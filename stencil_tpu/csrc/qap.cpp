@@ -1,0 +1,123 @@
+// Native quadratic-assignment solvers for topology-aware placement.
+//
+// TPU-native re-implementation of the reference's qap namespace
+// (reference: include/stencil/qap.hpp:51-180): an exact brute-force
+// search over permutations with a wall-clock timeout, and a greedy
+// pairwise-swap hill climb with incremental cost updates. Exposed as a
+// C ABI consumed from Python via ctypes (stencil_tpu/qap.py).
+//
+// Cost model: cost(f) = sum_{a,b} w[a][b] * d[f[a]][f[b]], with the
+// convention that 0 * inf == 0 (cost_product, qap.hpp:16-21).
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+inline double cost_product(double we, double de) {
+  if (0 == we || 0 == de) return 0;
+  return we * de;
+}
+
+inline double cost(int64_t n, const double *w, const double *d,
+                   const std::vector<int64_t> &f) {
+  double ret = 0;
+  for (int64_t a = 0; a < n; ++a)
+    for (int64_t b = 0; b < n; ++b)
+      ret += cost_product(w[a * n + b], d[f[a] * n + f[b]]);
+  return ret;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Exact search: all permutations, best kept; stops after timeout_s
+// seconds of wall clock (reference qap::solve uses a fixed 10 s cap).
+// Returns the best cost found; writes the permutation into out_f.
+double qap_solve_exact(int64_t n, const double *w, const double *d,
+                       int64_t *out_f, double timeout_s) {
+  using Clock = std::chrono::steady_clock;
+  const auto stop = Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                       std::chrono::duration<double>(timeout_s));
+  std::vector<int64_t> f(n);
+  for (int64_t i = 0; i < n; ++i) f[i] = i;
+  std::vector<int64_t> best = f;
+  double best_cost = cost(n, w, d, f);
+  uint64_t iter = 0;
+  while (std::next_permutation(f.begin(), f.end())) {
+    if ((++iter & 0x3FF) == 0 && Clock::now() > stop) break;
+    const double c = cost(n, w, d, f);
+    if (c < best_cost) {
+      best_cost = c;
+      best = f;
+    }
+  }
+  for (int64_t i = 0; i < n; ++i) out_f[i] = best[i];
+  return best_cost;
+}
+
+// Greedy pairwise-swap hill climb with incremental cost update
+// (reference qap::solve_catch, qap.hpp:87-180).
+double qap_solve_catch(int64_t n, const double *w, const double *d,
+                       int64_t *out_f) {
+  std::vector<int64_t> bestF(n);
+  for (int64_t i = 0; i < n; ++i) bestF[i] = i;
+  double bestCost = cost(n, w, d, bestF);
+
+  bool improved;
+  do {
+    improved = false;
+    std::vector<int64_t> imprF = bestF;
+    double imprCost = bestCost;
+    for (int64_t i = 0; i < n; ++i) {
+      for (int64_t j = i + 1; j < n; ++j) {
+        std::vector<int64_t> f = bestF;
+        double c = bestCost;
+        for (int64_t k = 0; k < n; ++k) {
+          c -= cost_product(w[i * n + k], d[f[i] * n + f[k]]);
+          c -= cost_product(w[j * n + k], d[f[j] * n + f[k]]);
+          if (k != i && k != j) {
+            c -= cost_product(w[k * n + i], d[f[k] * n + f[i]]);
+            c -= cost_product(w[k * n + j], d[f[k] * n + f[j]]);
+          }
+        }
+        std::swap(f[i], f[j]);
+        for (int64_t k = 0; k < n; ++k) {
+          c += cost_product(w[i * n + k], d[f[i] * n + f[k]]);
+          c += cost_product(w[j * n + k], d[f[j] * n + f[k]]);
+          if (k != i && k != j) {
+            c += cost_product(w[k * n + i], d[f[k] * n + f[i]]);
+            c += cost_product(w[k * n + j], d[f[k] * n + f[j]]);
+          }
+        }
+        // the incremental update is invalid when inf terms are involved
+        // (inf - inf = NaN); fall back to a full recompute
+        if (!std::isfinite(c)) c = cost(n, w, d, f);
+        if (c < imprCost) {
+          imprF = f;
+          imprCost = c;
+          improved = true;
+        }
+      }
+    }
+    if (improved) {
+      bestF = imprF;
+      bestCost = imprCost;
+    }
+  } while (improved);
+
+  for (int64_t i = 0; i < n; ++i) out_f[i] = bestF[i];
+  return bestCost;
+}
+
+double qap_cost(int64_t n, const double *w, const double *d,
+                const int64_t *f) {
+  std::vector<int64_t> fv(f, f + n);
+  return cost(n, w, d, fv);
+}
+
+}  // extern "C"

@@ -1,0 +1,301 @@
+"""Geometry value types: Dim3, Rect3, DirectionMap, Radius.
+
+TPU-native re-implementation of the reference's foundation layer
+(reference: include/stencil/dim3.hpp, rect3.hpp, direction_map.hpp,
+radius.hpp). These are pure-Python immutable values used for *planning*
+(partitioning, halo geometry, byte accounting); the data plane is JAX.
+
+Conventions
+-----------
+* A *direction* is a tuple ``(dx, dy, dz)`` with each component in
+  ``{-1, 0, 1}``. There are 26 non-zero directions.
+* ``Dim3`` is an immutable integer 3-vector with elementwise arithmetic
+  and periodic ``wrap`` (reference: dim3.hpp:208-230).
+* ``Radius`` stores 26 independent per-direction radii. The *allocation*
+  halo padding on each face side equals the face radius on that side
+  (reference: local_domain.cuh raw_size()); edge/corner radii gate
+  whether diagonal-neighbor data is required (reference:
+  src/stencil.cu:344).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, NamedTuple, Tuple, Union
+
+Dim3Like = Union["Dim3", Tuple[int, int, int]]
+
+
+class Dim3(NamedTuple):
+    """Immutable int64 3-vector (reference: include/stencil/dim3.hpp).
+
+    Note: the reference's ``operator!=``/``max`` have latent bugs
+    (dim3.hpp:195, 57-63); this class implements the intended semantics.
+    """
+
+    x: int
+    y: int
+    z: int
+
+    # -- constructors -------------------------------------------------
+    @staticmethod
+    def of(v: Dim3Like) -> "Dim3":
+        if isinstance(v, Dim3):
+            return v
+        return Dim3(int(v[0]), int(v[1]), int(v[2]))
+
+    @staticmethod
+    def filled(v: int) -> "Dim3":
+        return Dim3(v, v, v)
+
+    # -- arithmetic ---------------------------------------------------
+    def __add__(self, o: Dim3Like) -> "Dim3":  # type: ignore[override]
+        o = Dim3.of(o)
+        return Dim3(self.x + o.x, self.y + o.y, self.z + o.z)
+
+    def __sub__(self, o: Dim3Like) -> "Dim3":
+        o = Dim3.of(o)
+        return Dim3(self.x - o.x, self.y - o.y, self.z - o.z)
+
+    def __mul__(self, o: Union[int, Dim3Like]) -> "Dim3":  # type: ignore[override]
+        if isinstance(o, int):
+            return Dim3(self.x * o, self.y * o, self.z * o)
+        o = Dim3.of(o)
+        return Dim3(self.x * o.x, self.y * o.y, self.z * o.z)
+
+    __rmul__ = __mul__
+
+    def __floordiv__(self, o: Union[int, Dim3Like]) -> "Dim3":
+        if isinstance(o, int):
+            o = Dim3(o, o, o)
+        o = Dim3.of(o)
+        return Dim3(self.x // o.x, self.y // o.y, self.z // o.z)
+
+    def __mod__(self, o: Dim3Like) -> "Dim3":
+        o = Dim3.of(o)
+        return Dim3(self.x % o.x, self.y % o.y, self.z % o.z)
+
+    def __neg__(self) -> "Dim3":
+        return Dim3(-self.x, -self.y, -self.z)
+
+    # -- queries ------------------------------------------------------
+    def flatten(self) -> int:
+        """Product of components == element count (reference: dim3.hpp)."""
+        return self.x * self.y * self.z
+
+    def any_lt(self, v: int) -> bool:
+        return self.x < v or self.y < v or self.z < v
+
+    def all_lt(self, v: int) -> bool:
+        return self.x < v and self.y < v and self.z < v
+
+    def all_ge(self, v: int) -> bool:
+        return self.x >= v and self.y >= v and self.z >= v
+
+    def all_gt(self, v: int) -> bool:
+        return self.x > v and self.y > v and self.z > v
+
+    def elementwise_max(self, o: Dim3Like) -> "Dim3":
+        o = Dim3.of(o)
+        return Dim3(max(self.x, o.x), max(self.y, o.y), max(self.z, o.z))
+
+    def elementwise_min(self, o: Dim3Like) -> "Dim3":
+        o = Dim3.of(o)
+        return Dim3(min(self.x, o.x), min(self.y, o.y), min(self.z, o.z))
+
+    def wrap(self, lims: Dim3Like) -> "Dim3":
+        """Periodic modulo into ``[0, lims)`` (reference: dim3.hpp:208-230)."""
+        lims = Dim3.of(lims)
+        return Dim3(self.x % lims.x, self.y % lims.y, self.z % lims.z)
+
+    def __repr__(self) -> str:
+        return f"[{self.x},{self.y},{self.z}]"
+
+
+ZERO = Dim3(0, 0, 0)
+
+
+def all_directions(include_zero: bool = False) -> Iterator[Dim3]:
+    """Iterate the 26 (or 27) direction vectors in the reference's z-y-x
+    loop order (reference: src/stencil.cu:331-336)."""
+    for dz in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                d = Dim3(dx, dy, dz)
+                if d == ZERO and not include_zero:
+                    continue
+                yield d
+
+
+def direction_kind(d: Dim3Like) -> str:
+    """'face' | 'edge' | 'corner' by number of nonzero components."""
+    d = Dim3.of(d)
+    n = (d.x != 0) + (d.y != 0) + (d.z != 0)
+    return {1: "face", 2: "edge", 3: "corner"}[n] if n else "center"
+
+
+class Rect3(NamedTuple):
+    """Half-open box ``[lo, hi)`` (reference: include/stencil/rect3.hpp:13-22)."""
+
+    lo: Dim3
+    hi: Dim3
+
+    @staticmethod
+    def of(lo: Dim3Like, hi: Dim3Like) -> "Rect3":
+        return Rect3(Dim3.of(lo), Dim3.of(hi))
+
+    def extent(self) -> Dim3:
+        return self.hi - self.lo
+
+    def empty(self) -> bool:
+        e = self.extent()
+        return e.x <= 0 or e.y <= 0 or e.z <= 0
+
+    def contains(self, p: Dim3Like) -> bool:
+        p = Dim3.of(p)
+        return (self.lo.x <= p.x < self.hi.x
+                and self.lo.y <= p.y < self.hi.y
+                and self.lo.z <= p.z < self.hi.z)
+
+    def __repr__(self) -> str:
+        return f"Rect3({self.lo!r}..{self.hi!r})"
+
+
+class DirectionMap:
+    """3x3x3 table indexed by direction vectors in {-1,0,1}^3
+    (reference: include/stencil/direction_map.hpp:43-57)."""
+
+    __slots__ = ("_data",)
+
+    def __init__(self, fill=None) -> None:
+        self._data: List = [fill] * 27
+
+    @staticmethod
+    def _idx(x: int, y: int, z: int) -> int:
+        assert -1 <= x <= 1 and -1 <= y <= 1 and -1 <= z <= 1
+        return (x + 1) + (y + 1) * 3 + (z + 1) * 9
+
+    def at_dir(self, x: int, y: int, z: int):
+        return self._data[self._idx(x, y, z)]
+
+    def set_dir(self, x: int, y: int, z: int, v) -> None:
+        self._data[self._idx(x, y, z)] = v
+
+    def __getitem__(self, d: Dim3Like):
+        d = Dim3.of(d)
+        return self.at_dir(d.x, d.y, d.z)
+
+    def __setitem__(self, d: Dim3Like, v) -> None:
+        d = Dim3.of(d)
+        self.set_dir(d.x, d.y, d.z, v)
+
+    def __eq__(self, o) -> bool:
+        return isinstance(o, DirectionMap) and self._data == o._data
+
+    def copy(self) -> "DirectionMap":
+        m = DirectionMap()
+        m._data = list(self._data)
+        return m
+
+
+class Radius:
+    """Per-direction stencil radius: 26 directions + center
+    (reference: include/stencil/radius.hpp:14-104).
+
+    Supports asymmetric/uncentered kernels: the radius may differ per
+    direction (e.g. +x vs -x). The halo region a subdomain allocates on
+    side ``d`` of axis ``a`` has width ``face radius of (sign d) along a``;
+    edge/corner radii control whether diagonal-neighbor halo data is
+    required at all (zero = that exchange may be skipped — reference:
+    src/stencil.cu:344).
+    """
+
+    __slots__ = ("_m",)
+
+    def __init__(self) -> None:
+        self._m = DirectionMap(0)
+
+    # -- indexing -----------------------------------------------------
+    def dir(self, d: Dim3Like) -> int:
+        return self._m[Dim3.of(d)]
+
+    def set_dir(self, d: Dim3Like, v: int) -> None:
+        d = Dim3.of(d)
+        self._m[d] = int(v)
+
+    def x(self, d: int) -> int:
+        """Face radius along x on side ``d`` in {-1, 0, 1}."""
+        return self._m.at_dir(d, 0, 0)
+
+    def y(self, d: int) -> int:
+        return self._m.at_dir(0, d, 0)
+
+    def z(self, d: int) -> int:
+        return self._m.at_dir(0, 0, d)
+
+    def face(self, axis: int, side: int) -> int:
+        """Face radius on ``side`` (+1/-1) of ``axis`` (0=x,1=y,2=z)."""
+        d = [0, 0, 0]
+        d[axis] = side
+        return self._m.at_dir(*d)
+
+    def __eq__(self, o) -> bool:
+        return isinstance(o, Radius) and self._m == o._m
+
+    # -- setters ------------------------------------------------------
+    def set_face(self, r: int) -> None:
+        for d in all_directions():
+            if direction_kind(d) == "face":
+                self._m[d] = int(r)
+
+    def set_edge(self, r: int) -> None:
+        for d in all_directions():
+            if direction_kind(d) == "edge":
+                self._m[d] = int(r)
+
+    def set_corner(self, r: int) -> None:
+        for d in all_directions():
+            if direction_kind(d) == "corner":
+                self._m[d] = int(r)
+
+    # -- constructors -------------------------------------------------
+    @staticmethod
+    def constant(r: int) -> "Radius":
+        out = Radius()
+        for d in all_directions(include_zero=True):
+            out._m[d] = int(r)
+        return out
+
+    @staticmethod
+    def face_edge_corner(face: int, edge: int, corner: int) -> "Radius":
+        out = Radius()
+        out.set_face(face)
+        out.set_edge(edge)
+        out.set_corner(corner)
+        out._m[ZERO] = 0
+        return out
+
+    # -- derived geometry --------------------------------------------
+    def pad_lo(self) -> Dim3:
+        """Allocation padding on the low side of each axis
+        (reference: local_domain.cuh raw_size())."""
+        return Dim3(self.x(-1), self.y(-1), self.z(-1))
+
+    def pad_hi(self) -> Dim3:
+        return Dim3(self.x(1), self.y(1), self.z(1))
+
+    def max_side(self, axis: int, side: int) -> int:
+        """Max radius over all directions whose ``axis`` component equals
+        ``side`` — the amount the interior shrinks on that side
+        (reference: src/stencil.cu get_interior, 874-921)."""
+        best = 0
+        for d in all_directions():
+            if d[axis] == side:
+                best = max(best, self._m[d])
+        return best
+
+    def to_dict(self) -> Dict[Tuple[int, int, int], int]:
+        return {tuple(d): self._m[d] for d in all_directions(include_zero=True)}
+
+    def __repr__(self) -> str:
+        return (f"Radius(face=[{self.x(-1)},{self.x(1)},{self.y(-1)},{self.y(1)},"
+                f"{self.z(-1)},{self.z(1)}])")

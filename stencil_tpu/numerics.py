@@ -1,0 +1,127 @@
+"""Small numeric helpers.
+
+TPU-native re-implementation of the reference's numeric utilities
+(reference: include/stencil/numeric.hpp, src/numeric.cpp:7-27).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Sequence
+
+
+def next_power_of_two(x: int) -> int:
+    """Smallest power of two >= x (reference: include/stencil/numeric.hpp)."""
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def prime_factors(n: int) -> List[int]:
+    """Prime factorization of ``n``, sorted descending.
+
+    Matches the semantics of the reference's ``prime_factors``
+    (src/numeric.cpp:7-27): returns the multiset of prime factors,
+    largest first, so recursive splitters cut by big factors first.
+    ``prime_factors(1) == [1]`` and ``prime_factors(0) == []`` as in the
+    reference.
+    """
+    if n <= 0:
+        return []
+    if n == 1:
+        return [1]
+    out: List[int] = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    out.sort(reverse=True)
+    return out
+
+
+def div_ceil(n: int, d: int) -> int:
+    """Ceiling division (reference: include/stencil/numeric.hpp)."""
+    return -(-n // d)
+
+
+def next_align_of(x: int, align: int) -> int:
+    """Round ``x`` up to a multiple of ``align`` (reference: include/stencil/align.cuh:7-9)."""
+    return div_ceil(x, align) * align
+
+
+def get_max_abs_error(a: Sequence[float], b: Sequence[float]) -> float:
+    """Max elementwise absolute error (reference: include/stencil/numeric.hpp)."""
+    return max((abs(x - y) for x, y in zip(a, b)), default=0.0)
+
+
+def trimean(samples: Sequence[float]) -> float:
+    """Tukey trimean (q1 + 2*q2 + q3) / 4 over sorted samples.
+
+    This is the summary statistic all reference benchmarks report
+    (reference: bin/statistics.hpp:6-19).
+    """
+    s = sorted(samples)
+    n = len(s)
+    if n == 0:
+        raise ValueError("trimean of empty sample set")
+
+    def quantile(q: float) -> float:
+        # linear interpolation between closest ranks (type-7, numpy default)
+        idx = q * (n - 1)
+        lo = math.floor(idx)
+        hi = math.ceil(idx)
+        frac = idx - lo
+        return s[lo] * (1.0 - frac) + s[hi] * frac
+
+    return (quantile(0.25) + 2.0 * quantile(0.5) + quantile(0.75)) / 4.0
+
+
+class Statistics:
+    """Streaming accumulator reporting min/max/avg/median/trimean/stddev.
+
+    Mirrors the accumulator used by every reference benchmark
+    (reference: bin/statistics.hpp:6-19).
+    """
+
+    def __init__(self) -> None:
+        self._samples: List[float] = []
+
+    def insert(self, x: float) -> None:
+        self._samples.append(float(x))
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    @property
+    def count(self) -> int:
+        return len(self._samples)
+
+    def min(self) -> float:
+        return min(self._samples)
+
+    def max(self) -> float:
+        return max(self._samples)
+
+    def avg(self) -> float:
+        return sum(self._samples) / len(self._samples)
+
+    def median(self) -> float:
+        s = sorted(self._samples)
+        n = len(s)
+        mid = n // 2
+        return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+    def trimean(self) -> float:
+        return trimean(self._samples)
+
+    def stddev(self) -> float:
+        n = len(self._samples)
+        if n < 2:
+            return 0.0
+        mean = self.avg()
+        var = sum((x - mean) ** 2 for x in self._samples) / (n - 1)
+        return math.sqrt(var)

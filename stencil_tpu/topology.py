@@ -1,0 +1,47 @@
+"""Logical 3D lattice of subdomain indices with periodic neighbors.
+
+TPU-native re-implementation of the reference's Topology
+(reference: include/stencil/topology.hpp:9-30, src/topology.cpp:5-17).
+The reference only implements PERIODIC boundaries (NONE is fatal); we
+support both PERIODIC and NONE (neighbor may not exist).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple
+
+from .geometry import Dim3, Dim3Like
+
+
+class Boundary(enum.Enum):
+    """Boundary condition for the global lattice (reference:
+    include/stencil/boundary.hpp — dead code there; live here)."""
+
+    PERIODIC = "periodic"
+    NONE = "none"
+
+
+class OptionalNeighbor(NamedTuple):
+    exists: bool
+    index: Dim3
+
+
+class Topology:
+    """3D lattice of subdomain indices (reference: topology.hpp:9-30)."""
+
+    def __init__(self, dim: Dim3Like, boundary: Boundary = Boundary.PERIODIC) -> None:
+        self.dim = Dim3.of(dim)
+        self.boundary = boundary
+
+    def get_neighbor(self, index: Dim3Like, dir: Dim3Like) -> OptionalNeighbor:
+        """Neighbor of ``index`` in direction ``dir``; wraps periodically
+        (reference: src/topology.cpp:5-17)."""
+        index = Dim3.of(index)
+        dir = Dim3.of(dir)
+        raw = index + dir
+        if self.boundary == Boundary.PERIODIC:
+            return OptionalNeighbor(True, raw.wrap(self.dim))
+        inside = (0 <= raw.x < self.dim.x and 0 <= raw.y < self.dim.y
+                  and 0 <= raw.z < self.dim.z)
+        return OptionalNeighbor(inside, raw.wrap(self.dim) if inside else raw)

@@ -1,0 +1,18 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of simulating multi-GPU / multi-node
+without a cluster (SURVEY.md section 4): the reference oversubscribes one
+GPU (test/test_exchange.cu:52 `dd.set_gpus({0,0})`); we fake an 8-device
+mesh on CPU via XLA_FLAGS. Must run before jax is imported — a
+sitecustomize in this image forces JAX_PLATFORMS=axon, so we override it
+here rather than in the shell environment.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,128 @@
+"""Unit tests for geometry value types (mirrors reference
+test/test_cpu_{numeric,radius,mat2d}.cpp coverage)."""
+
+import pytest
+
+from stencil_tpu.geometry import (Dim3, Rect3, Radius, all_directions,
+                                  direction_kind)
+from stencil_tpu.numerics import (Statistics, div_ceil, next_align_of,
+                                  next_power_of_two, prime_factors, trimean)
+
+
+class TestNumerics:
+    def test_prime_factors(self):
+        assert prime_factors(12) == [3, 2, 2]
+        assert prime_factors(1) == [1]
+        assert prime_factors(0) == []
+        assert prime_factors(13) == [13]
+        assert prime_factors(8) == [2, 2, 2]
+        assert prime_factors(30) == [5, 3, 2]
+
+    def test_div_ceil(self):
+        assert div_ceil(10, 3) == 4
+        assert div_ceil(9, 3) == 3
+        assert div_ceil(1, 3) == 1
+
+    def test_next_power_of_two(self):
+        assert next_power_of_two(1) == 1
+        assert next_power_of_two(3) == 4
+        assert next_power_of_two(8) == 8
+        assert next_power_of_two(9) == 16
+
+    def test_next_align_of(self):
+        # reference: include/stencil/align.cuh:7-9
+        assert next_align_of(0, 8) == 0
+        assert next_align_of(1, 8) == 8
+        assert next_align_of(8, 8) == 8
+        assert next_align_of(9, 4) == 12
+
+    def test_trimean(self):
+        assert trimean([1.0, 2.0, 3.0, 4.0, 5.0]) == pytest.approx(3.0)
+        # asymmetric sample: q1=0.0, q2=0.5, q3=25.75 (type-7 quantiles)
+        assert trimean([0.0, 0.0, 1.0, 100.0]) == pytest.approx(
+            (0.0 + 2 * 0.5 + 25.75) / 4.0)
+
+    def test_statistics(self):
+        s = Statistics()
+        for v in [3.0, 1.0, 2.0]:
+            s.insert(v)
+        assert s.min() == 1.0
+        assert s.max() == 3.0
+        assert s.avg() == pytest.approx(2.0)
+        assert s.median() == pytest.approx(2.0)
+
+
+class TestDim3:
+    def test_arithmetic(self):
+        a = Dim3(1, 2, 3)
+        b = Dim3(4, 5, 6)
+        assert a + b == Dim3(5, 7, 9)
+        assert b - a == Dim3(3, 3, 3)
+        assert a * 2 == Dim3(2, 4, 6)
+        assert a * b == Dim3(4, 10, 18)
+        assert -a == Dim3(-1, -2, -3)
+        assert Dim3(7, 8, 9) % Dim3(2, 3, 4) == Dim3(1, 2, 1)
+
+    def test_flatten(self):
+        assert Dim3(2, 3, 4).flatten() == 24
+
+    def test_wrap(self):
+        # periodic modulo (reference: dim3.hpp:208-230)
+        assert Dim3(-1, 5, 3).wrap((4, 4, 4)) == Dim3(3, 1, 3)
+        assert Dim3(4, -2, 0).wrap((4, 4, 4)) == Dim3(0, 2, 0)
+
+    def test_neq_intended_semantics(self):
+        # the reference operator!= has a latent bug (dim3.hpp:195);
+        # we implement intended semantics
+        assert Dim3(1, 1, 1) != Dim3(1, 1, 2)
+        assert Dim3(1, 1, 1) == Dim3(1, 1, 1)
+
+
+class TestRect3:
+    def test_extent_contains(self):
+        r = Rect3.of((1, 1, 1), (4, 5, 6))
+        assert r.extent() == Dim3(3, 4, 5)
+        assert r.contains((1, 1, 1))
+        assert not r.contains((4, 1, 1))
+        assert not r.empty()
+        assert Rect3.of((2, 2, 2), (2, 5, 5)).empty()
+
+
+class TestRadius:
+    def test_constant(self):
+        r = Radius.constant(2)
+        for d in all_directions():
+            assert r.dir(d) == 2
+
+    def test_face_edge_corner(self):
+        # mirrors reference test_cpu_radius.cpp coverage
+        r = Radius.face_edge_corner(3, 2, 1)
+        assert r.dir((1, 0, 0)) == 3
+        assert r.dir((0, -1, 0)) == 3
+        assert r.dir((1, 1, 0)) == 2
+        assert r.dir((0, -1, 1)) == 2
+        assert r.dir((1, 1, 1)) == 1
+        assert r.dir((-1, -1, -1)) == 1
+        assert r.dir((0, 0, 0)) == 0
+        assert r.x(1) == 3 and r.y(-1) == 3 and r.z(0) == 0
+
+    def test_direction_kinds(self):
+        kinds = [direction_kind(d) for d in all_directions()]
+        assert kinds.count("face") == 6
+        assert kinds.count("edge") == 12
+        assert kinds.count("corner") == 8
+
+    def test_asymmetric(self):
+        r = Radius.constant(0)
+        r.set_dir((1, 0, 0), 3)   # uncentered kernel: +x only
+        assert r.pad_hi() == Dim3(3, 0, 0)
+        assert r.pad_lo() == Dim3(0, 0, 0)
+        assert r.max_side(0, 1) == 3
+        assert r.max_side(0, -1) == 0
+
+    def test_max_side_includes_diagonals(self):
+        r = Radius.face_edge_corner(1, 2, 3)
+        # corner radius 3 dominates every side
+        for axis in range(3):
+            for side in (-1, 1):
+                assert r.max_side(axis, side) == 3

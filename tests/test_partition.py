@@ -1,0 +1,176 @@
+"""Partition / topology / placement-solver tests mirroring the
+reference's test/test_cpu_partition.cpp and test_cpu_qap.cpp pinned
+arithmetic."""
+
+import numpy as np
+import pytest
+
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.partition import (NodePartition, RankPartition,
+                                   partition_dims_even)
+from stencil_tpu.qap import cost, make_reciprocal, solve, solve_catch
+from stencil_tpu.topology import Boundary, Topology
+
+
+class TestRankPartition:
+    """Pinned cases from reference test/test_cpu_partition.cpp:22-41."""
+
+    def test_10x5x5_into_2(self):
+        p = RankPartition((10, 5, 5), 2)
+        assert p.dim() == Dim3(2, 1, 1)
+        assert p.subdomain_size((0, 0, 0)) == Dim3(5, 5, 5)
+        assert p.subdomain_size((1, 0, 0)) == Dim3(5, 5, 5)
+
+    def test_10x3x1_into_4(self):
+        p = RankPartition((10, 3, 1), 4)
+        assert p.subdomain_size((0, 0, 0)) == Dim3(3, 3, 1)
+        assert p.subdomain_size((1, 0, 0)) == Dim3(3, 3, 1)
+        assert p.subdomain_size((2, 0, 0)) == Dim3(2, 3, 1)
+        assert p.subdomain_size((3, 0, 0)) == Dim3(2, 3, 1)
+        assert p.subdomain_origin((0, 0, 0)) == Dim3(0, 0, 0)
+        assert p.subdomain_origin((1, 0, 0)) == Dim3(3, 0, 0)
+        assert p.subdomain_origin((2, 0, 0)) == Dim3(6, 0, 0)
+        assert p.subdomain_origin((3, 0, 0)) == Dim3(8, 0, 0)
+
+    def test_10x5x5_into_3(self):
+        p = RankPartition((10, 5, 5), 3)
+        assert p.subdomain_size((0, 0, 0)) == Dim3(4, 5, 5)
+        assert p.subdomain_size((1, 0, 0)) == Dim3(3, 5, 5)
+        assert p.subdomain_size((2, 0, 0)) == Dim3(3, 5, 5)
+
+    def test_13x7x7_into_4(self):
+        p = RankPartition((13, 7, 7), 4)
+        assert p.subdomain_size((0, 0, 0)) == Dim3(4, 7, 7)
+        assert p.subdomain_size((1, 0, 0)) == Dim3(3, 7, 7)
+        assert p.subdomain_size((2, 0, 0)) == Dim3(3, 7, 7)
+        assert p.subdomain_size((3, 0, 0)) == Dim3(3, 7, 7)
+
+    def test_10x14x2_into_9(self):
+        p = RankPartition((10, 14, 2), 9)
+        assert p.subdomain_origin((0, 0, 0)) == Dim3(0, 0, 0)
+        assert p.subdomain_origin((1, 1, 0)) == Dim3(4, 5, 0)
+        assert p.subdomain_origin((2, 2, 0)) == Dim3(7, 10, 0)
+
+    def test_sizes_tile_exactly(self):
+        # subdomain sizes and origins must tile the global grid
+        p = RankPartition((13, 7, 7), 6)
+        d = p.dim()
+        total = 0
+        for z in range(d.z):
+            for y in range(d.y):
+                for x in range(d.x):
+                    total += p.subdomain_size((x, y, z)).flatten()
+        assert total == 13 * 7 * 7
+
+    def test_linearize_roundtrip(self):
+        p = RankPartition((16, 16, 16), 8)
+        d = p.dim()
+        for i in range(d.flatten()):
+            assert p.linearize(p.dimensionize(i)) == i
+
+
+class TestNodePartition:
+    def test_min_interface_split(self):
+        # radius only in z -> cutting z is expensive; x/y preferred
+        r = Radius.constant(0)
+        r.set_dir((0, 0, 1), 2)
+        r.set_dir((0, 0, -1), 2)
+        p = NodePartition((8, 8, 8), r, 2, 2)
+        assert p.dim().z == 1
+        assert p.dim().flatten() == 4
+
+    def test_two_level_dims(self):
+        r = Radius.constant(1)
+        p = NodePartition((64, 64, 64), r, 2, 4)
+        assert (p.sys_dim() * p.node_dim()).flatten() == 8
+        assert p.dim() == p.sys_dim() * p.node_dim()
+
+    def test_sizes_tile_exactly(self):
+        r = Radius.constant(1)
+        p = NodePartition((13, 7, 7), r, 2, 2)
+        d = p.dim()
+        total = 0
+        for z in range(d.z):
+            for y in range(d.y):
+                for x in range(d.x):
+                    total += p.subdomain_size((x, y, z)).flatten()
+        assert total == 13 * 7 * 7
+
+
+class TestPartitionDimsEven:
+    def test_exact_when_divisible(self):
+        d = partition_dims_even((64, 64, 64), 8)
+        assert d.flatten() == 8
+        assert Dim3(64, 64, 64) % d == Dim3(0, 0, 0)
+
+    def test_finds_divisor_shape(self):
+        d = partition_dims_even((12, 10, 1), 4)
+        assert d.flatten() == 4
+        assert Dim3(12, 10, 1) % d == Dim3(0, 0, 0)
+
+    def test_raises_when_impossible(self):
+        with pytest.raises(ValueError):
+            partition_dims_even((7, 7, 7), 2)
+
+
+class TestTopology:
+    def test_periodic_wrap(self):
+        # reference: src/topology.cpp:5-17 (PERIODIC only)
+        t = Topology((2, 2, 2))
+        n = t.get_neighbor((0, 0, 0), (-1, 0, 0))
+        assert n.exists and n.index == Dim3(1, 0, 0)
+        n = t.get_neighbor((1, 1, 1), (1, 1, 1))
+        assert n.exists and n.index == Dim3(0, 0, 0)
+
+    def test_none_boundary(self):
+        t = Topology((2, 2, 2), Boundary.NONE)
+        assert not t.get_neighbor((0, 0, 0), (-1, 0, 0)).exists
+        assert t.get_neighbor((0, 0, 0), (1, 0, 0)).exists
+
+
+class TestQap:
+    """Pinned cases from reference test/test_cpu_qap.cpp:30-60."""
+
+    def test_unbalanced_triangle(self):
+        inf = np.inf
+        bw = np.array([[inf, 1, 10], [1, inf, 1], [10, 1, inf]])
+        comm = np.array([[0, 10, 1], [10, 0, 1], [1, 1, 0.0]])
+        f, c = solve(comm, make_reciprocal(bw))
+        assert f == [0, 2, 1]
+
+    def test_p9(self):
+        bw = np.array([[900, 75, 64, 64],
+                       [75, 900, 64, 64],
+                       [64, 64, 900, 75],
+                       [64, 64, 75, 900.0]])
+        comm = np.array([[7, 5, 10, 1],
+                         [5, 7, 1, 10],
+                         [10, 1, 7, 5],
+                         [1, 10, 5, 7.0]])
+        f, c = solve(comm, make_reciprocal(bw))
+        assert f == [0, 2, 1, 3]
+
+    def test_p9_catch(self):
+        bw = np.array([[900, 75, 64, 64],
+                       [75, 900, 64, 64],
+                       [64, 64, 900, 75],
+                       [64, 64, 75, 900.0]])
+        comm = np.array([[7, 5, 10, 1],
+                         [5, 7, 1, 10],
+                         [10, 1, 7, 5],
+                         [1, 10, 5, 7.0]])
+        dist = make_reciprocal(bw)
+        f_exact, c_exact = solve(comm, dist)
+        f_catch, c_catch = solve_catch(comm, dist)
+        # hill climb must be no worse than identity and match cost()
+        assert c_catch <= cost(comm, dist, list(range(4)))
+        assert c_catch == pytest.approx(cost(comm, dist, f_catch))
+
+    def test_solver_agreement_random(self):
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            w = rng.uniform(0, 10, (5, 5))
+            np.fill_diagonal(w, 0)
+            d = rng.uniform(0.1, 1, (5, 5))
+            f, c = solve(w, d)
+            assert c == pytest.approx(cost(w, d, f))
