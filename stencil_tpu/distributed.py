@@ -1,0 +1,334 @@
+"""DistributedDomain: the public orchestrator.
+
+TPU-native re-implementation of the reference's DistributedDomain
+(reference: include/stencil/stencil.hpp:33-225, src/stencil.cu), the
+single class applications talk to:
+
+* configure: ``add_data`` / ``set_radius`` / ``set_methods`` /
+  ``set_placement`` / ``set_mesh_shape`` / ``set_output_prefix``
+* ``realize()`` — partition the global grid, place subdomains on the
+  device mesh, allocate sharded double-buffered padded fields, build the
+  jitted exchange program, and emit plan files + byte counters
+  (reference: src/stencil.cu:241-850).
+* per iteration: ``exchange()`` then ``swap()``
+  (reference: src/stencil.cu:1002-1186, local_domain.cu:67-84).
+* geometry queries for overlap: ``get_interior`` / ``get_exterior`` /
+  ``get_compute_region`` (reference: src/stencil.cu:874-977).
+* IO: ``write_paraview`` (reference: src/stencil.cu:1188-1264).
+
+Where the reference plans per-pair transports and polls senders, here
+``realize()`` lowers the whole exchange to one XLA SPMD program over a
+3D ``jax.sharding.Mesh``; XLA owns scheduling, streams, and the wire.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .geometry import Dim3, Dim3Like, Radius, Rect3
+from .local_domain import (LocalDomain, get_exterior as _dom_exterior,
+                           get_interior as _dom_interior, raw_size, zyx_shape)
+from .parallel.exchange import exchanged_bytes_per_sweep, make_exchange
+from .parallel.mesh import make_mesh, mesh_dim
+from .parallel.methods import Method, pick_method
+from .partition import RankPartition, partition_dims_even
+from .placement import Placement, PlacementStrategy, make_placement
+from .topology import Boundary, Topology
+from .utils.logging import LOG_INFO
+
+
+class DistributedDomain:
+    """Global 3D grid of quantities distributed over a TPU mesh."""
+
+    def __init__(self, x: int, y: int, z: int,
+                 devices: Optional[Sequence] = None) -> None:
+        self.size = Dim3(x, y, z)
+        self._devices = list(devices) if devices is not None else list(jax.devices())
+        self.radius = Radius.constant(0)
+        self._names: List[str] = []
+        self._dtypes: Dict[str, np.dtype] = {}
+        self.methods = Method.Default
+        self.strategy = PlacementStrategy.NodeAware
+        self._mesh_shape: Optional[Dim3] = None
+        self._output_prefix = os.environ.get("STENCIL_OUTPUT_PREFIX", "")
+        self.boundary = Boundary.PERIODIC
+        # populated by realize()
+        self.mesh = None
+        self.placement: Optional[Placement] = None
+        self.topology: Optional[Topology] = None
+        self.local_size: Optional[Dim3] = None
+        self.curr: Dict[str, jnp.ndarray] = {}
+        self.next_: Dict[str, jnp.ndarray] = {}
+        self._exchange_fn = None
+        self._bytes_per_axis: Dict[str, int] = {}
+        self.setup_seconds: Dict[str, float] = {}
+        self.exchange_seconds: List[float] = []
+        self._timing = False
+
+    # ------------------------------------------------------------------
+    # configuration (reference: stencil.hpp:134-158)
+    # ------------------------------------------------------------------
+    def add_data(self, name: str, dtype=jnp.float32) -> str:
+        """Register a quantity (reference: stencil.hpp add_data<T>).
+        Returns the name as the data handle."""
+        assert self.mesh is None, "add_data before realize()"
+        assert name not in self._dtypes, f"duplicate quantity {name}"
+        self._names.append(name)
+        self._dtypes[name] = np.dtype(dtype)
+        return name
+
+    def set_radius(self, r: Union[int, Radius]) -> None:
+        self.radius = Radius.constant(r) if isinstance(r, int) else r
+
+    def set_methods(self, m: Method) -> None:
+        self.methods = m
+
+    def set_placement(self, s: PlacementStrategy) -> None:
+        self.strategy = s
+
+    def set_mesh_shape(self, shape: Dim3Like) -> None:
+        """Explicit subdomain-grid shape (the set_gpus analog —
+        reference tests oversubscribe one GPU via set_gpus({0,0}),
+        here a 1-device mesh axis plays that role)."""
+        self._mesh_shape = Dim3.of(shape)
+
+    def set_output_prefix(self, prefix: str) -> None:
+        self._output_prefix = prefix
+
+    def set_boundary(self, b: Boundary) -> None:
+        self.boundary = b
+
+    def enable_timing(self, on: bool = True) -> None:
+        """The STENCIL_EXCHANGE_STATS analog — off by default because it
+        synchronizes every exchange (reference: bin/jacobi3d.cu:149-153
+        warns it distorts benchmarks)."""
+        self._timing = on
+
+    # ------------------------------------------------------------------
+    # realize (reference: src/stencil.cu:241-850)
+    # ------------------------------------------------------------------
+    def realize(self) -> None:
+        assert self._names, "add_data at least one quantity before realize()"
+        if self.boundary != Boundary.PERIODIC:
+            raise NotImplementedError("only PERIODIC boundaries for now "
+                                      "(the reference hardcodes PERIODIC too)")
+        n = len(self._devices)
+
+        t0 = time.perf_counter()
+        # --- partition: choose the subdomain grid ----------------------
+        if self._mesh_shape is not None:
+            dim = self._mesh_shape
+            if dim.flatten() != n:
+                raise ValueError(f"mesh shape {dim} != device count {n}")
+            if self.size % dim != Dim3(0, 0, 0):
+                raise ValueError(f"grid {self.size} not divisible by mesh {dim}")
+        else:
+            dim = partition_dims_even(self.size, n)
+        part = RankPartition.from_dim(self.size, dim)
+        self.local_size = self.size // dim
+        if self.local_size.any_lt(1):
+            raise ValueError(f"zero-extent subdomains: {self.local_size}")
+        if any(self.local_size[a] < self.radius.face(a, 1) or
+               self.local_size[a] < self.radius.face(a, -1)
+               for a in range(3)):
+            raise ValueError(f"subdomain {self.local_size} smaller than "
+                             f"radius {self.radius}")
+        self.setup_seconds["partition"] = time.perf_counter() - t0
+
+        # --- placement (reference: src/stencil.cu:201-239) -------------
+        t0 = time.perf_counter()
+        elem_sizes = [self._dtypes[q].itemsize for q in self._names]
+        self.placement = make_placement(self.strategy, part, self._devices,
+                                        self.radius, elem_sizes)
+        self.topology = Topology(dim, self.boundary)
+        self.setup_seconds["placement"] = time.perf_counter() - t0
+
+        # --- mesh + allocation (reference: src/stencil.cu:249-272) -----
+        t0 = time.perf_counter()
+        self.mesh = make_mesh(dim, self.placement.device_order_for_mesh())
+        padded_local = raw_size(self.local_size, self.radius)
+        global_padded = padded_local * dim
+        sharding = NamedSharding(self.mesh, P("z", "y", "x"))
+        for q in self._names:
+            shape = zyx_shape(global_padded)
+            dt = self._dtypes[q]
+            self.curr[q] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)
+            self.next_[q] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)
+        self.setup_seconds["realize"] = time.perf_counter() - t0
+
+        # --- plan: build the exchange program --------------------------
+        t0 = time.perf_counter()
+        self._exchange_fn = make_exchange(self.mesh, self.radius, self.methods)
+        counts = mesh_dim(self.mesh)
+        self._bytes_per_axis = {"x": 0, "y": 0, "z": 0}
+        for q in self._names:
+            b = exchanged_bytes_per_sweep(zyx_shape(padded_local), self.radius,
+                                          counts, self._dtypes[q].itemsize)
+            for k in b:
+                self._bytes_per_axis[k] += b[k]
+        self.setup_seconds["plan"] = time.perf_counter() - t0
+
+        if self._output_prefix:
+            self._write_plan()
+        LOG_INFO(f"realized {self.size} over mesh {dim} "
+                 f"(local {self.local_size}, padded {padded_local}, "
+                 f"method {pick_method(self.methods)})")
+
+    # ------------------------------------------------------------------
+    # iteration hot path
+    # ------------------------------------------------------------------
+    def exchange(self) -> None:
+        """Fill all halos of all quantities' *curr* buffers
+        (reference: src/stencil.cu:1002-1186 — pack/send/poll/unpack
+        collapse into one jitted SPMD program)."""
+        assert self._exchange_fn is not None, "realize() first"
+        if self._timing:
+            from .utils.timers import device_sync
+            t0 = time.perf_counter()
+            out = self._exchange_fn(self.curr)
+            device_sync(out)
+            self.exchange_seconds.append(time.perf_counter() - t0)
+            self.curr = dict(out)
+        else:
+            self.curr = dict(self._exchange_fn(self.curr))
+
+    def swap(self) -> None:
+        """Swap curr/next bindings (reference: src/local_domain.cu:67-84)."""
+        self.curr, self.next_ = self.next_, self.curr
+
+    # ------------------------------------------------------------------
+    # geometry queries (reference: src/stencil.cu:874-977)
+    # ------------------------------------------------------------------
+    def num_subdomains(self) -> int:
+        return self.placement.dim().flatten() if self.placement else 0
+
+    def domain_view(self, i: int) -> LocalDomain:
+        """Geometry-only LocalDomain for subdomain with linear id ``i``
+        (no separate allocation — data lives in the sharded globals)."""
+        idx = self.placement.part.dimensionize(i)
+        dom = LocalDomain(self.placement.subdomain_size(idx),
+                          self.placement.subdomain_origin(idx), self.radius)
+        for q in self._names:
+            dom.add_data(q, self._dtypes[q])
+        return dom
+
+    def get_interior(self) -> List[Rect3]:
+        """Per-subdomain interior regions whose stencil reads never
+        touch halos — safe to compute while the exchange is in flight."""
+        return [_dom_interior(self.domain_view(i))
+                for i in range(self.num_subdomains())]
+
+    def get_exterior(self) -> List[List[Rect3]]:
+        return [_dom_exterior(self.domain_view(i))
+                for i in range(self.num_subdomains())]
+
+    def get_compute_region(self) -> Rect3:
+        return Rect3(Dim3(0, 0, 0), self.size)
+
+    # ------------------------------------------------------------------
+    # observability (reference: src/stencil.cu:482-637, stencil.hpp:86-93)
+    # ------------------------------------------------------------------
+    def exchange_bytes_per_axis(self) -> Dict[str, int]:
+        """Bytes one shard puts on the ICI per exchange, per mesh axis
+        (the per-method byte-counter analog)."""
+        return dict(self._bytes_per_axis)
+
+    def exchange_bytes_total(self) -> int:
+        """Total cross-device bytes per exchange over the whole mesh."""
+        counts = mesh_dim(self.mesh)
+        return sum(v * counts.flatten() for v in self._bytes_per_axis.values())
+
+    def _write_plan(self) -> None:
+        """Emit plan file + communication matrix (reference:
+        src/stencil.cu:482-637: plan_<rank>.txt and the rank x rank
+        matrix in numpy.loadtxt format)."""
+        prefix = self._output_prefix
+        dim = self.placement.dim()
+        n = dim.flatten()
+        with open(f"{prefix}plan.txt", "w") as f:
+            f.write(f"global size: {self.size}\n")
+            f.write(f"mesh: {dim}\n")
+            f.write(f"local size: {self.local_size}\n")
+            f.write(f"method: {pick_method(self.methods)}\n")
+            f.write(f"quantities: {self._names}\n")
+            for i in range(n):
+                idx = self.placement.part.dimensionize(i)
+                dev = self.placement.get_device(idx)
+                f.write(f"subdomain {i} idx {idx} -> device {dev}\n")
+            for axis, b in self._bytes_per_axis.items():
+                f.write(f"bytes per shard per exchange, axis {axis}: {b}\n")
+        from .placement import comm_bytes_matrix
+        w = comm_bytes_matrix(self.placement.part, self.radius,
+                              [self._dtypes[q].itemsize for q in self._names])
+        np.savetxt(f"{prefix}comm_matrix.txt", w, fmt="%d")
+
+    # ------------------------------------------------------------------
+    # IO (reference: src/stencil.cu:1188-1264)
+    # ------------------------------------------------------------------
+    def interior_to_host(self, name: str) -> np.ndarray:
+        """Assemble the full global interior (z,y,x-ordered) on host by
+        stripping per-shard halo padding."""
+        dim = self.placement.dim()
+        local = self.local_size
+        pr = raw_size(local, self.radius)
+        lo = self.radius.pad_lo()
+        host = np.asarray(self.curr[name])
+        out = np.empty(zyx_shape(self.size), dtype=host.dtype)
+        for bz in range(dim.z):
+            for by in range(dim.y):
+                for bx in range(dim.x):
+                    blk = host[bz * pr.z + lo.z: bz * pr.z + lo.z + local.z,
+                               by * pr.y + lo.y: by * pr.y + lo.y + local.y,
+                               bx * pr.x + lo.x: bx * pr.x + lo.x + local.x]
+                    out[bz * local.z:(bz + 1) * local.z,
+                        by * local.y:(by + 1) * local.y,
+                        bx * local.x:(bx + 1) * local.x] = blk
+        return out
+
+    def set_interior(self, name: str, values: np.ndarray) -> None:
+        """Scatter a global (z,y,x) interior array into the sharded
+        padded field (initial conditions)."""
+        assert tuple(values.shape) == zyx_shape(self.size)
+        dim = self.placement.dim()
+        local = self.local_size
+        pr = raw_size(local, self.radius)
+        lo = self.radius.pad_lo()
+        host = np.zeros(zyx_shape(pr * dim), dtype=self._dtypes[name])
+        for bz in range(dim.z):
+            for by in range(dim.y):
+                for bx in range(dim.x):
+                    host[bz * pr.z + lo.z: bz * pr.z + lo.z + local.z,
+                         by * pr.y + lo.y: by * pr.y + lo.y + local.y,
+                         bx * pr.x + lo.x: bx * pr.x + lo.x + local.x] = \
+                        values[bz * local.z:(bz + 1) * local.z,
+                               by * local.y:(by + 1) * local.y,
+                               bx * local.x:(bx + 1) * local.x]
+        sharding = NamedSharding(self.mesh, P("z", "y", "x"))
+        self.curr[name] = jax.device_put(jnp.asarray(host), sharding)
+
+    def write_paraview(self, prefix: str) -> None:
+        """CSV dumps, one file per subdomain, rows ``Z,Y,X,q0,...``
+        (reference: src/stencil.cu:1188-1264)."""
+        interiors = {q: self.interior_to_host(q) for q in self._names}
+        dim = self.placement.dim()
+        local = self.local_size
+        for i in range(self.num_subdomains()):
+            idx = self.placement.part.dimensionize(i)
+            org = self.placement.subdomain_origin(idx)
+            with open(f"{prefix}{i}.txt", "w") as f:
+                f.write("Z,Y,X," + ",".join(self._names) + "\n")
+                for lz in range(local.z):
+                    for ly in range(local.y):
+                        for lx in range(local.x):
+                            gz, gy, gx = org.z + lz, org.y + ly, org.x + lx
+                            vals = ",".join(
+                                repr(interiors[q][gz, gy, gx])
+                                for q in self._names)
+                            f.write(f"{gz},{gy},{gx},{vals}\n")

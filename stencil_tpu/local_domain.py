@@ -1,0 +1,254 @@
+"""LocalDomain: one subdomain's halo-padded, double-buffered fields.
+
+TPU-native re-implementation of the reference's LocalDomain
+(reference: include/stencil/local_domain.cuh:34-276,
+src/local_domain.cu:86-219). Geometry conventions are identical:
+
+* The *compute region* of a subdomain has size ``sz`` and global origin
+  ``origin``.
+* Each quantity is allocated halo-padded: the allocation ("raw") size is
+  ``sz + pad_lo + pad_hi`` where the padding on each face side equals
+  the face radius on that side (reference: local_domain.cuh raw_size()).
+* Fields are double-buffered (curr/next); ``swap()`` exchanges the
+  buffer tables (reference: src/local_domain.cu:67-84).
+
+Array layout: JAX arrays are indexed ``arr[z, y, x]`` — x contiguous,
+matching the reference's pitched layout where x is the fastest-varying
+dimension. ``Dim3``/geometry values remain (x, y, z) ordered; helpers
+convert at the array boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .geometry import Dim3, Dim3Like, Radius, Rect3, all_directions
+
+
+def zyx_shape(sz: Dim3Like) -> Tuple[int, int, int]:
+    """Convert an (x,y,z) Dim3 into a (z,y,x) array shape."""
+    sz = Dim3.of(sz)
+    return (sz.z, sz.y, sz.x)
+
+
+def halo_pos(dir: Dim3Like, sz: Dim3Like, radius: Radius, halo: bool) -> Dim3:
+    """Offset (in allocation coordinates, x/y/z order) of the halo region
+    on side ``dir``: the *halo* itself when ``halo`` is True, else the
+    interior ("exterior compute") region adjacent to that side.
+    ``dir == 0`` on an axis selects the whole interior span on that axis.
+    (reference: src/local_domain.cu:86-129 halo_pos)
+    """
+    dir = Dim3.of(dir)
+    sz = Dim3.of(sz)
+    out: List[int] = []
+    for axis in range(3):
+        d = dir[axis]
+        n = sz[axis]
+        r_lo = radius.face(axis, -1)
+        if d == 1:
+            out.append(n + (r_lo if halo else 0))
+        elif d == -1:
+            out.append(0 if halo else r_lo)
+        else:
+            out.append(r_lo)
+    return Dim3(*out)
+
+
+def halo_extent(dir: Dim3Like, sz: Dim3Like, radius: Radius) -> Dim3:
+    """Point-extent of the halo region on side ``dir``; components use
+    the *face* radii (reference: local_domain.cuh:212-222 halo_extent).
+    ``dir == (0,0,0)`` returns ``sz``.
+    """
+    dir = Dim3.of(dir)
+    sz = Dim3.of(sz)
+    out: List[int] = []
+    for axis in range(3):
+        d = dir[axis]
+        out.append(sz[axis] if d == 0 else radius.face(axis, d))
+    return Dim3(*out)
+
+
+def halo_bytes(dir: Dim3Like, sz: Dim3Like, radius: Radius, elem_size: int) -> int:
+    """Bytes of one quantity's halo region on side ``dir``
+    (reference: local_domain.cuh halo_bytes)."""
+    return elem_size * halo_extent(dir, sz, radius).flatten()
+
+
+def raw_size(sz: Dim3Like, radius: Radius) -> Dim3:
+    """Allocation size including halo padding
+    (reference: local_domain.cuh raw_size())."""
+    sz = Dim3.of(sz)
+    return sz + radius.pad_lo() + radius.pad_hi()
+
+
+class Accessor:
+    """Global-coordinate indexing into a padded local array — the
+    app-facing "friendly coordinates" feature
+    (reference: include/stencil/accessor.hpp:14-49).
+
+    ``acc[(x, y, z)]`` reads the element at *global* grid coordinate
+    (x, y, z) from the padded (z,y,x)-ordered array. The stored origin
+    is ``domain origin - pad_lo`` so halo cells are addressable too.
+    """
+
+    def __init__(self, arr, origin: Dim3Like, radius: Radius) -> None:
+        self.arr = arr
+        origin = Dim3.of(origin)
+        self.origin = origin - radius.pad_lo()
+
+    def __getitem__(self, p: Dim3Like):
+        p = Dim3.of(p) - self.origin
+        return self.arr[p.z, p.y, p.x]
+
+    def set(self, p: Dim3Like, v):
+        """Functional update; returns a new array."""
+        p = Dim3.of(p) - self.origin
+        return self.arr.at[p.z, p.y, p.x].set(v)
+
+
+class LocalDomain:
+    """One subdomain's quantities on one device: halo-padded,
+    double-buffered arrays plus halo-geometry queries
+    (reference: include/stencil/local_domain.cuh:34-276).
+
+    In JAX the buffers are immutable; ``curr``/``next_`` hold the
+    current bindings and ``swap()`` exchanges them (the analog of the
+    reference's pointer-table swap, src/local_domain.cu:67-84).
+    """
+
+    def __init__(self, sz: Dim3Like, origin: Dim3Like, radius: Radius) -> None:
+        self.sz = Dim3.of(sz)
+        self.origin = Dim3.of(origin)
+        self.radius = radius
+        self._names: List[str] = []
+        self._dtypes: Dict[str, np.dtype] = {}
+        self.curr: Dict[str, jnp.ndarray] = {}
+        self.next_: Dict[str, jnp.ndarray] = {}
+
+    # -- data management (reference: local_domain.cuh add_data) -------
+    def add_data(self, name: str, dtype=jnp.float32) -> None:
+        assert name not in self._dtypes, f"duplicate quantity {name}"
+        self._names.append(name)
+        self._dtypes[name] = np.dtype(dtype)
+
+    def num_data(self) -> int:
+        return len(self._names)
+
+    @property
+    def names(self) -> List[str]:
+        return list(self._names)
+
+    def elem_size(self, name: str) -> int:
+        return self._dtypes[name].itemsize
+
+    def realize(self) -> None:
+        """Allocate zeroed curr/next padded arrays for every quantity
+        (reference: src/local_domain.cu:159-219)."""
+        shape = zyx_shape(self.raw_size())
+        for name in self._names:
+            dt = self._dtypes[name]
+            self.curr[name] = jnp.zeros(shape, dtype=dt)
+            self.next_[name] = jnp.zeros(shape, dtype=dt)
+
+    def swap(self) -> None:
+        self.curr, self.next_ = self.next_, self.curr
+
+    # -- geometry -----------------------------------------------------
+    def raw_size(self) -> Dim3:
+        return raw_size(self.sz, self.radius)
+
+    def size(self) -> Dim3:
+        return self.sz
+
+    def halo_pos(self, dir: Dim3Like, halo: bool) -> Dim3:
+        return halo_pos(dir, self.sz, self.radius, halo)
+
+    def halo_extent(self, dir: Dim3Like) -> Dim3:
+        return halo_extent(dir, self.sz, self.radius)
+
+    def halo_bytes(self, dir: Dim3Like, name: str) -> int:
+        return halo_bytes(dir, self.sz, self.radius, self.elem_size(name))
+
+    def halo_coords(self, dir: Dim3Like, halo: bool) -> Rect3:
+        """Global coordinates of the halo (or interior-edge) region on
+        side ``dir`` (reference: src/local_domain.cu:39-58)."""
+        pos = self.halo_pos(dir, halo)
+        ext = self.halo_extent(dir)
+        pos = pos - self.radius.pad_lo() + self.origin
+        return Rect3(pos, pos + ext)
+
+    def get_compute_region(self) -> Rect3:
+        return Rect3(self.origin, self.origin + self.sz)
+
+    # -- accessors ----------------------------------------------------
+    def get_curr_accessor(self, name: str) -> Accessor:
+        return Accessor(self.curr[name], self.origin, self.radius)
+
+    def get_next_accessor(self, name: str) -> Accessor:
+        return Accessor(self.next_[name], self.origin, self.radius)
+
+    # -- host/debug copies (reference: src/local_domain.cu:131-157) ---
+    def interior_slices(self) -> Tuple[slice, slice, slice]:
+        """(z, y, x) slices selecting the compute interior of a padded
+        array."""
+        lo = self.radius.pad_lo()
+        return (slice(lo.z, lo.z + self.sz.z),
+                slice(lo.y, lo.y + self.sz.y),
+                slice(lo.x, lo.x + self.sz.x))
+
+    def interior_to_host(self, name: str) -> np.ndarray:
+        """Copy the compute region to host, (z,y,x) ordered."""
+        return np.asarray(self.curr[name][self.interior_slices()])
+
+    def quantity_to_host(self, name: str) -> np.ndarray:
+        """Copy the full padded region (including halos) to host."""
+        return np.asarray(self.curr[name])
+
+
+def interior_shrink(radius: Radius) -> Tuple[Dim3, Dim3]:
+    """How far the interior pulls in from the compute region on the
+    (lo, hi) side of each axis: the max radius over every direction
+    touching that side (reference: src/stencil.cu:874-921 get_interior).
+    """
+    lo = Dim3(radius.max_side(0, -1), radius.max_side(1, -1), radius.max_side(2, -1))
+    hi = Dim3(radius.max_side(0, 1), radius.max_side(1, 1), radius.max_side(2, 1))
+    return lo, hi
+
+
+def get_interior(dom: LocalDomain) -> Rect3:
+    """Interior region: points whose stencil reads never touch the halo
+    (reference: src/stencil.cu:874-921)."""
+    lo_s, hi_s = interior_shrink(dom.radius)
+    com = dom.get_compute_region()
+    lo = com.lo + lo_s
+    hi = com.hi - hi_s
+    return Rect3(lo.elementwise_min(hi), hi.elementwise_max(lo))
+
+
+def get_exterior(dom: LocalDomain) -> List[Rect3]:
+    """Non-overlapping face-slab decomposition of compute-region minus
+    interior, by sliding faces in (+x,+y,+z,-x,-y,-z order — reference:
+    src/stencil.cu:927-977)."""
+    int_reg = get_interior(dom)
+    com = dom.get_compute_region()
+    out: List[Rect3] = []
+    lo = [com.lo.x, com.lo.y, com.lo.z]
+    hi = [com.hi.x, com.hi.y, com.hi.z]
+    for axis in (0, 1, 2):  # +x, +y, +z
+        if int_reg.hi[axis] != hi[axis]:
+            r_lo = [lo[0], lo[1], lo[2]]
+            r_hi = [hi[0], hi[1], hi[2]]
+            r_lo[axis] = int_reg.hi[axis]
+            out.append(Rect3.of(tuple(r_lo), tuple(r_hi)))
+            hi[axis] = int_reg.hi[axis]
+    for axis in (0, 1, 2):  # -x, -y, -z
+        if int_reg.lo[axis] != lo[axis]:
+            r_lo = [lo[0], lo[1], lo[2]]
+            r_hi = [hi[0], hi[1], hi[2]]
+            r_hi[axis] = int_reg.lo[axis]
+            out.append(Rect3.of(tuple(r_lo), tuple(r_hi)))
+            lo[axis] = int_reg.lo[axis]
+    return out

@@ -1,0 +1,157 @@
+"""Jacobi-3D heat solver: the flagship demo application.
+
+TPU-native re-implementation of the reference's jacobi3d app
+(reference: bin/jacobi3d.cu): a 7-point Jacobi relaxation over a
+periodic global grid with a hot sphere (T=1) at x=1/3 and a cold sphere
+(T=0) at x=2/3, each of radius gx/10, re-imposed every iteration
+(bin/jacobi3d.cu:40-85); everything else initialized to the mean
+temperature 0.5 (bin/jacobi3d.cu:18-27).
+
+Design: unlike the reference's interior-launch / exchange / exterior-
+launch choreography (bin/jacobi3d.cu:296-377), the whole iteration —
+halo exchange + stencil + sources — is ONE ``shard_map``-ped XLA
+program; XLA schedules the ppermutes against the compute (async
+collectives are its overlap mechanism), and buffer donation makes the
+double-buffer swap an in-place update.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..distributed import DistributedDomain
+from ..geometry import Dim3, Dim3Like, Radius
+from ..local_domain import raw_size, zyx_shape
+from ..ops.stencil_kernels import global_coords, jacobi7, write_interior
+from ..parallel.exchange import (exchange_shard, exchange_shard_allgather,
+                                 exchange_shard_packed)
+from ..parallel.mesh import mesh_dim
+from ..parallel.methods import Method, pick_method
+
+HOT_TEMP = 1.0   # reference: bin/jacobi3d.cu:12
+COLD_TEMP = 0.0  # reference: bin/jacobi3d.cu:11
+
+
+class Jacobi3D:
+    """Distributed Jacobi-3D solver over a TPU mesh."""
+
+    def __init__(self, x: int, y: int, z: int,
+                 mesh_shape: Optional[Dim3Like] = None,
+                 dtype=jnp.float32,
+                 devices: Optional[Sequence] = None,
+                 methods: Method = Method.Default) -> None:
+        self.dd = DistributedDomain(x, y, z, devices=devices)
+        self.dd.set_radius(1)
+        self.dd.set_methods(methods)
+        if mesh_shape is not None:
+            self.dd.set_mesh_shape(mesh_shape)
+        self.dd.add_data("temp", dtype)
+        self.dd.realize()
+        self._dtype = dtype
+        self._build_step()
+
+    # -- initial conditions (reference: bin/jacobi3d.cu:18-27) ---------
+    def init(self) -> None:
+        mean = np.asarray((HOT_TEMP + COLD_TEMP) / 2, dtype=self._dtype)
+        vals = np.full(zyx_shape(self.dd.size), mean, dtype=self._dtype)
+        self.dd.set_interior("temp", vals)
+
+    # -- the fused step ------------------------------------------------
+    def _build_step(self) -> None:
+        dd = self.dd
+        radius = dd.radius
+        counts = mesh_dim(dd.mesh)
+        local = dd.local_size
+        gsize = dd.size
+        # sphere geometry (reference: bin/jacobi3d.cu:45-50)
+        hot_c = Dim3(gsize.x // 3, gsize.y // 2, gsize.z // 2)
+        cold_c = Dim3(gsize.x * 2 // 3, gsize.y // 2, gsize.z // 2)
+        sph_r = gsize.x // 10
+
+        method = pick_method(self.dd.methods)
+
+        def do_exchange(p):
+            if method == Method.PpermutePacked:
+                return exchange_shard_packed({"temp": p}, radius, counts)["temp"]
+            if method == Method.AllGather:
+                return exchange_shard_allgather(p, radius, counts)
+            return exchange_shard(p, radius, counts)
+
+        def shard_step(p):
+            p = do_exchange(p)
+            new = jacobi7(p, radius, local)
+            # global coords of this shard's interior
+            origin = (lax.axis_index("x") * local.x,
+                      lax.axis_index("y") * local.y,
+                      lax.axis_index("z") * local.z)
+            gz, gy, gx = global_coords(origin, local)
+
+            def dist2(c: Dim3):
+                return ((gx - c.x) ** 2 + (gy - c.y) ** 2 + (gz - c.z) ** 2)
+
+            new = jnp.where(dist2(hot_c) <= sph_r * sph_r,
+                            jnp.asarray(HOT_TEMP, new.dtype), new)
+            new = jnp.where(dist2(cold_c) <= sph_r * sph_r,
+                            jnp.asarray(COLD_TEMP, new.dtype), new)
+            return write_interior(p, new, radius)
+
+        spec = P("z", "y", "x")
+        sm = jax.shard_map(shard_step, mesh=dd.mesh, in_specs=spec,
+                           out_specs=spec, check_vma=False)
+        self._step = jax.jit(sm, donate_argnums=0)
+
+        def shard_steps(p, n):
+            return lax.fori_loop(0, n, lambda _, q: shard_step(q), p)
+
+        sm_n = jax.shard_map(functools.partial(shard_steps),
+                             mesh=dd.mesh, in_specs=(spec, P()),
+                             out_specs=spec, check_vma=False)
+        self._step_n = jax.jit(sm_n, donate_argnums=0,
+                               static_argnums=())
+
+    def step(self) -> None:
+        """One iteration: exchange + 7-point update + sources."""
+        self.dd.curr["temp"] = self._step(self.dd.curr["temp"])
+
+    def run(self, iters: int) -> None:
+        """``iters`` iterations in one XLA program (fori_loop — no
+        per-iteration dispatch)."""
+        self.dd.curr["temp"] = self._step_n(self.dd.curr["temp"],
+                                            jnp.asarray(iters, jnp.int32))
+
+    def block(self) -> None:
+        from ..utils.timers import device_sync
+        device_sync(self.dd.curr["temp"])
+
+    def temperature(self) -> np.ndarray:
+        """Global interior (z,y,x) on host."""
+        return self.dd.interior_to_host("temp")
+
+
+def dense_reference_step(temp: np.ndarray, hot_c: Tuple[int, int, int],
+                         cold_c: Tuple[int, int, int], sph_r: int
+                         ) -> np.ndarray:
+    """Single-device dense oracle of one jacobi step on a (z,y,x) global
+    array with periodic wrap — the correctness reference for the
+    distributed solver (BASELINE.md config 1)."""
+    out = np.zeros_like(temp)
+    for axis, dim in ((0, 0), (1, 1), (2, 2)):
+        out += np.roll(temp, 1, axis=axis) + np.roll(temp, -1, axis=axis)
+    out /= 6.0
+    gz, gy, gx = np.meshgrid(np.arange(temp.shape[0]),
+                             np.arange(temp.shape[1]),
+                             np.arange(temp.shape[2]), indexing="ij")
+    hx, hy, hz = hot_c
+    cx, cy, cz = cold_c
+    d2h = (gx - hx) ** 2 + (gy - hy) ** 2 + (gz - hz) ** 2
+    d2c = (gx - cx) ** 2 + (gy - cy) ** 2 + (gz - cz) ** 2
+    out = np.where(d2h <= sph_r * sph_r, HOT_TEMP, out)
+    out = np.where(d2c <= sph_r * sph_r, COLD_TEMP, out)
+    return out.astype(temp.dtype)

@@ -1,0 +1,87 @@
+"""Stencil compute kernels on padded (z,y,x) shards.
+
+XLA-native equivalents of the reference's application kernels
+(reference: bin/jacobi3d.cu:40-85 stencil_kernel). Each kernel takes a
+halo-padded shard and produces interior values; slicing-based neighbor
+access lowers to fused XLA ops (the VPU does the adds; no gather). A
+Pallas version of the hot kernels lives in ``pallas_stencil.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..geometry import Dim3, Radius
+
+
+def shifted(padded: jnp.ndarray, off: Tuple[int, int, int],
+            pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+    """Interior-shaped view of ``padded`` shifted by ``off`` (x,y,z
+    direction vector): element [k,j,i] = padded[k+oz, j+oy, i+ox] in
+    interior coordinates."""
+    ox, oy, oz = off
+    return lax.slice(
+        padded,
+        (pad_lo.z + oz, pad_lo.y + oy, pad_lo.x + ox),
+        (pad_lo.z + oz + interior.z, pad_lo.y + oy + interior.y,
+         pad_lo.x + ox + interior.x))
+
+
+def jacobi7(padded: jnp.ndarray, radius: Radius, interior: Dim3) -> jnp.ndarray:
+    """7-point Jacobi average: (sum of 6 face neighbors) / 6
+    (reference: bin/jacobi3d.cu:65-80)."""
+    lo = radius.pad_lo()
+    acc = shifted(padded, (1, 0, 0), lo, interior)
+    acc = acc + shifted(padded, (-1, 0, 0), lo, interior)
+    acc = acc + shifted(padded, (0, 1, 0), lo, interior)
+    acc = acc + shifted(padded, (0, -1, 0), lo, interior)
+    acc = acc + shifted(padded, (0, 0, 1), lo, interior)
+    acc = acc + shifted(padded, (0, 0, -1), lo, interior)
+    return acc * (1.0 / 6.0)
+
+
+def laplacian27(padded: jnp.ndarray, radius: Radius, interior: Dim3,
+                weights=None) -> jnp.ndarray:
+    """27-point weighted stencil (radius-1 box) — exercises edge/corner
+    halo data; default weights are the standard 27-point Laplacian."""
+    lo = radius.pad_lo()
+    if weights is None:
+        # face 6/26? use canonical 27-pt laplacian weights
+        w_center, w_face, w_edge, w_corner = -88.0 / 26.0, 6.0 / 26.0, 3.0 / 26.0, 2.0 / 26.0
+    else:
+        w_center, w_face, w_edge, w_corner = weights
+    out = w_center * shifted(padded, (0, 0, 0), lo, interior)
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            for dz in (-1, 0, 1):
+                n = (dx != 0) + (dy != 0) + (dz != 0)
+                if n == 0:
+                    continue
+                w = (w_face, w_edge, w_corner)[n - 1]
+                out = out + w * shifted(padded, (dx, dy, dz), lo, interior)
+    return out
+
+
+def write_interior(padded: jnp.ndarray, interior_vals: jnp.ndarray,
+                   radius: Radius) -> jnp.ndarray:
+    """Place interior-shaped values into a padded shard (halos keep
+    their previous contents)."""
+    lo = radius.pad_lo()
+    return lax.dynamic_update_slice(padded, interior_vals.astype(padded.dtype),
+                                    (lo.z, lo.y, lo.x))
+
+
+def global_coords(origin_xyz, interior: Dim3):
+    """(z, y, x) broadcastable global-coordinate arrays for a shard's
+    interior — the Accessor "friendly coordinates" analog for
+    masks/sources (reference: include/stencil/accessor.hpp:31-45).
+    ``origin_xyz`` is an (ox, oy, oz) triple; traced scalars are fine
+    (e.g. derived from ``lax.axis_index`` inside shard_map)."""
+    ox, oy, oz = origin_xyz
+    gz = oz + jnp.arange(interior.z)[:, None, None]
+    gy = oy + jnp.arange(interior.y)[None, :, None]
+    gx = ox + jnp.arange(interior.x)[None, None, :]
+    return gz, gy, gx

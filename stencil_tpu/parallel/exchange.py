@@ -1,0 +1,275 @@
+"""The halo-exchange engine: per-axis neighbor shifts over the mesh.
+
+This is the TPU-native replacement for the reference's entire transport
+stack (reference: include/stencil/tx_cuda.cuh, tx_colocated.cuh,
+tx_ipc.hpp, packer.cuh and the exchange orchestration in
+src/stencil.cu:1002-1186). Where the reference plans 26 point-to-point
+messages per subdomain and routes each over the fastest of 4 transports
+(same-GPU kernel / cudaMemcpyPeer / IPC / MPI), the TPU design performs
+**three sequential axis sweeps** of ``lax.ppermute`` shifts inside one
+``shard_map``-ped XLA program:
+
+* sweep x: exchange +-x face slabs spanning the full (y, z) allocation;
+* sweep y: slabs span full (x, z) — x halos are now valid, so xy edge
+  data propagates automatically;
+* sweep z: slabs span full (x, y) — fills all z faces, xz/yz edges and
+  corners.
+
+26 directions collapse into at most 6 shifts, and edge/corner data
+rides along for free (SURVEY.md section 7 step 3). Per-direction radii
+are honored: the slab widths on each side of axis ``a`` are the *face*
+radii (allocation geometry, reference local_domain.cuh raw_size), which
+is exactly what the reference's messages carry (halo_extent uses face
+radii — local_domain.cuh:212-222); zero-radius sides skip the shift.
+
+Everything here operates on one shard's padded (z,y,x)-ordered block
+and must run inside ``shard_map`` (or on a 1-device axis, where the
+periodic neighbor is the shard itself and the shift degenerates to a
+local slab copy — the analog of the reference's same-GPU
+PeerAccessSender, tx_cuda.cuh:41-113).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax import lax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..geometry import Dim3, Radius
+from .methods import Method, pick_method
+
+# grid axis index -> array dimension of a (z,y,x)-ordered field
+AXIS_TO_DIM = {0: 2, 1: 1, 2: 0}
+AXIS_NAME = {0: "x", 1: "y", 2: "z"}
+
+
+def _axis_size(axis_name: str) -> int:
+    """Size of a mesh axis from inside shard_map."""
+    return lax.axis_size(axis_name)
+
+
+def _shift_from_plus(block, axis_name: str, n: int):
+    """Bring data from the +axis neighbor (periodic): device i receives
+    from device i+1."""
+    if n == 1:
+        return block
+    return lax.ppermute(block, axis_name, [((i + 1) % n, i) for i in range(n)])
+
+
+def _shift_from_minus(block, axis_name: str, n: int):
+    """Bring data from the -axis neighbor (periodic): device i receives
+    from device i-1."""
+    if n == 1:
+        return block
+    return lax.ppermute(block, axis_name, [(i, (i + 1) % n) for i in range(n)])
+
+
+def exchange_shard(arr: jnp.ndarray, radius: Radius,
+                   mesh_counts: Dim3,
+                   axis_order: Tuple[int, ...] = (0, 1, 2)) -> jnp.ndarray:
+    """Fill all halo regions of one padded shard via sequential axis
+    sweeps. Must be traced inside ``shard_map`` over mesh axes
+    ('x','y','z') when the corresponding mesh_counts entry is > 1.
+
+    ``arr``: padded (z,y,x) block; interior extent along grid axis a is
+    ``arr.shape[AXIS_TO_DIM[a]] - r_lo - r_hi``.
+    ``mesh_counts``: subdomain count along each grid axis.
+    """
+    for a in axis_order:
+        r_lo = radius.face(a, -1)
+        r_hi = radius.face(a, 1)
+        if r_lo == 0 and r_hi == 0:
+            continue
+        dim = AXIS_TO_DIM[a]
+        name = AXIS_NAME[a]
+        n_dev = mesh_counts[a]
+        alloc = arr.shape[dim]
+        interior = alloc - r_lo - r_hi
+
+        # fill the hi-side halo [r_lo+interior, alloc): data lives at the
+        # +a neighbor's interior lo edge [r_lo, r_lo + r_hi)
+        if r_hi > 0:
+            src = lax.slice_in_dim(arr, r_lo, r_lo + r_hi, axis=dim)
+            recv = _shift_from_plus(src, name, n_dev)
+            arr = lax.dynamic_update_slice_in_dim(arr, recv, r_lo + interior,
+                                                  axis=dim)
+        # fill the lo-side halo [0, r_lo): data lives at the -a
+        # neighbor's interior hi edge [r_lo+interior-r_lo, r_lo+interior)
+        if r_lo > 0:
+            src = lax.slice_in_dim(arr, r_lo + interior - r_lo,
+                                   r_lo + interior, axis=dim)
+            recv = _shift_from_minus(src, name, n_dev)
+            arr = lax.dynamic_update_slice_in_dim(arr, recv, 0, axis=dim)
+    return arr
+
+
+def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
+                          mesh_counts: Dim3,
+                          axis_order: Tuple[int, ...] = (0, 1, 2)
+                          ) -> Dict[str, jnp.ndarray]:
+    """Multi-quantity exchange with per-direction packing: all
+    quantities' slabs for one axis-direction are flattened and
+    concatenated into a single buffer, moved with ONE ppermute, then
+    unpacked — the analog of DevicePacker/DeviceUnpacker packing all
+    quantities per message (reference: src/packer.cu:10-44, 69-82).
+
+    All quantities are bitcast to a common byte layout via flattening in
+    float32/raw dtype groups; quantities of differing dtypes are packed
+    in separate groups (alignment rule analog, src/packer.cu:76-82).
+    """
+    names = sorted(arrs.keys())  # sorted so both endpoints agree on
+    # layout (reference sorts messages by size, src/packer.cu:69,182-183)
+    out = {k: v for k, v in arrs.items()}
+    for a in axis_order:
+        r_lo = radius.face(a, -1)
+        r_hi = radius.face(a, 1)
+        if r_lo == 0 and r_hi == 0:
+            continue
+        dim = AXIS_TO_DIM[a]
+        name = AXIS_NAME[a]
+        n_dev = mesh_counts[a]
+
+        for side, r_fill in ((1, r_hi), (-1, r_lo)):
+            if r_fill == 0:
+                continue
+            # group quantities by dtype so concatenation is well-typed
+            groups: Dict[np.dtype, List[str]] = {}
+            for q in names:
+                groups.setdefault(out[q].dtype, []).append(q)
+            for dt, qs in groups.items():
+                slabs = []
+                shapes = []
+                for q in qs:
+                    arr = out[q]
+                    alloc = arr.shape[dim]
+                    interior = alloc - r_lo - r_hi
+                    if side == 1:
+                        src = lax.slice_in_dim(arr, r_lo, r_lo + r_hi, axis=dim)
+                    else:
+                        src = lax.slice_in_dim(arr, interior, r_lo + interior,
+                                               axis=dim)
+                    shapes.append(src.shape)
+                    slabs.append(src.reshape(-1))
+                packed = jnp.concatenate(slabs) if len(slabs) > 1 else slabs[0]
+                moved = (_shift_from_plus(packed, name, n_dev) if side == 1
+                         else _shift_from_minus(packed, name, n_dev))
+                # unpack
+                off = 0
+                for q, shp in zip(qs, shapes):
+                    cnt = int(np.prod(shp))
+                    recv = lax.dynamic_slice_in_dim(moved, off, cnt, axis=0
+                                                    ).reshape(shp)
+                    off += cnt
+                    arr = out[q]
+                    alloc = arr.shape[dim]
+                    interior = alloc - r_lo - r_hi
+                    start = (r_lo + interior) if side == 1 else 0
+                    out[q] = lax.dynamic_update_slice_in_dim(arr, recv, start,
+                                                             axis=dim)
+    return out
+
+
+def exchange_shard_allgather(arr: jnp.ndarray, radius: Radius,
+                             mesh_counts: Dim3,
+                             axis_order: Tuple[int, ...] = (0, 1, 2)
+                             ) -> jnp.ndarray:
+    """Control strategy: per axis, all_gather the boundary slabs and
+    slice out the two needed neighbors. Strictly more bytes on the wire
+    than ppermute — exists for method A/B sweeps like the reference's
+    bench_alltoallv (bin/bench_alltoallv.cu)."""
+    for a in axis_order:
+        r_lo = radius.face(a, -1)
+        r_hi = radius.face(a, 1)
+        if r_lo == 0 and r_hi == 0:
+            continue
+        dim = AXIS_TO_DIM[a]
+        name = AXIS_NAME[a]
+        n_dev = mesh_counts[a]
+        alloc = arr.shape[dim]
+        interior = alloc - r_lo - r_hi
+        if n_dev == 1:
+            arr = exchange_shard(arr, _single_axis_radius(radius, a), mesh_counts,
+                                 axis_order=(a,))
+            continue
+        idx = lax.axis_index(name)
+        if r_hi > 0:
+            src = lax.slice_in_dim(arr, r_lo, r_lo + r_hi, axis=dim)
+            gath = lax.all_gather(src, name, axis=0)  # (n_dev, ...slab)
+            recv = gath[(idx + 1) % n_dev]
+            arr = lax.dynamic_update_slice_in_dim(arr, recv, r_lo + interior,
+                                                  axis=dim)
+        if r_lo > 0:
+            src = lax.slice_in_dim(arr, interior, r_lo + interior, axis=dim)
+            gath = lax.all_gather(src, name, axis=0)
+            recv = gath[(idx - 1) % n_dev]
+            arr = lax.dynamic_update_slice_in_dim(arr, recv, 0, axis=dim)
+    return arr
+
+
+def _single_axis_radius(radius: Radius, axis: int) -> Radius:
+    r = Radius.constant(0)
+    for side in (-1, 1):
+        d = [0, 0, 0]
+        d[axis] = side
+        r.set_dir(tuple(d), radius.face(axis, side))
+    return r
+
+
+def make_exchange(mesh: Mesh, radius: Radius,
+                  methods: Method = Method.Default,
+                  axis_order: Tuple[int, ...] = (0, 1, 2)):
+    """Build a jitted multi-quantity halo exchange over ``mesh``.
+
+    Returns ``exchange(fields: dict[str, Array]) -> dict[str, Array]``
+    where each field is a *global* padded (z,y,x) array sharded
+    ``P('z','y','x')``. The orchestrator analog of
+    DistributedDomain::exchange() (reference: src/stencil.cu:1002-1186)
+    — except the whole dance (pack, send, poll, unpack, sync) is one
+    XLA program.
+    """
+    method = pick_method(methods)
+    counts = Dim3(mesh.shape["x"], mesh.shape["y"], mesh.shape["z"])
+    spec = P("z", "y", "x")
+
+    def shard_fn(fields: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        if method == Method.PpermutePacked:
+            return exchange_shard_packed(fields, radius, counts, axis_order)
+        if method == Method.AllGather:
+            return {k: exchange_shard_allgather(v, radius, counts, axis_order)
+                    for k, v in fields.items()}
+        return {k: exchange_shard(v, radius, counts, axis_order)
+                for k, v in fields.items()}
+
+    sm = jax.shard_map(shard_fn, mesh=mesh,
+                       in_specs=spec, out_specs=spec, check_vma=False)
+    return jax.jit(sm)
+
+
+def exchanged_bytes_per_sweep(shard_padded_shape_zyx: Sequence[int],
+                              radius: Radius, mesh_counts: Dim3,
+                              elem_size: int,
+                              axis_order: Tuple[int, ...] = (0, 1, 2)
+                              ) -> Dict[str, int]:
+    """Per-axis bytes one shard puts on the wire per exchange — the
+    byte-counter observability analog (reference: stencil.hpp:86-93,
+    src/stencil.cu:516-637). Counts only shifts that cross devices
+    (n_dev > 1); same-device wraps are local copies."""
+    out = {"x": 0, "y": 0, "z": 0}
+    shape = list(shard_padded_shape_zyx)
+    for a in axis_order:
+        r_lo = radius.face(a, -1)
+        r_hi = radius.face(a, 1)
+        dim = AXIS_TO_DIM[a]
+        if mesh_counts[a] <= 1:
+            continue
+        other = 1
+        for d in range(3):
+            if d != dim:
+                other *= shape[d]
+        out[AXIS_NAME[a]] = (r_lo + r_hi) * other * elem_size
+    return out

@@ -1,0 +1,51 @@
+"""Exchange-strategy flags.
+
+The analog of the reference's Method bitflags
+(reference: include/stencil/method.hpp:5-16), which select per-pair
+transports (CudaMpi, ColoPackMemcpyUnpack, CudaMemcpyPeer, CudaKernel,
+...). On TPU there is no rank/IPC/MPI distinction — XLA SPMD owns the
+wire — so the strategies select *how the halo data rides the ICI*:
+
+* ``PpermuteSlab``  — one ``lax.ppermute`` per axis-direction per
+  quantity (the default; XLA may combine collectives).
+* ``PpermutePacked`` — all quantities packed into one buffer per
+  axis-direction, one ``ppermute`` each (the DevicePacker analog,
+  reference: src/packer.cu:10-44).
+* ``PallasDMA``     — Pallas ``make_async_remote_copy`` ring DMA
+  (the manual-transport analog; enables true comm/compute overlap).
+* ``AllGather``     — per-axis ``all_gather`` then slice (control
+  strategy for benchmarking, like the reference's method sweeps).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Method(enum.Flag):
+    """Bitmask of allowed exchange strategies
+    (reference: include/stencil/method.hpp:5-16 to_string at :31-74)."""
+
+    NONE = 0
+    PpermuteSlab = 1
+    PpermutePacked = 2
+    PallasDMA = 4
+    AllGather = 8
+    Default = PpermuteSlab
+
+    def __str__(self) -> str:  # reference: method.hpp to_string
+        names = ["PpermuteSlab", "PpermutePacked", "PallasDMA", "AllGather"]
+        parts = [n for n in names if Method[n] in self]
+        return "|".join(parts) if parts else "none"
+
+
+def pick_method(methods: "Method") -> "Method":
+    """Choose the single strategy the exchange will use this run, by
+    priority (the analog of the reference's per-pair transport routing,
+    src/stencil.cu:371-458 — on TPU every pair rides the same ICI, so
+    one strategy is picked globally)."""
+    for m in (Method.PallasDMA, Method.PpermutePacked, Method.PpermuteSlab,
+              Method.AllGather):
+        if m in methods:
+            return m
+    raise ValueError(f"no usable method in {methods}")

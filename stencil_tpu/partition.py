@@ -64,6 +64,19 @@ class RankPartition:
         self._size = sz
         self._rem = size % dim
 
+    @classmethod
+    def from_dim(cls, size: Dim3Like, dim: Dim3Like) -> "RankPartition":
+        """Partition with an explicitly chosen subdomain grid ``dim``
+        (used when the mesh shape is fixed by the device topology)."""
+        size = Dim3.of(size)
+        dim = Dim3.of(dim)
+        p = cls(size, 1)
+        p._dim = dim
+        p._size = Dim3(div_ceil(size.x, dim.x), div_ceil(size.y, dim.y),
+                       div_ceil(size.z, dim.z))
+        p._rem = size % dim
+        return p
+
     def dim(self) -> Dim3:
         """Number of subdomains along each axis."""
         return self._dim

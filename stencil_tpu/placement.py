@@ -1,0 +1,176 @@
+"""Placement: mapping subdomain indices onto physical devices.
+
+TPU-native re-implementation of the reference's placement layer
+(reference: include/stencil/partition.hpp:258-831,
+placement_intranoderandom.hpp): a bijection between subdomain index
+(Dim3) and a device, chosen to put heavy halo traffic on fast links.
+
+On a TPU slice the ICI fabric is a torus and ``device.coords`` exposes
+the physical coordinates, so the NodeAware strategy reduces to sorting
+devices by torus coordinates — nearest-neighbor mesh shifts become
+single-hop by construction. The QAP machinery (reference:
+partition.hpp:694-760) is retained for irregular device sets (e.g.
+multi-host DCN pods or virtual meshes): it builds the subdomain-pair
+communication-bytes matrix (periodic-aware halo bytes) and a device-pair
+distance matrix (torus hop count), then solves the quadratic assignment.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import qap
+from .geometry import Dim3, Dim3Like, Radius, all_directions
+from .local_domain import halo_bytes
+from .partition import RankPartition
+from .topology import Topology
+
+
+class PlacementStrategy(enum.Enum):
+    """reference: include/stencil/partition.hpp:258-262."""
+
+    NodeAware = "node-aware"
+    Trivial = "trivial"
+    IntraNodeRandom = "random"
+
+
+def comm_bytes_matrix(part: RankPartition, radius: Radius,
+                      elem_sizes: Sequence[int]) -> np.ndarray:
+    """Subdomain-pair halo-communication bytes (periodic-aware), the
+    "w" matrix of the QAP (reference: partition.hpp:722-752).
+
+    entry [i, j] = bytes subdomain i sends subdomain j per exchange,
+    summed over all quantities and all directions that map i -> j.
+    """
+    dim = part.dim()
+    n = dim.flatten()
+    topo = Topology(dim)
+    w = np.zeros((n, n), dtype=np.float64)
+    for i in range(n):
+        idx = part.dimensionize(i)
+        for d in all_directions():
+            if radius.dir(-d) == 0:
+                # no send needed in d when the opposite radius is zero
+                # (reference: src/stencil.cu:344)
+                continue
+            nbr = topo.get_neighbor(idx, d)
+            if not nbr.exists:
+                continue
+            j = part.linearize(nbr.index)
+            if i == j:
+                continue  # same-device wrap is local
+            dst_size = part.subdomain_size(nbr.index)
+            for es in elem_sizes:
+                w[i, j] += halo_bytes(-d, dst_size, radius, es)
+    return w
+
+
+def torus_distance_matrix(devices: Sequence) -> np.ndarray:
+    """Device-pair distance: ICI torus hop count (L1 over coords) when
+    coords are exposed, else uniform distance 1 — the gpu_topo bandwidth
+    analog (reference: src/gpu_topology.cpp:17-95, bandwidth=1/distance)."""
+    n = len(devices)
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None or len(c) < 3:
+            coords = None
+            break
+        coords.append(tuple(c))
+    dist = np.ones((n, n), dtype=np.float64)
+    np.fill_diagonal(dist, 0.0)
+    if coords is None:
+        return dist
+    for i in range(n):
+        for j in range(n):
+            if i != j:
+                dist[i, j] = sum(abs(a - b) for a, b in zip(coords[i], coords[j]))
+    return dist
+
+
+class Placement:
+    """Bijection subdomain-index <-> device slot
+    (reference: partition.hpp:264-289 abstract Placement).
+
+    ``order`` holds device objects; subdomain with linear id ``i``
+    (x-fastest, via ``part.linearize``) runs on ``order[assignment[i]]``.
+    """
+
+    def __init__(self, part: RankPartition, devices: Sequence,
+                 assignment: Optional[List[int]] = None) -> None:
+        self.part = part
+        self.devices = list(devices)
+        n = part.dim().flatten()
+        assert len(self.devices) == n, (len(self.devices), n)
+        self.assignment = assignment or list(range(n))
+
+    def dim(self) -> Dim3:
+        return self.part.dim()
+
+    def get_device(self, idx: Dim3Like):
+        """Device hosting subdomain ``idx`` (the get_cuda analog)."""
+        i = self.part.linearize(Dim3.of(idx))
+        return self.devices[self.assignment[i]]
+
+    def get_idx(self, device) -> Dim3:
+        """Subdomain index hosted by ``device`` (the get_idx analog)."""
+        slot = self.devices.index(device)
+        i = self.assignment.index(slot)
+        return self.part.dimensionize(i)
+
+    def subdomain_size(self, idx: Dim3Like) -> Dim3:
+        return self.part.subdomain_size(Dim3.of(idx))
+
+    def subdomain_origin(self, idx: Dim3Like) -> Dim3:
+        return self.part.subdomain_origin(Dim3.of(idx))
+
+    def device_order_for_mesh(self) -> List:
+        """Devices ordered by subdomain linear index (x fastest) — feed
+        to ``mesh.make_mesh``."""
+        return [self.devices[self.assignment[i]]
+                for i in range(len(self.devices))]
+
+
+# single source of truth for device ordering lives in parallel.mesh so
+# the placement layer and the mesh provably agree
+from .parallel.mesh import _torus_sorted as _torus_sorted_devices
+
+
+def make_placement(strategy: PlacementStrategy, part: RankPartition,
+                   devices: Sequence, radius: Radius,
+                   elem_sizes: Sequence[int], seed: int = 0,
+                   qap_timeout_s: float = 2.0) -> Placement:
+    """Construct a placement (reference: src/stencil.cu:201-239
+    do_placement dispatch).
+
+    * Trivial: subdomain i -> device i in enumeration order
+      (reference: partition.hpp:291-445).
+    * NodeAware: torus-sort devices, then QAP-refine the assignment with
+      the halo-bytes x hop-distance objective when the device count is
+      small enough for the hill climb (reference: partition.hpp:525-831).
+    * IntraNodeRandom: seeded shuffle, the experimental control
+      (reference: src/placement_intranoderandom.cpp:117-125).
+    """
+    n = part.dim().flatten()
+    if strategy == PlacementStrategy.Trivial:
+        return Placement(part, list(devices))
+    if strategy == PlacementStrategy.IntraNodeRandom:
+        rng = np.random.default_rng(seed)
+        assignment = list(rng.permutation(n))
+        return Placement(part, list(devices), [int(a) for a in assignment])
+    # NodeAware
+    devs = _torus_sorted_devices(devices)
+    dist = torus_distance_matrix(devs)
+    offdiag = dist[~np.eye(n, dtype=bool)]
+    if n <= 1 or np.all(offdiag == offdiag[0]):
+        # uniform fabric: torus sort is already optimal
+        return Placement(part, devs)
+    w = comm_bytes_matrix(part, radius, elem_sizes)
+    if n <= 8:
+        f, _ = qap.solve(w, dist, timeout_s=qap_timeout_s)
+    else:
+        f, _ = qap.solve_catch(w, dist)
+    return Placement(part, devs, [int(i) for i in f])

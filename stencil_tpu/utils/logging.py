@@ -1,0 +1,64 @@
+"""Leveled stderr logging with process/device prefix.
+
+The analog of the reference's compile-time-leveled macros
+(reference: include/stencil/logging.hpp:12-53): level selected by the
+``STENCIL_TPU_LOG`` env var (spew|debug|info|warn|error|fatal, default
+info); messages are prefixed with the jax process index the way the
+reference prefixes the MPI rank. LOG_FATAL raises instead of exit(1) —
+fail-fast, but catchable.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_LEVELS = {"spew": 0, "debug": 1, "info": 2, "warn": 3, "error": 4, "fatal": 5}
+_level = _LEVELS.get(os.environ.get("STENCIL_TPU_LOG", "info").lower(), 2)
+
+
+def _rank() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _emit(tag: str, lvl: int, msg: str) -> None:
+    if lvl >= _level:
+        print(f"[{_rank()}] {tag}: {msg}", file=sys.stderr)
+
+
+def LOG_SPEW(msg: str) -> None:
+    _emit("SPEW", 0, msg)
+
+
+def LOG_DEBUG(msg: str) -> None:
+    _emit("DEBUG", 1, msg)
+
+
+def LOG_INFO(msg: str) -> None:
+    _emit("INFO", 2, msg)
+
+
+def LOG_WARN(msg: str) -> None:
+    _emit("WARN", 3, msg)
+
+
+def LOG_ERROR(msg: str) -> None:
+    _emit("ERROR", 4, msg)
+
+
+class FatalError(RuntimeError):
+    pass
+
+
+def LOG_FATAL(msg: str) -> None:
+    _emit("FATAL", 5, msg)
+    raise FatalError(msg)
+
+
+def set_level(name: str) -> None:
+    global _level
+    _level = _LEVELS[name.lower()]

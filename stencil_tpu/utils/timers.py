@@ -1,0 +1,64 @@
+"""Wall-clock timing utilities.
+
+The analog of the reference's Timer/rt wrappers (reference:
+include/stencil/timer.hpp:21-39, rt.hpp:9-37) adapted to async XLA
+dispatch: on some platforms (notably the axon TPU tunnel used in this
+environment) ``jax.block_until_ready`` does not actually drain the
+execution pipeline, so ``device_sync`` forces a one-element
+device-to-host transfer instead — the only reliable fence.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List
+
+import jax
+import numpy as np
+
+
+def device_sync(tree: Any) -> None:
+    """Force completion of all computations producing ``tree``'s leaves
+    by fetching one element of each to host (transfer is the only
+    reliable fence on the axon tunnel platform)."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "addressable_shards"):
+            for s in leaf.addressable_shards:
+                np.asarray(s.data.ravel()[:1])
+        elif hasattr(leaf, "__array__"):
+            np.asarray(leaf).ravel()[:1]
+
+
+class Timer:
+    """Accumulating wall timer (reference: timer.hpp:21-39)."""
+
+    def __init__(self) -> None:
+        self.seconds = 0.0
+        self._t0 = 0.0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> float:
+        dt = time.perf_counter() - self._t0
+        self.seconds += dt
+        return dt
+
+
+def time_fn(fn: Callable, *args, sync: Any = None, **kw) -> float:
+    """Time one call including device completion (the rt::time analog,
+    reference: rt.hpp:9-22): argument evaluation is excluded, the
+    returned value (or ``sync``) is fetched to fence."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kw)
+    device_sync(out if sync is None else sync)
+    return time.perf_counter() - t0
+
+
+# global accumulators, the timers::cudaRuntime / timers::mpi analog
+# (reference: src/timer.cpp:13-16)
+timers: Dict[str, Timer] = {}
+
+
+def get_timer(name: str) -> Timer:
+    return timers.setdefault(name, Timer())

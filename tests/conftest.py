@@ -3,16 +3,22 @@
 Mirrors the reference's strategy of simulating multi-GPU / multi-node
 without a cluster (SURVEY.md section 4): the reference oversubscribes one
 GPU (test/test_exchange.cu:52 `dd.set_gpus({0,0})`); we fake an 8-device
-mesh on CPU via XLA_FLAGS. Must run before jax is imported — a
-sitecustomize in this image forces JAX_PLATFORMS=axon, so we override it
-here rather than in the shell environment.
+mesh on CPU via XLA_FLAGS.
+
+Note: a sitecustomize in this image imports jax at interpreter startup
+with JAX_PLATFORMS=axon, so env vars are too late here — but the XLA
+backend initializes lazily, so `jax.config.update` still takes effect as
+long as no test module touched a device yet.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

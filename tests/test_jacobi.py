@@ -1,0 +1,66 @@
+"""Jacobi3D distributed solver vs dense single-device oracle
+(the numerical-parity strategy from SURVEY.md section 4)."""
+
+import numpy as np
+import pytest
+
+from stencil_tpu.geometry import Dim3
+from stencil_tpu.models.jacobi import (Jacobi3D, dense_reference_step,
+                                       HOT_TEMP, COLD_TEMP)
+from stencil_tpu.parallel.methods import Method
+
+
+def run_dense(size: Dim3, iters: int) -> np.ndarray:
+    temp = np.full((size.z, size.y, size.x), (HOT_TEMP + COLD_TEMP) / 2,
+                   dtype=np.float64)
+    hot_c = (size.x // 3, size.y // 2, size.z // 2)
+    cold_c = (size.x * 2 // 3, size.y // 2, size.z // 2)
+    sph_r = size.x // 10
+    for _ in range(iters):
+        temp = dense_reference_step(temp, hot_c, cold_c, sph_r)
+    return temp
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 2, 2), (8, 1, 1), (1, 2, 4)])
+def test_jacobi_matches_dense(mesh_shape):
+    size = Dim3(16, 16, 16)
+    j = Jacobi3D(size.x, size.y, size.z, mesh_shape=mesh_shape,
+                 dtype=np.float64)
+    j.init()
+    for _ in range(5):
+        j.step()
+    want = run_dense(size, 5)
+    got = j.temperature()
+    np.testing.assert_allclose(got, want, rtol=0, atol=1e-13)
+
+
+def test_jacobi_run_fused_loop():
+    size = Dim3(16, 16, 16)
+    j = Jacobi3D(size.x, size.y, size.z, mesh_shape=(2, 2, 2),
+                 dtype=np.float64)
+    j.init()
+    j.run(5)
+    want = run_dense(size, 5)
+    np.testing.assert_allclose(j.temperature(), want, rtol=0, atol=1e-13)
+
+
+def test_jacobi_packed_method():
+    size = Dim3(16, 16, 16)
+    j = Jacobi3D(size.x, size.y, size.z, mesh_shape=(2, 2, 2),
+                 dtype=np.float64, methods=Method.PpermutePacked)
+    j.init()
+    for _ in range(3):
+        j.step()
+    np.testing.assert_allclose(j.temperature(), run_dense(size, 3),
+                               rtol=0, atol=1e-13)
+
+
+def test_jacobi_single_device():
+    size = Dim3(12, 12, 12)
+    import jax
+    j = Jacobi3D(size.x, size.y, size.z, mesh_shape=(1, 1, 1),
+                 dtype=np.float64, devices=jax.devices()[:1])
+    j.init()
+    j.run(4)
+    np.testing.assert_allclose(j.temperature(), run_dense(size, 4),
+                               rtol=0, atol=1e-13)

@@ -1,0 +1,144 @@
+"""Halo-geometry tests mirroring reference test/test_cuda_local_domain.cu
+pinned cases (symmetric and asymmetric radius, face/edge/corner)."""
+
+import numpy as np
+
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.local_domain import (LocalDomain, get_exterior, get_interior,
+                                      halo_extent, halo_pos, raw_size)
+
+
+class TestHaloGeometry:
+    def test_raw_size_symmetric(self):
+        r = Radius.constant(2)
+        assert raw_size((10, 10, 10), r) == Dim3(14, 14, 14)
+
+    def test_raw_size_asymmetric(self):
+        # uncentered kernel: +x radius 3 only
+        r = Radius.constant(0)
+        r.set_dir((1, 0, 0), 3)
+        assert raw_size((10, 10, 10), r) == Dim3(13, 10, 10)
+
+    def test_halo_pos_symmetric(self):
+        # reference: src/local_domain.cu:86-125 halo_pos
+        sz = Dim3(10, 10, 10)
+        r = Radius.constant(2)
+        # +x halo begins past lo pad + interior
+        assert halo_pos((1, 0, 0), sz, r, halo=True) == Dim3(12, 2, 2)
+        # +x interior-edge region (exterior compute) begins at sz.x offset
+        assert halo_pos((1, 0, 0), sz, r, halo=False) == Dim3(10, 2, 2)
+        assert halo_pos((-1, 0, 0), sz, r, halo=True) == Dim3(0, 2, 2)
+        assert halo_pos((-1, 0, 0), sz, r, halo=False) == Dim3(2, 2, 2)
+        assert halo_pos((0, 0, 0), sz, r, halo=True) == Dim3(2, 2, 2)
+
+    def test_halo_extent_uses_face_radii(self):
+        # reference: local_domain.cuh:212-222 — edge/corner extents are
+        # built from face radii, not the edge/corner radius values
+        sz = Dim3(10, 12, 14)
+        r = Radius.face_edge_corner(2, 1, 1)
+        assert halo_extent((1, 0, 0), sz, r) == Dim3(2, 12, 14)
+        assert halo_extent((1, 1, 0), sz, r) == Dim3(2, 2, 14)
+        assert halo_extent((1, 1, 1), sz, r) == Dim3(2, 2, 2)
+        assert halo_extent((0, 0, 0), sz, r) == sz
+
+    def test_halo_extent_asymmetric(self):
+        sz = Dim3(10, 10, 10)
+        r = Radius.constant(0)
+        r.set_dir((1, 0, 0), 3)
+        assert halo_extent((1, 0, 0), sz, r) == Dim3(3, 10, 10)
+        assert halo_extent((-1, 0, 0), sz, r) == Dim3(0, 10, 10)
+
+
+class TestLocalDomain:
+    def _make(self, sz=(8, 8, 8), r=None):
+        dom = LocalDomain(sz, (0, 0, 0), r or Radius.constant(1))
+        dom.add_data("q0", np.float32)
+        dom.add_data("q1", np.float64)
+        dom.realize()
+        return dom
+
+    def test_realize_shapes(self):
+        dom = self._make()
+        assert dom.curr["q0"].shape == (10, 10, 10)
+        assert dom.curr["q1"].dtype == np.float64
+        assert dom.num_data() == 2
+        assert dom.elem_size("q0") == 4
+        assert dom.elem_size("q1") == 8
+
+    def test_swap(self):
+        dom = self._make()
+        dom.curr["q0"] = dom.curr["q0"] + 1.0
+        dom.swap()
+        assert float(dom.curr["q0"][0, 0, 0]) == 0.0
+        assert float(dom.next_["q0"][0, 0, 0]) == 1.0
+
+    def test_halo_bytes(self):
+        dom = self._make()
+        # radius-1 +x face: 1*8*8 points
+        assert dom.halo_bytes((1, 0, 0), "q0") == 4 * 1 * 8 * 8
+        assert dom.halo_bytes((1, 0, 0), "q1") == 8 * 1 * 8 * 8
+
+    def test_accessor_global_coords(self):
+        dom = LocalDomain((4, 4, 4), (10, 20, 30), Radius.constant(1))
+        dom.add_data("q", np.float32)
+        dom.realize()
+        dom.curr["q"] = dom.curr["q"].at[1 + 2, 1 + 1, 1 + 3].set(7.0)
+        acc = dom.get_curr_accessor("q")
+        # global coord = origin + local interior offset (x=3,y=1,z=2)
+        assert float(acc[(13, 21, 32)]) == 7.0
+        # halo cells are addressable (origin shifted by pad_lo)
+        assert float(acc[(9, 19, 29)]) == 0.0
+
+    def test_halo_coords(self):
+        dom = LocalDomain((4, 4, 4), (10, 20, 30), Radius.constant(1))
+        rect = dom.halo_coords((1, 0, 0), halo=True)
+        assert rect.lo == Dim3(14, 20, 30)
+        assert rect.extent() == Dim3(1, 4, 4)
+        rect = dom.halo_coords((-1, 0, 0), halo=False)
+        assert rect.lo == Dim3(10, 20, 30)
+        assert rect.extent() == Dim3(1, 4, 4)
+
+
+class TestInteriorExterior:
+    def test_interior_symmetric(self):
+        # reference: src/stencil.cu:874-921
+        dom = LocalDomain((10, 10, 10), (0, 0, 0), Radius.constant(2))
+        inter = get_interior(dom)
+        assert inter.lo == Dim3(2, 2, 2)
+        assert inter.hi == Dim3(8, 8, 8)
+
+    def test_interior_respects_diagonal_radii(self):
+        r = Radius.face_edge_corner(1, 1, 3)
+        dom = LocalDomain((10, 10, 10), (0, 0, 0), r)
+        inter = get_interior(dom)
+        # corner radius 3 dominates
+        assert inter.lo == Dim3(3, 3, 3)
+        assert inter.hi == Dim3(7, 7, 7)
+
+    def test_exterior_tiles_complement(self):
+        dom = LocalDomain((10, 10, 10), (5, 5, 5), Radius.constant(2))
+        inter = get_interior(dom)
+        exts = get_exterior(dom)
+        # exterior slabs + interior must tile the compute region exactly
+        vol = sum(r.extent().flatten() for r in exts)
+        assert vol + inter.extent().flatten() == 1000
+        # non-overlap: pairwise disjoint
+        boxes = exts + [inter]
+        for i in range(len(boxes)):
+            for j in range(i + 1, len(boxes)):
+                a, b = boxes[i], boxes[j]
+                lo = a.lo.elementwise_max(b.lo)
+                hi = a.hi.elementwise_min(b.hi)
+                assert (hi - lo).any_lt(1)
+
+    def test_exterior_asymmetric(self):
+        r = Radius.constant(0)
+        r.set_dir((1, 0, 0), 2)
+        dom = LocalDomain((10, 10, 10), (0, 0, 0), r)
+        inter = get_interior(dom)
+        assert inter.lo == Dim3(0, 0, 0)
+        assert inter.hi == Dim3(8, 10, 10)
+        exts = get_exterior(dom)
+        assert len(exts) == 1
+        assert exts[0].lo == Dim3(8, 0, 0)
+        assert exts[0].hi == Dim3(10, 10, 10)
