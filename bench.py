@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Headline benchmark: Jacobi-3D iteration rate + halo-exchange bandwidth.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
+
+North-star metric (BASELINE.md): jacobi3d iters/sec at 512^3, radius 1,
+measured with the reference's statistics (trimean over sample windows,
+bin/statistics.hpp analog). The reference publishes no numbers
+(BASELINE.md), so vs_baseline compares against the previous round's
+recorded result in BENCH_r*.json when present, else 1.0.
+
+Timing note: on the axon TPU tunnel, block_until_ready does not drain
+execution; we fence with a device->host fetch (stencil_tpu.utils.timers).
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    on_tpu = any("tpu" in str(d).lower() for d in jax.devices())
+    if on_tpu:
+        size, iters, warmup = 512, 200, 10
+    else:  # CPU smoke-test path
+        size, iters, warmup = 64, 20, 2
+
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.numerics import trimean
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.local_domain import halo_bytes
+
+    ndev = len(jax.devices())
+    from stencil_tpu.parallel.mesh import default_mesh_shape
+    mesh_shape = default_mesh_shape(ndev)
+    j = Jacobi3D(size, size, size, mesh_shape=mesh_shape, dtype=np.float32)
+    j.init()
+    j.run(warmup)
+    j.block()
+
+    # iteration rate: several timed windows, trimean (reference
+    # statistics schema, bin/statistics.hpp:6-19)
+    window = max(iters // 4, 1)
+    rates = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        j.run(window)
+        j.block()
+        dt = time.perf_counter() - t0
+        rates.append(window / dt)
+    iters_per_sec = trimean(rates)
+
+    # exchange-only bandwidth: all 26-direction halo bytes accounted the
+    # reference way (halo_extent per direction, local_domain.cuh:212-239)
+    dd = j.dd
+    radius = dd.radius
+    from stencil_tpu.geometry import all_directions
+    per_dir = sum(halo_bytes(d, dd.local_size, radius, 4)
+                  for d in all_directions())
+    total_halo_bytes = per_dir * dd.placement.dim().flatten()
+    ex = dd._exchange_fn
+    out = ex(dd.curr)  # compile
+    from stencil_tpu.utils.timers import device_sync
+    device_sync(out)
+    n_ex = 50 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(n_ex):
+        out = ex(out)
+    device_sync(out)
+    ex_s = (time.perf_counter() - t0) / n_ex
+    exchange_gbs = total_halo_bytes / ex_s / 1e9
+
+    value = round(iters_per_sec, 2)
+    baseline = _previous_round_value()
+    vs = round(value / baseline, 3) if baseline else 1.0
+    print(json.dumps({
+        "metric": f"jacobi3d_{size}c_iters_per_sec",
+        "value": value,
+        "unit": "iters/s",
+        "vs_baseline": vs,
+        "extra": {
+            "devices": ndev,
+            "mesh": tuple(mesh_shape),
+            "platform": str(jax.devices()[0].platform),
+            "exchange_GBps": round(exchange_gbs, 2),
+            "exchange_s": round(ex_s, 6),
+            "halo_bytes_per_exchange": total_halo_bytes,
+        },
+    }))
+
+
+def _previous_round_value():
+    best = None
+    for path in sorted(glob.glob("BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            v = rec.get("value")
+            if isinstance(v, (int, float)) and v > 0:
+                best = v
+        except Exception:
+            pass
+    return best
+
+
+if __name__ == "__main__":
+    main()

@@ -156,11 +156,15 @@ class DistributedDomain:
         padded_local = raw_size(self.local_size, self.radius)
         global_padded = padded_local * dim
         sharding = NamedSharding(self.mesh, P("z", "y", "x"))
+        self._padded_global = global_padded
         for q in self._names:
             shape = zyx_shape(global_padded)
             dt = self._dtypes[q]
             self.curr[q] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)
-            self.next_[q] = jax.device_put(jnp.zeros(shape, dtype=dt), sharding)
+        # next_ buffers allocate lazily on first swap(): fused-step apps
+        # (Jacobi3D) double-buffer via jit donation and never touch them,
+        # which halves field HBM at benchmark sizes
+        self.next_ = {}
         self.setup_seconds["realize"] = time.perf_counter() - t0
 
         # --- plan: build the exchange program --------------------------
@@ -200,7 +204,14 @@ class DistributedDomain:
             self.curr = dict(self._exchange_fn(self.curr))
 
     def swap(self) -> None:
-        """Swap curr/next bindings (reference: src/local_domain.cu:67-84)."""
+        """Swap curr/next bindings (reference: src/local_domain.cu:67-84).
+        next_ buffers are created on first use."""
+        if not self.next_ and self._names:
+            sharding = NamedSharding(self.mesh, P("z", "y", "x"))
+            shape = zyx_shape(self._padded_global)
+            self.next_ = {q: jax.device_put(
+                jnp.zeros(shape, dtype=self._dtypes[q]), sharding)
+                for q in self._names}
         self.curr, self.next_ = self.next_, self.curr
 
     # ------------------------------------------------------------------

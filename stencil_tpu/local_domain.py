@@ -173,10 +173,18 @@ class LocalDomain:
         return halo_bytes(dir, self.sz, self.radius, self.elem_size(name))
 
     def halo_coords(self, dir: Dim3Like, halo: bool) -> Rect3:
-        """Global coordinates of the halo (or interior-edge) region on
-        side ``dir`` (reference: src/local_domain.cu:39-58)."""
+        """Global coordinates of the halo (halo=True) or the
+        interior send region adjacent to side ``dir`` (halo=False).
+
+        The send region's width is the *opposite* face radius — the
+        receiver's halo on its ``-dir`` side — matching the pairing the
+        reference's packer uses (reference: src/packer.cu:116-118:
+        halo_pos(dir, false) with halo_extent(dir * -1); the reference's
+        own halo_coords pairs halo_extent(dir) instead, which reads out
+        of bounds for asymmetric radii — intended semantics kept here).
+        """
         pos = self.halo_pos(dir, halo)
-        ext = self.halo_extent(dir)
+        ext = self.halo_extent(Dim3.of(dir) if halo else -Dim3.of(dir))
         pos = pos - self.radius.pad_lo() + self.origin
         return Rect3(pos, pos + ext)
 

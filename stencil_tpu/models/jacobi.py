@@ -17,7 +17,6 @@ double-buffer swap an in-place update.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -110,11 +109,9 @@ class Jacobi3D:
         def shard_steps(p, n):
             return lax.fori_loop(0, n, lambda _, q: shard_step(q), p)
 
-        sm_n = jax.shard_map(functools.partial(shard_steps),
-                             mesh=dd.mesh, in_specs=(spec, P()),
+        sm_n = jax.shard_map(shard_steps, mesh=dd.mesh, in_specs=(spec, P()),
                              out_specs=spec, check_vma=False)
-        self._step_n = jax.jit(sm_n, donate_argnums=0,
-                               static_argnums=())
+        self._step_n = jax.jit(sm_n, donate_argnums=0)
 
     def step(self) -> None:
         """One iteration: exchange + 7-point update + sources."""

@@ -43,9 +43,15 @@ def pick_method(methods: "Method") -> "Method":
     """Choose the single strategy the exchange will use this run, by
     priority (the analog of the reference's per-pair transport routing,
     src/stencil.cu:371-458 — on TPU every pair rides the same ICI, so
-    one strategy is picked globally)."""
-    for m in (Method.PallasDMA, Method.PpermutePacked, Method.PpermuteSlab,
-              Method.AllGather):
+    one strategy is picked globally).
+
+    PallasDMA is not implemented yet: selecting it alongside other
+    flags falls through to the next priority; selecting it alone raises.
+    """
+    for m in (Method.PpermutePacked, Method.PpermuteSlab, Method.AllGather):
         if m in methods:
             return m
+    if Method.PallasDMA in methods:
+        raise NotImplementedError("Method.PallasDMA is not implemented yet; "
+                                  "combine with a ppermute method as fallback")
     raise ValueError(f"no usable method in {methods}")

@@ -98,6 +98,23 @@ class TestLocalDomain:
         assert rect.lo == Dim3(10, 20, 30)
         assert rect.extent() == Dim3(1, 4, 4)
 
+    def test_halo_coords_asymmetric_send_region(self):
+        # send region width must be the receiver's opposite halo
+        # (reference pairing: src/packer.cu:116-118)
+        r = Radius.constant(0)
+        r.set_dir((1, 0, 0), 2)   # +x halo is 2 wide
+        r.set_dir((-1, 0, 0), 1)  # -x halo is 1 wide
+        dom = LocalDomain((10, 10, 10), (0, 0, 0), r)
+        # sending in +x fills the neighbor's -x halo (width 1): last
+        # interior plane only, and stays inside the compute region
+        rect = dom.halo_coords((1, 0, 0), halo=False)
+        assert rect.lo == Dim3(9, 0, 0)
+        assert rect.hi == Dim3(10, 10, 10)
+        # the +x halo region itself is width 2
+        rect = dom.halo_coords((1, 0, 0), halo=True)
+        assert rect.lo == Dim3(10, 0, 0)
+        assert rect.hi == Dim3(12, 10, 10)
+
 
 class TestInteriorExterior:
     def test_interior_symmetric(self):
