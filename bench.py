@@ -35,8 +35,6 @@ def main() -> None:
 
     from stencil_tpu.models.jacobi import Jacobi3D
     from stencil_tpu.numerics import trimean
-    from stencil_tpu.geometry import Radius
-    from stencil_tpu.local_domain import halo_bytes
 
     ndev = len(jax.devices())
     from stencil_tpu.parallel.mesh import default_mesh_shape
@@ -58,14 +56,11 @@ def main() -> None:
         rates.append(window / dt)
     iters_per_sec = trimean(rates)
 
-    # exchange-only bandwidth: all 26-direction halo bytes accounted the
-    # reference way (halo_extent per direction, local_domain.cuh:212-239)
+    # exchange-only bandwidth: cross-device bytes only (axes with mesh
+    # count 1 are local wraps, not wire traffic) — same accounting as
+    # DistributedDomain's byte counters
     dd = j.dd
-    radius = dd.radius
-    from stencil_tpu.geometry import all_directions
-    per_dir = sum(halo_bytes(d, dd.local_size, radius, 4)
-                  for d in all_directions())
-    total_halo_bytes = per_dir * dd.placement.dim().flatten()
+    total_halo_bytes = dd.exchange_bytes_total()
     ex = dd._exchange_fn
     out = ex(dd.curr)  # compile
     from stencil_tpu.utils.timers import device_sync
@@ -79,10 +74,11 @@ def main() -> None:
     exchange_gbs = total_halo_bytes / ex_s / 1e9
 
     value = round(iters_per_sec, 2)
-    baseline = _previous_round_value()
+    metric = f"jacobi3d_{size}c_iters_per_sec"
+    baseline = _previous_round_value(metric)
     vs = round(value / baseline, 3) if baseline else 1.0
     print(json.dumps({
-        "metric": f"jacobi3d_{size}c_iters_per_sec",
+        "metric": metric,
         "value": value,
         "unit": "iters/s",
         "vs_baseline": vs,
@@ -97,14 +93,23 @@ def main() -> None:
     }))
 
 
-def _previous_round_value():
+def _previous_round_value(metric):
+    """Value of the latest prior round whose metric matches (files sort
+    numerically by round: BENCH_r10 after BENCH_r9)."""
+    import re
+
+    def round_no(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
     best = None
-    for path in sorted(glob.glob("BENCH_r*.json")):
+    for path in sorted(glob.glob("BENCH_r*.json"), key=round_no):
         try:
             with open(path) as f:
                 rec = json.load(f)
             v = rec.get("value")
-            if isinstance(v, (int, float)) and v > 0:
+            if (rec.get("metric") == metric
+                    and isinstance(v, (int, float)) and v > 0):
                 best = v
         except Exception:
             pass

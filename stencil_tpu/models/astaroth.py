@@ -1,0 +1,377 @@
+"""Astaroth-parity MHD integrator: 8 fields, 6th order, RK3.
+
+TPU-native re-implementation of the reference's astaroth mini-app
+("rough approximation of astaroth using the stencil library",
+reference: astaroth/astaroth.cu:1-3): 8 scalar fields — lnrho, uu(x,y,z),
+aa(x,y,z), entropy (astaroth/astaroth.cu:19-27) — advanced by a
+Williamson (1980) 3-step low-storage Runge-Kutta
+(astaroth/integration.cuh:14-38) with 6th-order central + cross
+derivatives (radius 3 <-> STENCIL_ORDER 6, astaroth/astaroth.h:8-9) and
+periodic boundaries.
+
+Physics (reference: astaroth/user_kernels.h:383-453):
+* continuity:  d lnrho/dt = -u . grad lnrho - div u
+* momentum:    du/dt = -(u.grad)u - cs2 (grad ss / cp + grad lnrho)
+               + (1/rho) j x B + nu (lap u + (1/3) grad div u
+               + 2 S . grad lnrho) + zeta grad div u
+* induction:   dA/dt = u x B + eta lap A           (B = curl A)
+* entropy:     d ss/dt = -u . grad ss + (1/(rho T)) [eta mu0 j.j
+               + 2 rho nu S:S + zeta rho (div u)^2] + heat conduction
+with j = (1/mu0)(grad div A - lap A),
+cs2 = cs2_sound exp(gamma ss/cp + (gamma-1)(lnrho - lnrho0)).
+
+Design notes vs the reference:
+* One iteration = 3 substeps; each substep is exchange + rates + RK3
+  update fused into a single shard_map'ped XLA program over the 3D mesh.
+* The reference mini-app never swaps its in/out buffers between
+  substeps, so substeps 1-2 re-read the original state
+  (astaroth/astaroth.cu:643-649 swaps once per iteration) — a quirk of
+  the mini-app, not of Astaroth. Here the 2N-storage scheme is applied
+  correctly (w = alpha w + dt F(u); u += beta w per substep), which has
+  identical per-iteration comm/compute cost (3 exchanges + 3 stencil
+  sweeps).
+* dtype is configurable: float32 is the TPU-native choice; float64
+  (the reference's AcReal) works on CPU for validation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..distributed import DistributedDomain
+from ..geometry import Dim3, Dim3Like, Radius
+from ..local_domain import raw_size, zyx_shape
+from ..ops.fd6 import RADIUS, FieldData
+from ..parallel.exchange import exchange_shard, exchange_shard_packed
+from ..parallel.mesh import mesh_dim
+from ..parallel.methods import Method, pick_method
+from ..utils.config import load_config
+
+FIELDS = ("lnrho", "uux", "uuy", "uuz", "ax", "ay", "az", "ss")
+
+# Williamson (1980) low-storage RK3 (reference: integration.cuh:20-21)
+RK3_ALPHA = (0.0, -5.0 / 9.0, -153.0 / 128.0)
+RK3_BETA = (1.0 / 3.0, 15.0 / 16.0, 8.0 / 15.0)
+
+
+@dataclasses.dataclass
+class MhdParams:
+    """Physical constants (reference: astaroth/astaroth.conf defaults)."""
+
+    dsx: float = 0.04908738521
+    dsy: float = 0.04908738521
+    dsz: float = 0.04908738521
+    dt: float = 1e-8            # astaroth.cu:578 loads AC_dt = 1e-8
+    nu_visc: float = 5e-3
+    cs_sound: float = 1.0
+    zeta: float = 0.01
+    eta: float = 5e-3
+    mu0: float = 1.4
+    cp_sound: float = 1.0
+    gamma: float = 0.5
+    lnT0: float = 1.2
+    lnrho0: float = 1.3
+
+    @property
+    def cs2_sound(self) -> float:
+        return self.cs_sound * self.cs_sound
+
+    @classmethod
+    def from_conf(cls, path: str) -> "MhdParams":
+        """Load from an astaroth.conf-style file (reference:
+        astaroth/astaroth_utils.cu acLoadConfig)."""
+        ints, reals = load_config(path)
+        m = {"AC_dsx": "dsx", "AC_dsy": "dsy", "AC_dsz": "dsz",
+             "AC_dt": "dt", "AC_nu_visc": "nu_visc",
+             "AC_cs_sound": "cs_sound", "AC_zeta": "zeta", "AC_eta": "eta",
+             "AC_mu0": "mu0", "AC_cp_sound": "cp_sound",
+             "AC_gamma": "gamma", "AC_lnT0": "lnT0", "AC_lnrho0": "lnrho0"}
+        kw = {}
+        for src, dst in m.items():
+            if src in reals:
+                kw[dst] = reals[src]
+            elif src in ints:
+                kw[dst] = float(ints[src])
+        return cls(**kw)
+
+
+def _dot(a, b):
+    return a[0] * b[0] + a[1] * b[1] + a[2] * b[2]
+
+
+def _cross(a, b):
+    return (a[1] * b[2] - a[2] * b[1],
+            a[2] * b[0] - a[0] * b[2],
+            a[0] * b[1] - a[1] * b[0])
+
+
+def mhd_rates(f: Dict[str, FieldData], prm: MhdParams, dtype):
+    """Right-hand sides of all 8 equations at the current state
+    (reference: astaroth/user_kernels.h:383-453)."""
+
+    def c(v):
+        return jnp.asarray(v, dtype)
+
+    lnrho, ss = f["lnrho"], f["ss"]
+    uu = (f["uux"], f["uuy"], f["uuz"])
+    aa = (f["ax"], f["ay"], f["az"])
+
+    u = tuple(q.value for q in uu)
+    grad_lnrho = lnrho.gradient
+    grad_ss = ss.gradient
+
+    div_u = uu[0].grad(0) + uu[1].grad(1) + uu[2].grad(2)
+
+    # continuity (user_kernels.h continuity)
+    d_lnrho = -_dot(u, grad_lnrho) - div_u
+
+    # traceless rate-of-strain tensor S (user_kernels.h stress_tensor)
+    third = c(1.0 / 3.0)
+    S = [[None] * 3 for _ in range(3)]
+    S[0][0] = c(2.0 / 3.0) * uu[0].grad(0) - third * (uu[1].grad(1) + uu[2].grad(2))
+    S[1][1] = c(2.0 / 3.0) * uu[1].grad(1) - third * (uu[0].grad(0) + uu[2].grad(2))
+    S[2][2] = c(2.0 / 3.0) * uu[2].grad(2) - third * (uu[0].grad(0) + uu[1].grad(1))
+    S[0][1] = S[1][0] = c(0.5) * (uu[0].grad(1) + uu[1].grad(0))
+    S[0][2] = S[2][0] = c(0.5) * (uu[0].grad(2) + uu[2].grad(0))
+    S[1][2] = S[2][1] = c(0.5) * (uu[1].grad(2) + uu[2].grad(1))
+
+    # current j = (1/mu0)(grad div A - lap A); B = curl A
+    grad_div_a = tuple(
+        aa[0].hess(i, 0) + aa[1].hess(i, 1) + aa[2].hess(i, 2)
+        for i in range(3))
+    lap_a = tuple(q.laplace for q in aa)
+    inv_mu0 = c(1.0 / prm.mu0)
+    j = tuple(inv_mu0 * (grad_div_a[i] - lap_a[i]) for i in range(3))
+    B = (aa[2].grad(1) - aa[1].grad(2),
+         aa[0].grad(2) - aa[2].grad(0),
+         aa[1].grad(0) - aa[0].grad(1))
+
+    # induction (user_kernels.h induction)
+    u_x_B = _cross(u, B)
+    d_aa = tuple(u_x_B[i] + c(prm.eta) * lap_a[i] for i in range(3))
+
+    # momentum (user_kernels.h momentum)
+    cs2 = c(prm.cs2_sound) * jnp.exp(
+        c(prm.gamma / prm.cp_sound) * ss.value
+        + c(prm.gamma - 1.0) * (lnrho.value - c(prm.lnrho0)))
+    inv_rho = jnp.exp(-lnrho.value)
+    adv = tuple(_dot((uu[i].grad(0), uu[i].grad(1), uu[i].grad(2)), u)
+                for i in range(3))
+    grad_div_u = tuple(
+        uu[0].hess(i, 0) + uu[1].hess(i, 1) + uu[2].hess(i, 2)
+        for i in range(3))
+    lap_u = tuple(q.laplace for q in uu)
+    j_x_B = _cross(j, B)
+    S_dot_glnrho = tuple(_dot(S[i], grad_lnrho) for i in range(3))
+    d_uu = tuple(
+        -adv[i]
+        - cs2 * (c(1.0 / prm.cp_sound) * grad_ss[i] + grad_lnrho[i])
+        + inv_rho * j_x_B[i]
+        + c(prm.nu_visc) * (lap_u[i] + third * grad_div_u[i]
+                            + c(2.0) * S_dot_glnrho[i])
+        + c(prm.zeta) * grad_div_u[i]
+        for i in range(3))
+
+    # entropy (user_kernels.h entropy, lnT, heat_conduction)
+    lnT = (c(prm.lnT0) + c(prm.gamma / prm.cp_sound) * ss.value
+           + c(prm.gamma - 1.0) * (lnrho.value - c(prm.lnrho0)))
+    rho = jnp.exp(lnrho.value)
+    inv_pT = jnp.exp(-lnrho.value - lnT)
+    contract_S = sum(S[i][k] * S[i][k] for i in range(3) for k in range(3))
+    rhs = (c(prm.eta * prm.mu0) * _dot(j, j)
+           + c(2.0 * prm.nu_visc) * rho * contract_S
+           + c(prm.zeta) * rho * div_u * div_u)
+    # heat conduction with chi = 0.001/(rho cp) (user_kernels.h:441-449)
+    inv_cp = c(1.0 / prm.cp_sound)
+    gamma_ = c(prm.gamma)
+    first_term = gamma_ * inv_cp * ss.laplace + (gamma_ - c(1.0)) * lnrho.laplace
+    second = tuple(gamma_ * inv_cp * grad_ss[i] + (gamma_ - c(1.0)) * grad_lnrho[i]
+                   for i in range(3))
+    third_t = tuple(gamma_ * (inv_cp * grad_ss[i] + grad_lnrho[i])
+                    - grad_lnrho[i] for i in range(3))
+    chi = c(0.001) * jnp.exp(-lnrho.value) * inv_cp
+    heat = c(prm.cp_sound) * chi * (first_term + _dot(second, third_t))
+    d_ss = -_dot(u, grad_ss) + inv_pT * rhs + heat
+
+    return {"lnrho": d_lnrho, "uux": d_uu[0], "uuy": d_uu[1], "uuz": d_uu[2],
+            "ax": d_aa[0], "ay": d_aa[1], "az": d_aa[2], "ss": d_ss}
+
+
+class Astaroth:
+    """Distributed MHD integrator over a TPU mesh."""
+
+    def __init__(self, nx: int, ny: int, nz: int,
+                 params: Optional[MhdParams] = None,
+                 mesh_shape: Optional[Dim3Like] = None,
+                 dtype=jnp.float32,
+                 devices: Optional[Sequence] = None,
+                 methods: Method = Method.PpermutePacked) -> None:
+        self.prm = params or MhdParams()
+        self.dd = DistributedDomain(nx, ny, nz, devices=devices)
+        self.dd.set_radius(Radius.constant(RADIUS))
+        self.dd.set_methods(methods)
+        if mesh_shape is not None:
+            self.dd.set_mesh_shape(mesh_shape)
+        for q in FIELDS:
+            self.dd.add_data(q, dtype)
+        self.dd.realize()
+        self._dtype = np.dtype(dtype)
+        # RK3 accumulators (interior-shaped, no halos)
+        self._w: Optional[Dict[str, jnp.ndarray]] = None
+        self._build_step()
+
+    # -- initial conditions (reference: astaroth/astaroth.cu:509-528) --
+    def init(self) -> None:
+        """hash-random all fields in [-1, 1); lnrho constant 0.5;
+        radial-explosion shell velocity."""
+        size = self.dd.size
+        shape = zyx_shape(size)
+        for q in FIELDS:
+            self.dd.set_interior(q, _hash_field(shape).astype(self._dtype))
+        self.dd.set_interior("lnrho",
+                             np.full(shape, 0.5, dtype=self._dtype))
+        ux, uy, uz = _radial_explosion(size, self.prm)
+        self.dd.set_interior("uux", ux.astype(self._dtype))
+        self.dd.set_interior("uuy", uy.astype(self._dtype))
+        self.dd.set_interior("uuz", uz.astype(self._dtype))
+        self._w = None
+
+    # -- fused iteration ----------------------------------------------
+    def _build_step(self) -> None:
+        dd = self.dd
+        radius = dd.radius
+        counts = mesh_dim(dd.mesh)
+        local = dd.local_size
+        prm = self.prm
+        pad_lo = radius.pad_lo()
+        inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
+        method = pick_method(dd.methods)
+        dt = prm.dt
+
+        def do_exchange(fields):
+            if method == Method.PpermutePacked:
+                return exchange_shard_packed(fields, radius, counts)
+            return {k: exchange_shard(v, radius, counts)
+                    for k, v in fields.items()}
+
+        def substep(fields, w, s):
+            fields = do_exchange(fields)
+            data = {q: FieldData(fields[q], inv_ds, pad_lo, local)
+                    for q in FIELDS}
+            rates = mhd_rates(data, prm, self._dtype)
+            alpha = jnp.asarray(RK3_ALPHA[s], self._dtype)
+            beta = jnp.asarray(RK3_BETA[s], self._dtype)
+            dt_ = jnp.asarray(dt, self._dtype)
+            new_f = {}
+            new_w = {}
+            for q in FIELDS:
+                wq = alpha * w[q] + dt_ * rates[q]
+                uq = data[q].value + beta * wq
+                new_w[q] = wq
+                new_f[q] = lax.dynamic_update_slice(
+                    fields[q], uq, (pad_lo.z, pad_lo.y, pad_lo.x))
+            return new_f, new_w
+
+        def shard_iter(fields, w):
+            for s in range(3):
+                fields, w = substep(fields, w, s)
+            return fields, w
+
+        spec = P("z", "y", "x")
+        sm = jax.shard_map(shard_iter, mesh=dd.mesh,
+                           in_specs=(spec, spec), out_specs=(spec, spec),
+                           check_vma=False)
+        self._iter = jax.jit(sm, donate_argnums=(0, 1))
+
+        def shard_iters(fields, w, n):
+            return lax.fori_loop(
+                0, n, lambda _, fw: shard_iter(*fw), (fields, w))
+
+        sm_n = jax.shard_map(shard_iters, mesh=dd.mesh,
+                             in_specs=(spec, spec, P()),
+                             out_specs=(spec, spec), check_vma=False)
+        self._iter_n = jax.jit(sm_n, donate_argnums=(0, 1))
+
+    def _ensure_w(self) -> None:
+        if self._w is None:
+            from jax.sharding import NamedSharding
+            sharding = NamedSharding(self.dd.mesh, P("z", "y", "x"))
+            dim = self.dd.placement.dim()
+            shape = zyx_shape(self.dd.local_size * dim)
+            self._w = {q: jax.device_put(
+                jnp.zeros(shape, dtype=self._dtype), sharding)
+                for q in FIELDS}
+
+    def step(self) -> None:
+        """One full RK3 iteration (3 substeps, 3 exchanges)."""
+        self._ensure_w()
+        out_f, out_w = self._iter(self.dd.curr, self._w)
+        self.dd.curr = dict(out_f)
+        self._w = dict(out_w)
+
+    def run(self, iters: int) -> None:
+        self._ensure_w()
+        out_f, out_w = self._iter_n(self.dd.curr, self._w,
+                                    jnp.asarray(iters, jnp.int32))
+        self.dd.curr = dict(out_f)
+        self._w = dict(out_w)
+
+    def block(self) -> None:
+        from ..utils.timers import device_sync
+        device_sync(self.dd.curr["lnrho"])
+
+    def field(self, name: str) -> np.ndarray:
+        return self.dd.interior_to_host(name)
+
+
+# ----------------------------------------------------------------------
+# initial-condition fields (reference: astaroth/astaroth.cu:84-200)
+# ----------------------------------------------------------------------
+def _hash64(x: np.ndarray) -> np.ndarray:
+    """splitmix64-style avalanche (reference: astaroth.cu:84-89)."""
+    x = x.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def _hash_field(shape_zyx) -> np.ndarray:
+    """'bad random numbers from -1 to 1' (reference: astaroth.cu:92-114):
+    val = hash(x) ^ hash(y) ^ hash(z) scaled to [-1, 1)."""
+    nz, ny, nx = shape_zyx
+    hz = _hash64(np.arange(nz))[:, None, None]
+    hy = _hash64(np.arange(ny))[None, :, None]
+    hx = _hash64(np.arange(nx))[None, None, :]
+    h = hx ^ hy ^ hz
+    val = h.astype(np.float64) / float(np.iinfo(np.uint64).max)
+    return (val - 0.5) * 2.0
+
+
+def _radial_explosion(size: Dim3, prm: MhdParams):
+    """Gaussian shell of radially outward velocity
+    (reference: astaroth.cu:136-200): amplitude 1, shell radius 0.8,
+    width 0.2, origin (0.01, 32 dsy, 50 dsz); components via the unit
+    radial vector (algebraically equal to the reference's spherical-
+    angle decomposition, without the branch ladder)."""
+    ampl, shell_r, width = 1.0, 0.8, 0.2
+    ox, oy, oz = 0.01, 32 * prm.dsy, 50 * prm.dsz
+    z, y, x = np.meshgrid(np.arange(size.z), np.arange(size.y),
+                          np.arange(size.x), indexing="ij")
+    xx = x * prm.dsx - ox
+    yy = y * prm.dsy - oy
+    zz = z * prm.dsz - oz
+    rr = np.sqrt(xx * xx + yy * yy + zz * zz)
+    u_rad = ampl * np.exp(-((rr - shell_r) ** 2) / (2.0 * width * width))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        inv_r = np.where(rr > 0, 1.0 / np.where(rr > 0, rr, 1.0), 0.0)
+    return (u_rad * xx * inv_r, u_rad * yy * inv_r, u_rad * zz * inv_r)

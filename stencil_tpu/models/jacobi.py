@@ -38,6 +38,35 @@ HOT_TEMP = 1.0   # reference: bin/jacobi3d.cu:12
 COLD_TEMP = 0.0  # reference: bin/jacobi3d.cu:11
 
 
+def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
+                      gsize: Dim3, origin_xyz, method: Method):
+    """One fused Jacobi step on one shard: exchange + 7-point update +
+    Dirichlet sphere sources. ``origin_xyz`` is the shard's global
+    origin (traced axis_index-derived inside shard_map, or static
+    (0,0,0) single-chip). Shared by Jacobi3D and the driver entry."""
+    hot_c = Dim3(gsize.x // 3, gsize.y // 2, gsize.z // 2)
+    cold_c = Dim3(gsize.x * 2 // 3, gsize.y // 2, gsize.z // 2)
+    sph_r = gsize.x // 10
+
+    if method == Method.PpermutePacked:
+        p = exchange_shard_packed({"temp": p}, radius, counts)["temp"]
+    elif method == Method.AllGather:
+        p = exchange_shard_allgather(p, radius, counts)
+    else:
+        p = exchange_shard(p, radius, counts)
+    new = jacobi7(p, radius, local)
+    gz, gy, gx = global_coords(origin_xyz, local)
+
+    def dist2(c: Dim3):
+        return (gx - c.x) ** 2 + (gy - c.y) ** 2 + (gz - c.z) ** 2
+
+    new = jnp.where(dist2(hot_c) <= sph_r * sph_r,
+                    jnp.asarray(HOT_TEMP, new.dtype), new)
+    new = jnp.where(dist2(cold_c) <= sph_r * sph_r,
+                    jnp.asarray(COLD_TEMP, new.dtype), new)
+    return write_interior(p, new, radius)
+
+
 class Jacobi3D:
     """Distributed Jacobi-3D solver over a TPU mesh."""
 
@@ -69,37 +98,14 @@ class Jacobi3D:
         counts = mesh_dim(dd.mesh)
         local = dd.local_size
         gsize = dd.size
-        # sphere geometry (reference: bin/jacobi3d.cu:45-50)
-        hot_c = Dim3(gsize.x // 3, gsize.y // 2, gsize.z // 2)
-        cold_c = Dim3(gsize.x * 2 // 3, gsize.y // 2, gsize.z // 2)
-        sph_r = gsize.x // 10
-
         method = pick_method(self.dd.methods)
 
-        def do_exchange(p):
-            if method == Method.PpermutePacked:
-                return exchange_shard_packed({"temp": p}, radius, counts)["temp"]
-            if method == Method.AllGather:
-                return exchange_shard_allgather(p, radius, counts)
-            return exchange_shard(p, radius, counts)
-
         def shard_step(p):
-            p = do_exchange(p)
-            new = jacobi7(p, radius, local)
-            # global coords of this shard's interior
             origin = (lax.axis_index("x") * local.x,
                       lax.axis_index("y") * local.y,
                       lax.axis_index("z") * local.z)
-            gz, gy, gx = global_coords(origin, local)
-
-            def dist2(c: Dim3):
-                return ((gx - c.x) ** 2 + (gy - c.y) ** 2 + (gz - c.z) ** 2)
-
-            new = jnp.where(dist2(hot_c) <= sph_r * sph_r,
-                            jnp.asarray(HOT_TEMP, new.dtype), new)
-            new = jnp.where(dist2(cold_c) <= sph_r * sph_r,
-                            jnp.asarray(COLD_TEMP, new.dtype), new)
-            return write_interior(p, new, radius)
+            return jacobi_shard_step(p, radius, counts, local, gsize,
+                                     origin, method)
 
         spec = P("z", "y", "x")
         sm = jax.shard_map(shard_step, mesh=dd.mesh, in_specs=spec,

@@ -1,0 +1,148 @@
+"""6th-order central finite-difference operators on padded shards.
+
+XLA-native equivalents of the Astaroth DSL derivative machinery
+(reference: astaroth/user_kernels.h:36-121 first/second/cross_derivative
+and derx/deryy/derxy/... pencils): instead of per-thread pencil loads,
+each operator is a sum of shifted interior-shaped slices of the padded
+(z,y,x) array — XLA fuses the whole stencil into one loop nest.
+
+Coefficients (6th-order central):
+* 1st derivative: (3/4, -3/20, 1/60) antisymmetric pairs / ds
+* 2nd derivative: -49/18 center + (3/2, -3/20, 1/90) symmetric / ds^2
+* cross derivative: (270, -27, 2)/720 over the two diagonals
+  (reference: user_kernels.h:66-76) — requires edge halo data of the
+  same radius, i.e. Radius.constant(3), matching the reference's
+  STENCIL_ORDER 6 (astaroth/astaroth.h:8-9).
+
+Axis convention: axis 0=x, 1=y, 2=z (grid order); arrays are (z,y,x).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..geometry import Dim3, Radius
+
+# 6th-order coefficient tables
+_D1 = (3.0 / 4.0, -3.0 / 20.0, 1.0 / 60.0)
+_D2_C = -49.0 / 18.0
+_D2 = (3.0 / 2.0, -3.0 / 20.0, 1.0 / 90.0)
+_DC = (270.0 / 720.0, -27.0 / 720.0, 2.0 / 720.0)
+
+RADIUS = 3
+
+
+def _shift(padded: jnp.ndarray, off_xyz: Tuple[int, int, int],
+           pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+    ox, oy, oz = off_xyz
+    return lax.slice(
+        padded,
+        (pad_lo.z + oz, pad_lo.y + oy, pad_lo.x + ox),
+        (pad_lo.z + oz + interior.z, pad_lo.y + oy + interior.y,
+         pad_lo.x + ox + interior.x))
+
+
+def _axis_off(axis: int, i: int) -> Tuple[int, int, int]:
+    off = [0, 0, 0]
+    off[axis] = i
+    return tuple(off)
+
+
+def der1(padded: jnp.ndarray, axis: int, inv_ds: float,
+         pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+    """6th-order first derivative along ``axis``
+    (reference: user_kernels.h:36-48 first_derivative + derx/dery/derz)."""
+    dt = padded.dtype
+    acc = None
+    for i, c in enumerate(_D1, start=1):
+        hi = _shift(padded, _axis_off(axis, i), pad_lo, interior)
+        lo = _shift(padded, _axis_off(axis, -i), pad_lo, interior)
+        term = jnp.asarray(c, dt) * (hi - lo)
+        acc = term if acc is None else acc + term
+    return acc * jnp.asarray(inv_ds, dt)
+
+
+def der2(padded: jnp.ndarray, axis: int, inv_ds: float,
+         pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+    """6th-order second derivative along ``axis``
+    (reference: user_kernels.h:49-62 second_derivative)."""
+    dt = padded.dtype
+    acc = jnp.asarray(_D2_C, dt) * _shift(padded, (0, 0, 0), pad_lo, interior)
+    for i, c in enumerate(_D2, start=1):
+        hi = _shift(padded, _axis_off(axis, i), pad_lo, interior)
+        lo = _shift(padded, _axis_off(axis, -i), pad_lo, interior)
+        acc = acc + jnp.asarray(c, dt) * (hi + lo)
+    return acc * jnp.asarray(inv_ds * inv_ds, dt)
+
+
+def der_cross(padded: jnp.ndarray, axis_a: int, axis_b: int,
+              inv_ds_a: float, inv_ds_b: float,
+              pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+    """6th-order mixed derivative d2/(da db), a != b
+    (reference: user_kernels.h:63-76 cross_derivative + derxy/...):
+    pencil_a runs along the (+a,+b) diagonal, pencil_b along (+a,-b).
+    """
+    dt = padded.dtype
+    acc = None
+    for i, c in enumerate(_DC, start=1):
+        def at(sa: int, sb: int):
+            off = [0, 0, 0]
+            off[axis_a] = sa
+            off[axis_b] = sb
+            return _shift(padded, tuple(off), pad_lo, interior)
+        term = jnp.asarray(c, dt) * (at(i, i) + at(-i, -i)
+                                     - at(i, -i) - at(-i, i))
+        acc = term if acc is None else acc + term
+    return acc * jnp.asarray(inv_ds_a * inv_ds_b, dt)
+
+
+def value(padded: jnp.ndarray, pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+    """Center value (interior view)."""
+    return _shift(padded, (0, 0, 0), pad_lo, interior)
+
+
+class FieldData:
+    """value + gradient + hessian of one scalar field, computed lazily
+    and cached — the AcRealData analog (reference: user_kernels.h:19-23,
+    read_data). ``inv_ds`` is (1/dsx, 1/dsy, 1/dsz)."""
+
+    def __init__(self, padded: jnp.ndarray, inv_ds: Tuple[float, float, float],
+                 pad_lo: Dim3, interior: Dim3) -> None:
+        self._p = padded
+        self._inv = inv_ds
+        self._lo = pad_lo
+        self._n = interior
+        self._cache = {}
+
+    @property
+    def value(self) -> jnp.ndarray:
+        return self._get(("v",), lambda: value(self._p, self._lo, self._n))
+
+    def grad(self, axis: int) -> jnp.ndarray:
+        return self._get(("g", axis), lambda: der1(
+            self._p, axis, self._inv[axis], self._lo, self._n))
+
+    @property
+    def gradient(self):
+        return tuple(self.grad(a) for a in range(3))
+
+    def hess(self, a: int, b: int) -> jnp.ndarray:
+        if a > b:
+            a, b = b, a
+        if a == b:
+            return self._get(("h", a, a), lambda: der2(
+                self._p, a, self._inv[a], self._lo, self._n))
+        return self._get(("h", a, b), lambda: der_cross(
+            self._p, a, b, self._inv[a], self._inv[b], self._lo, self._n))
+
+    @property
+    def laplace(self) -> jnp.ndarray:
+        return self.hess(0, 0) + self.hess(1, 1) + self.hess(2, 2)
+
+    def _get(self, key, fn):
+        if key not in self._cache:
+            self._cache[key] = fn()
+        return self._cache[key]

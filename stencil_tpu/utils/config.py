@@ -1,0 +1,43 @@
+"""``key = value`` configuration loader.
+
+The analog of the reference's astaroth.conf parser
+(reference: astaroth/astaroth_utils.cu acLoadConfig,
+astaroth/astaroth.conf): lines of ``name = value`` with ``//`` and
+``/* */`` comments; int-valued names and real-valued names are kept in
+separate tables like AcMeshInfo's int_params/real_params.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Tuple
+
+
+def load_config(path: str) -> Tuple[Dict[str, int], Dict[str, float]]:
+    """Parse a conf file into (int_params, real_params)."""
+    with open(path) as f:
+        text = f.read()
+    return parse_config(text)
+
+
+def parse_config(text: str) -> Tuple[Dict[str, int], Dict[str, float]]:
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    int_params: Dict[str, int] = {}
+    real_params: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.split("//")[0].strip()
+        if not line or "=" not in line:
+            continue
+        name, _, val = line.partition("=")
+        name = name.strip()
+        val = val.strip()
+        if not name or not val:
+            continue
+        try:
+            if re.fullmatch(r"[+-]?\d+", val):
+                int_params[name] = int(val)
+            else:
+                real_params[name] = float(val)
+        except ValueError:
+            continue  # non-numeric values are ignored, as in the reference
+    return int_params, real_params

@@ -1,0 +1,141 @@
+"""6th-order derivative operators vs an independent numpy oracle and
+analytic convergence checks (reference coefficients:
+astaroth/user_kernels.h:36-76)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.ops.fd6 import FieldData, der1, der2, der_cross
+
+R = 3
+
+
+def pad_periodic(a: np.ndarray) -> np.ndarray:
+    """Periodic halo padding of a (z,y,x) interior array."""
+    return np.pad(a, R, mode="wrap")
+
+
+def np_der1(a: np.ndarray, axis_grid: int, inv_ds: float) -> np.ndarray:
+    """Independent oracle via np.roll on the interior (periodic)."""
+    ax = {0: 2, 1: 1, 2: 0}[axis_grid]
+    c = [3.0 / 4.0, -3.0 / 20.0, 1.0 / 60.0]
+    out = np.zeros_like(a)
+    for i, ci in enumerate(c, start=1):
+        out += ci * (np.roll(a, -i, axis=ax) - np.roll(a, i, axis=ax))
+    return out * inv_ds
+
+
+def np_der2(a: np.ndarray, axis_grid: int, inv_ds: float) -> np.ndarray:
+    ax = {0: 2, 1: 1, 2: 0}[axis_grid]
+    c0 = -49.0 / 18.0
+    c = [3.0 / 2.0, -3.0 / 20.0, 1.0 / 90.0]
+    out = c0 * a.copy()
+    for i, ci in enumerate(c, start=1):
+        out += ci * (np.roll(a, -i, axis=ax) + np.roll(a, i, axis=ax))
+    return out * inv_ds * inv_ds
+
+
+def np_cross(a: np.ndarray, ga: int, gb: int, inv_a: float, inv_b: float
+             ) -> np.ndarray:
+    axa = {0: 2, 1: 1, 2: 0}[ga]
+    axb = {0: 2, 1: 1, 2: 0}[gb]
+    fac = 1.0 / 720.0
+    c = [270.0 * fac, -27.0 * fac, 2.0 * fac]
+    out = np.zeros_like(a)
+    for i, ci in enumerate(c, start=1):
+        pp = np.roll(np.roll(a, -i, axis=axa), -i, axis=axb)
+        mm = np.roll(np.roll(a, i, axis=axa), i, axis=axb)
+        pm = np.roll(np.roll(a, -i, axis=axa), i, axis=axb)
+        mp = np.roll(np.roll(a, i, axis=axa), -i, axis=axb)
+        out += ci * (pp + mm - pm - mp)
+    return out * inv_a * inv_b
+
+
+@pytest.fixture
+def rand_field():
+    rng = np.random.default_rng(42)
+    return rng.standard_normal((10, 12, 14))
+
+
+class TestOperatorsVsOracle:
+    def test_der1_all_axes(self, rand_field):
+        a = rand_field
+        p = jnp.asarray(pad_periodic(a))
+        lo = Dim3(R, R, R)
+        n = Dim3(a.shape[2], a.shape[1], a.shape[0])
+        for axis in range(3):
+            got = np.asarray(der1(p, axis, 2.5, lo, n))
+            want = np_der1(a, axis, 2.5)
+            np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_der2_all_axes(self, rand_field):
+        a = rand_field
+        p = jnp.asarray(pad_periodic(a))
+        lo = Dim3(R, R, R)
+        n = Dim3(a.shape[2], a.shape[1], a.shape[0])
+        for axis in range(3):
+            got = np.asarray(der2(p, axis, 1.5, lo, n))
+            want = np_der2(a, axis, 1.5)
+            np.testing.assert_allclose(got, want, atol=1e-12)
+
+    def test_cross_all_pairs(self, rand_field):
+        a = rand_field
+        p = jnp.asarray(pad_periodic(a))
+        lo = Dim3(R, R, R)
+        n = Dim3(a.shape[2], a.shape[1], a.shape[0])
+        for ga, gb in ((0, 1), (0, 2), (1, 2)):
+            got = np.asarray(der_cross(p, ga, gb, 2.0, 3.0, lo, n))
+            want = np_cross(a, ga, gb, 2.0, 3.0)
+            np.testing.assert_allclose(got, want, atol=1e-12)
+            # symmetry d2/dadb == d2/dbda
+            got_t = np.asarray(der_cross(p, gb, ga, 3.0, 2.0, lo, n))
+            np.testing.assert_allclose(got, got_t, atol=1e-12)
+
+
+class TestAnalyticAccuracy:
+    def test_sine_wave_derivatives(self):
+        # f = sin(kx): f' = k cos(kx), f'' = -k^2 sin(kx); 6th order
+        # should be accurate to ~(k dx)^6
+        n = 32
+        ds = 2 * np.pi / n
+        x = np.arange(n) * ds
+        f = np.sin(x)[None, None, :] * np.ones((4, 4, 1))
+        p = jnp.asarray(pad_periodic(f))
+        lo = Dim3(R, R, R)
+        ni = Dim3(n, 4, 4)
+        d1 = np.asarray(der1(p, 0, 1.0 / ds, lo, ni))
+        np.testing.assert_allclose(d1[0, 0], np.cos(x), atol=1e-6)
+        d2v = np.asarray(der2(p, 0, 1.0 / ds, lo, ni))
+        np.testing.assert_allclose(d2v[0, 0], -np.sin(x), atol=1e-5)
+
+    def test_cross_of_product(self):
+        # f = sin(x) sin(y): dxy f = cos(x) cos(y)
+        n = 32
+        ds = 2 * np.pi / n
+        x = np.arange(n) * ds
+        f = np.sin(x)[None, :, None] * np.sin(x)[None, None, :]
+        f = np.broadcast_to(f, (4, n, n)).copy()
+        p = jnp.asarray(pad_periodic(f))
+        lo = Dim3(R, R, R)
+        ni = Dim3(n, n, 4)
+        got = np.asarray(der_cross(p, 0, 1, 1.0 / ds, 1.0 / ds, lo, ni))
+        want = np.cos(x)[None, :, None] * np.cos(x)[None, None, :]
+        # 6th-order truncation at this resolution is ~6e-6
+        np.testing.assert_allclose(got[0], want[0], atol=2e-5)
+
+
+class TestFieldData:
+    def test_caching_and_shapes(self, rand_field):
+        a = rand_field
+        p = jnp.asarray(pad_periodic(a))
+        fd = FieldData(p, (1.0, 1.0, 1.0), Dim3(R, R, R),
+                       Dim3(a.shape[2], a.shape[1], a.shape[0]))
+        assert fd.value.shape == a.shape
+        assert fd.grad(0) is fd.grad(0)  # cached
+        assert fd.hess(1, 0) is fd.hess(0, 1)  # symmetric alias
+        lap = np.asarray(fd.laplace)
+        want = np_der2(a, 0, 1) + np_der2(a, 1, 1) + np_der2(a, 2, 1)
+        np.testing.assert_allclose(lap, want, atol=1e-12)
