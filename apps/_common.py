@@ -1,0 +1,87 @@
+"""Shared CLI plumbing for the reference-parity app suite
+(reference: bin/ — argparse flags, CSV result lines, Statistics)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from stencil_tpu.numerics import Statistics  # noqa: E402
+from stencil_tpu.parallel.methods import Method  # noqa: E402
+
+
+def add_device_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--fake-cpu", type=int, default=0, metavar="N",
+                   help="run on N virtual CPU devices (the analog of the "
+                        "reference's GPU oversubscription, "
+                        "test/test_exchange.cu:52)")
+
+
+def apply_device_flags(args) -> None:
+    """Must run before any jax device use (backend init is lazy)."""
+    n = getattr(args, "fake_cpu", 0)
+    if n:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+
+
+def add_method_flags(p: argparse.ArgumentParser) -> None:
+    """The analog of the reference's per-method CLI flags
+    (reference: bin/jacobi3d.cu:107-122 --staged/--colo/--peer/--kernel)."""
+    p.add_argument("--slab", action="store_true",
+                   help="per-axis slab ppermute (default)")
+    p.add_argument("--packed", action="store_true",
+                   help="pack all quantities per direction into one buffer")
+    p.add_argument("--allgather", action="store_true",
+                   help="all-gather control strategy")
+
+
+def methods_from_args(args) -> Method:
+    m = Method.NONE
+    if getattr(args, "slab", False):
+        m |= Method.PpermuteSlab
+    if getattr(args, "packed", False):
+        m |= Method.PpermutePacked
+    if getattr(args, "allgather", False):
+        m |= Method.AllGather
+    return m if m != Method.NONE else Method.Default
+
+
+def add_placement_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--trivial", action="store_true",
+                   help="trivial placement instead of node-aware")
+    p.add_argument("--random", action="store_true",
+                   help="random placement (experimental control)")
+
+
+def placement_from_args(args):
+    from stencil_tpu.placement import PlacementStrategy
+    if getattr(args, "random", False):
+        return PlacementStrategy.IntraNodeRandom
+    if getattr(args, "trivial", False):
+        return PlacementStrategy.Trivial
+    return PlacementStrategy.NodeAware
+
+
+def csv_line(*fields) -> str:
+    return ",".join(str(f) for f in fields)
+
+
+def timed_samples(fn, sync, iters: int, warmup: int = 2) -> Statistics:
+    """Time ``fn()`` ``iters`` times (after warmup), fencing with
+    ``sync()``; returns the Statistics accumulator."""
+    for _ in range(warmup):
+        fn()
+    sync()
+    stats = Statistics()
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        sync()
+        stats.insert(time.perf_counter() - t0)
+    return stats

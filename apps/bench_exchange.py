@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Halo-exchange micro-benchmark with face/edge/corner radius control.
+
+Reference parity: bin/bench_exchange.cu — ``--fr/--er/--cr`` radius
+flags, reports trimean seconds and trimean B/s
+(bin/bench_exchange.cu:58-64,86-100).
+"""
+
+import argparse
+
+from _common import (add_device_flags, apply_device_flags,
+                     add_method_flags, csv_line, methods_from_args,
+                     timed_samples)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--x", type=int, default=128, help="per-device x size")
+    ap.add_argument("--y", type=int, default=128)
+    ap.add_argument("--z", type=int, default=128)
+    ap.add_argument("--fr", type=int, default=2, help="face radius")
+    ap.add_argument("--er", type=int, default=2, help="edge radius")
+    ap.add_argument("--cr", type=int, default=2, help="corner radius")
+    ap.add_argument("--fields", type=int, default=1)
+    ap.add_argument("--iters", "-n", type=int, default=30)
+    add_method_flags(ap)
+    add_device_flags(ap)
+    args = ap.parse_args()
+    apply_device_flags(args)
+
+    import jax
+    import numpy as np
+
+    from stencil_tpu.distributed import DistributedDomain
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.parallel.mesh import default_mesh_shape
+    from stencil_tpu.utils.timers import device_sync
+
+    ndev = len(jax.devices())
+    mesh_shape = default_mesh_shape(ndev)
+    dd = DistributedDomain(args.x * mesh_shape.x, args.y * mesh_shape.y,
+                           args.z * mesh_shape.z)
+    dd.set_mesh_shape(mesh_shape)
+    dd.set_radius(Radius.face_edge_corner(args.fr, args.er, args.cr))
+    dd.set_methods(methods_from_args(args))
+    for i in range(args.fields):
+        dd.add_data(f"q{i}", np.float32)
+    dd.realize()
+
+    stats = timed_samples(dd.exchange, lambda: device_sync(dd.curr),
+                          args.iters)
+    total = dd.exchange_bytes_total()
+    tm = stats.trimean()
+    print(csv_line("bench_exchange", dd.methods, ndev,
+                   args.x, args.y, args.z, args.fr, args.er, args.cr,
+                   args.fields, total,
+                   f"{tm:.6e}", f"{total / tm:.6e}"))
+
+
+if __name__ == "__main__":
+    main()

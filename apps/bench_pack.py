@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Pack/unpack throughput per direction and size.
+
+Reference parity: bin/bench_pack.cu — DevicePacker/Unpacker throughput
+by direction/size. Here the packer analog is the packed-slab path of
+the exchange engine: extract + flatten + concatenate the halo slabs of
+all quantities for one axis side, then scatter back.
+"""
+
+import argparse
+import time
+
+from _common import add_device_flags, apply_device_flags, csv_line
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes", type=int, nargs="+",
+                    default=[32, 64, 128, 256])
+    ap.add_argument("--radius", type=int, default=2)
+    ap.add_argument("--fields", type=int, default=4)
+    ap.add_argument("--iters", "-n", type=int, default=20)
+    add_device_flags(ap)
+    args = ap.parse_args()
+    apply_device_flags(args)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from stencil_tpu.geometry import Dim3, Radius
+    from stencil_tpu.local_domain import raw_size, zyx_shape
+    from stencil_tpu.numerics import Statistics
+    from stencil_tpu.utils.timers import device_sync
+
+    r = args.radius
+
+    for n in args.sizes:
+        sz = Dim3(n, n, n)
+        radius = Radius.constant(r)
+        shape = zyx_shape(raw_size(sz, radius))
+        arrs = {f"q{i}": jnp.zeros(shape, jnp.float32) + i
+                for i in range(args.fields)}
+
+        # pack: slabs of every field on the +x side -> one flat buffer
+        def pack(fields):
+            slabs = []
+            for k in sorted(fields):
+                a = fields[k]
+                slab = lax.slice_in_dim(a, r, 2 * r, axis=2)
+                slabs.append(slab.reshape(-1))
+            return jnp.concatenate(slabs)
+
+        # unpack: scatter the buffer back into the halo regions
+        def unpack(fields, buf):
+            out = {}
+            off = 0
+            for k in sorted(fields):
+                a = fields[k]
+                cnt = a.shape[0] * a.shape[1] * r
+                slab = lax.dynamic_slice_in_dim(buf, off, cnt).reshape(
+                    a.shape[0], a.shape[1], r)
+                off += cnt
+                out[k] = lax.dynamic_update_slice_in_dim(
+                    a, slab, a.shape[2] - r, axis=2)
+            return out
+
+        roundtrip = jax.jit(lambda f: unpack(f, pack(f)))
+        out = roundtrip(arrs)
+        device_sync(out)
+        stats = Statistics()
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            out = roundtrip(arrs)
+            device_sync(out)
+            stats.insert(time.perf_counter() - t0)
+        nbytes = sum(int(v.shape[0]) * int(v.shape[1]) * r * 4
+                     for v in arrs.values()) * 2  # pack + unpack
+        tm = stats.trimean()
+        print(csv_line("bench_pack", n, r, args.fields, nbytes,
+                       f"{tm:.6e}", f"{nbytes / tm:.6e}"))
+
+
+if __name__ == "__main__":
+    main()

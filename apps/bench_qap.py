@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""QAP placement-solver timing vs matrix size
+(reference: bin/bench_qap.cu:1-13)."""
+
+import argparse
+import time
+
+from _common import add_device_flags, apply_device_flags, csv_line
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes", type=int, nargs="+",
+                    default=[4, 6, 8, 10, 16, 32])
+    ap.add_argument("--timeout", type=float, default=2.0)
+    add_device_flags(ap)
+    args = ap.parse_args()
+    apply_device_flags(args)
+
+    import numpy as np
+
+    from stencil_tpu import qap
+
+    rng = np.random.default_rng(0)
+    print(csv_line("bench_qap", "n", "native", "exact_s", "exact_cost",
+                   "catch_s", "catch_cost"))
+    for n in args.sizes:
+        w = rng.uniform(0, 10, (n, n))
+        np.fill_diagonal(w, 0)
+        d = rng.uniform(0.1, 1, (n, n))
+        np.fill_diagonal(d, 0)
+        if n <= 10:
+            t0 = time.perf_counter()
+            _, c_exact = qap.solve(w, d, timeout_s=args.timeout)
+            t_exact = time.perf_counter() - t0
+        else:
+            t_exact, c_exact = float("nan"), float("nan")
+        t0 = time.perf_counter()
+        _, c_catch = qap.solve_catch(w, d)
+        t_catch = time.perf_counter() - t0
+        print(csv_line("bench_qap", n, qap.native_available(),
+                       f"{t_exact:.4f}", f"{c_exact:.3f}",
+                       f"{t_catch:.4f}", f"{c_catch:.3f}"))
+
+
+if __name__ == "__main__":
+    main()

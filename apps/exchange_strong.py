@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Pure exchange() time, strong scaling (fixed global size)
+(reference: bin/exchange_strong.cu)."""
+
+import argparse
+
+from _common import (add_device_flags, apply_device_flags,
+                     add_method_flags, methods_from_args)
+from exchange_weak import run_exchange_bench
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--x", type=int, default=512, help="global x size")
+    ap.add_argument("--y", type=int, default=512)
+    ap.add_argument("--z", type=int, default=512)
+    ap.add_argument("--radius", type=int, default=3)
+    ap.add_argument("--fields", type=int, default=1)
+    ap.add_argument("--iters", "-n", type=int, default=30)
+    add_method_flags(ap)
+    add_device_flags(ap)
+    args = ap.parse_args()
+    apply_device_flags(args)
+
+    run_exchange_bench("exchange_strong", args.x, args.y, args.z, None,
+                       args.radius, args.fields, args.iters,
+                       methods_from_args(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Pure exchange() time, weak scaling (per-device size fixed)
+(reference: bin/exchange_weak.cu: "measure purely total exchange time")."""
+
+import argparse
+
+from _common import (add_device_flags, apply_device_flags,
+                     add_method_flags, csv_line, methods_from_args,
+                     timed_samples)
+
+
+def run_exchange_bench(name: str, gx: int, gy: int, gz: int, mesh_shape,
+                       radius: int, fields: int, iters: int, methods) -> None:
+    import numpy as np
+
+    from stencil_tpu.distributed import DistributedDomain
+    from stencil_tpu.utils.timers import device_sync
+
+    dd = DistributedDomain(gx, gy, gz)
+    if mesh_shape is not None:
+        dd.set_mesh_shape(mesh_shape)
+    dd.set_radius(radius)
+    dd.set_methods(methods)
+    for i in range(fields):
+        dd.add_data(f"q{i}", np.float32)
+    dd.realize()
+    stats = timed_samples(dd.exchange, lambda: device_sync(dd.curr), iters)
+    ndev = dd.placement.dim().flatten()
+    total = dd.exchange_bytes_total()
+    tm = stats.trimean()
+    print(csv_line(name, dd.methods, ndev, gx, gy, gz, radius, fields,
+                   total, f"{stats.min():.6e}", f"{tm:.6e}",
+                   f"{(total / tm if tm else 0):.6e}"))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--x", type=int, default=256, help="per-device x size")
+    ap.add_argument("--y", type=int, default=256)
+    ap.add_argument("--z", type=int, default=256)
+    ap.add_argument("--radius", type=int, default=3)
+    ap.add_argument("--fields", type=int, default=1)
+    ap.add_argument("--iters", "-n", type=int, default=30)
+    add_method_flags(ap)
+    add_device_flags(ap)
+    args = ap.parse_args()
+    apply_device_flags(args)
+
+    import jax
+
+    from stencil_tpu.parallel.mesh import default_mesh_shape
+
+    mesh_shape = default_mesh_shape(len(jax.devices()))
+    run_exchange_bench("exchange_weak",
+                       args.x * mesh_shape.x, args.y * mesh_shape.y,
+                       args.z * mesh_shape.z, mesh_shape, args.radius,
+                       args.fields, args.iters, methods_from_args(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Weak-scaling Jacobi-3D heat demo.
+
+Reference parity: bin/jacobi3d.cu — the global grid is the per-device
+size scaled by the subdomain grid (weak scaling,
+bin/jacobi3d.cu:181-205); CSV result line
+``bin,methods,devices,x,y,z,bytes_x,bytes_y,bytes_z,min (s),trimean (s)``
+(schema analog of bin/jacobi3d.cu:383-392).
+"""
+
+import argparse
+
+from _common import (add_device_flags, apply_device_flags,
+                     add_method_flags, add_placement_flags, csv_line,
+                     methods_from_args, placement_from_args, timed_samples)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--x", type=int, default=128, help="per-device x size")
+    ap.add_argument("--y", type=int, default=128)
+    ap.add_argument("--z", type=int, default=128)
+    ap.add_argument("--iters", "-n", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=10,
+                    help="iterations per timing sample (fused loop)")
+    ap.add_argument("--prefix", default="", help="output prefix")
+    ap.add_argument("--paraview", action="store_true")
+    ap.add_argument("--period", type=int, default=0,
+                    help="paraview dump every N samples")
+    ap.add_argument("--f64", action="store_true")
+    add_method_flags(ap)
+    add_placement_flags(ap)
+    add_device_flags(ap)
+    args = ap.parse_args()
+    apply_device_flags(args)
+    if getattr(args, 'f64', False):
+        import jax
+        jax.config.update('jax_enable_x64', True)
+
+    import jax
+    import numpy as np
+
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.parallel.mesh import default_mesh_shape
+
+    ndev = len(jax.devices())
+    mesh_shape = default_mesh_shape(ndev)
+    # weak scaling: global = local x mesh (bin/jacobi3d.cu:181-205)
+    gx, gy, gz = (args.x * mesh_shape.x, args.y * mesh_shape.y,
+                  args.z * mesh_shape.z)
+    methods = methods_from_args(args)
+    j = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape,
+                 dtype=np.float64 if args.f64 else np.float32,
+                 methods=methods,
+                 placement=placement_from_args(args),
+                 output_prefix=args.prefix)
+    j.init()
+    if args.paraview:
+        j.dd.write_paraview(args.prefix + "jacobi3d_init")
+
+    samples = max(args.iters // args.batch, 1)
+    n = 0
+
+    def one():
+        nonlocal n
+        j.run(args.batch)
+        n += 1
+        if args.paraview and args.period and n % args.period == 0:
+            j.dd.write_paraview(f"{args.prefix}jacobi3d_{n}")
+
+    stats = timed_samples(one, j.block, samples)
+    b = j.dd.exchange_bytes_per_axis()
+    print(csv_line("jacobi3d", methods, ndev, gx, gy, gz,
+                   b["x"], b["y"], b["z"],
+                   f"{stats.min() / args.batch:.6e}",
+                   f"{stats.trimean() / args.batch:.6e}"))
+    if args.paraview:
+        j.dd.write_paraview(args.prefix + "jacobi3d_final")
+
+
+if __name__ == "__main__":
+    main()

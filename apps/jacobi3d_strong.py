@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Strong-scaling Jacobi-3D: fixed global size over all devices
+(reference: bin/jacobi3d_strong.cu)."""
+
+import argparse
+
+from _common import (add_device_flags, apply_device_flags,
+                     add_method_flags, add_placement_flags, csv_line,
+                     methods_from_args, placement_from_args, timed_samples)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--x", type=int, default=512, help="global x size")
+    ap.add_argument("--y", type=int, default=512)
+    ap.add_argument("--z", type=int, default=512)
+    ap.add_argument("--iters", "-n", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=10)
+    ap.add_argument("--f64", action="store_true")
+    add_method_flags(ap)
+    add_placement_flags(ap)
+    add_device_flags(ap)
+    args = ap.parse_args()
+    apply_device_flags(args)
+    if getattr(args, 'f64', False):
+        import jax
+        jax.config.update('jax_enable_x64', True)
+
+    import jax
+    import numpy as np
+
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    ndev = len(jax.devices())
+    methods = methods_from_args(args)
+    j = Jacobi3D(args.x, args.y, args.z,
+                 dtype=np.float64 if args.f64 else np.float32,
+                 methods=methods,
+                 placement=placement_from_args(args))
+    j.init()
+    samples = max(args.iters // args.batch, 1)
+    stats = timed_samples(lambda: j.run(args.batch), j.block, samples)
+    b = j.dd.exchange_bytes_per_axis()
+    print(csv_line("jacobi3d_strong", methods, ndev,
+                   args.x, args.y, args.z, b["x"], b["y"], b["z"],
+                   f"{stats.min() / args.batch:.6e}",
+                   f"{stats.trimean() / args.batch:.6e}"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Print the machine/mesh model: processes, devices, torus coords,
+memory — the analog of bin/machine_info.cu (nodes, ranks, GPUs by
+UUID via the Machine model, reference: include/stencil/machine.hpp)."""
+
+import sys
+
+from _common import csv_line  # noqa: F401  (path setup side effect)
+
+
+def main() -> None:
+    import jax
+
+    from stencil_tpu.parallel.mesh import default_mesh_shape, make_mesh
+
+    print(f"process {jax.process_index()} of {jax.process_count()}")
+    devs = jax.devices()
+    print(f"devices: {len(devs)} (local: {len(jax.local_devices())})")
+    for d in devs:
+        coords = getattr(d, "coords", None)
+        core = getattr(d, "core_on_chip", None)
+        mem = None
+        try:
+            stats = d.memory_stats()
+            if stats:
+                mem = f"{stats.get('bytes_limit', 0) / 2**30:.1f}GiB"
+        except Exception:
+            pass
+        print(f"  [{d.id}] {d.device_kind} platform={d.platform} "
+              f"process={d.process_index} coords={coords} core={core} "
+              f"mem={mem}")
+    shape = default_mesh_shape(len(devs))
+    mesh = make_mesh(shape)
+    print(f"default 3D mesh: {tuple(shape)} axes {mesh.axis_names}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Neighbor point-to-point bandwidth sweep over the device fabric.
+
+Reference parity: bin/pingpong.cu:19-28 — message sizes 2^min..2^max
+bytes bounced between a device pair; here a ppermute ring shift between
+mesh neighbors (the ICI point-to-point path).
+"""
+
+import argparse
+import time
+
+from _common import add_device_flags, apply_device_flags, csv_line
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--min", type=int, default=10, help="log2 min bytes")
+    ap.add_argument("--max", type=int, default=24, help="log2 max bytes")
+    ap.add_argument("--iters", "-n", type=int, default=20)
+    add_device_flags(ap)
+    args = ap.parse_args()
+    apply_device_flags(args)
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from stencil_tpu.numerics import Statistics
+    from stencil_tpu.utils.timers import device_sync
+
+    devs = jax.devices()
+    n = len(devs)
+    if n < 2:
+        print("pingpong: need >= 2 devices; have", n)
+        return
+    mesh = jax.make_mesh((n,), ("x",))
+    spec = P("x")
+
+    def shift(x):
+        return lax.ppermute(x, "x", [(i, (i + 1) % n) for i in range(n)])
+
+    sm = jax.jit(jax.shard_map(shift, mesh=mesh, in_specs=spec,
+                               out_specs=spec, check_vma=False))
+
+    print(csv_line("pingpong", "bytes_per_dev", "trimean_s", "GBps_per_dev"))
+    for p in range(args.min, args.max + 1):
+        nbytes = 1 << p
+        elems = max(nbytes // 4, 1) * n
+        x = jnp.zeros((elems,), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, spec))
+        y = sm(x)
+        device_sync(y)
+        stats = Statistics()
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            y = sm(y)
+            device_sync(y)
+            stats.insert(time.perf_counter() - t0)
+        tm = stats.trimean()
+        print(csv_line("pingpong", nbytes, f"{tm:.6e}",
+                       f"{nbytes / tm / 1e9:.3f}"))
+
+
+if __name__ == "__main__":
+    main()

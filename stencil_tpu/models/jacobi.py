@@ -29,8 +29,7 @@ from ..distributed import DistributedDomain
 from ..geometry import Dim3, Dim3Like, Radius
 from ..local_domain import raw_size, zyx_shape
 from ..ops.stencil_kernels import global_coords, jacobi7, write_interior
-from ..parallel.exchange import (exchange_shard, exchange_shard_allgather,
-                                 exchange_shard_packed)
+from ..parallel.exchange import dispatch_exchange
 from ..parallel.mesh import mesh_dim
 from ..parallel.methods import Method, pick_method
 
@@ -48,12 +47,7 @@ def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
     cold_c = Dim3(gsize.x * 2 // 3, gsize.y // 2, gsize.z // 2)
     sph_r = gsize.x // 10
 
-    if method == Method.PpermutePacked:
-        p = exchange_shard_packed({"temp": p}, radius, counts)["temp"]
-    elif method == Method.AllGather:
-        p = exchange_shard_allgather(p, radius, counts)
-    else:
-        p = exchange_shard(p, radius, counts)
+    p = dispatch_exchange({"temp": p}, radius, counts, method)["temp"]
     new = jacobi7(p, radius, local)
     gz, gy, gx = global_coords(origin_xyz, local)
 
@@ -74,10 +68,15 @@ class Jacobi3D:
                  mesh_shape: Optional[Dim3Like] = None,
                  dtype=jnp.float32,
                  devices: Optional[Sequence] = None,
-                 methods: Method = Method.Default) -> None:
+                 methods: Method = Method.Default,
+                 placement=None, output_prefix: str = "") -> None:
         self.dd = DistributedDomain(x, y, z, devices=devices)
         self.dd.set_radius(1)
         self.dd.set_methods(methods)
+        if placement is not None:
+            self.dd.set_placement(placement)
+        if output_prefix:
+            self.dd.set_output_prefix(output_prefix)
         if mesh_shape is not None:
             self.dd.set_mesh_shape(mesh_shape)
         self.dd.add_data("temp", dtype)

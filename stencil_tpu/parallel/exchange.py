@@ -220,6 +220,22 @@ def _single_axis_radius(radius: Radius, axis: int) -> Radius:
     return r
 
 
+def dispatch_exchange(fields: Dict[str, jnp.ndarray], radius: Radius,
+                      mesh_counts: Dim3, method: Method,
+                      axis_order: Tuple[int, ...] = (0, 1, 2)
+                      ) -> Dict[str, jnp.ndarray]:
+    """Route a multi-quantity shard exchange to the selected strategy —
+    the single dispatch point shared by the orchestrator and the fused
+    model steps (the Method-routing analog of src/stencil.cu:371-458)."""
+    if method == Method.PpermutePacked:
+        return exchange_shard_packed(fields, radius, mesh_counts, axis_order)
+    if method == Method.AllGather:
+        return {k: exchange_shard_allgather(v, radius, mesh_counts, axis_order)
+                for k, v in fields.items()}
+    return {k: exchange_shard(v, radius, mesh_counts, axis_order)
+            for k, v in fields.items()}
+
+
 def make_exchange(mesh: Mesh, radius: Radius,
                   methods: Method = Method.Default,
                   axis_order: Tuple[int, ...] = (0, 1, 2)):
@@ -237,13 +253,7 @@ def make_exchange(mesh: Mesh, radius: Radius,
     spec = P("z", "y", "x")
 
     def shard_fn(fields: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-        if method == Method.PpermutePacked:
-            return exchange_shard_packed(fields, radius, counts, axis_order)
-        if method == Method.AllGather:
-            return {k: exchange_shard_allgather(v, radius, counts, axis_order)
-                    for k, v in fields.items()}
-        return {k: exchange_shard(v, radius, counts, axis_order)
-                for k, v in fields.items()}
+        return dispatch_exchange(fields, radius, counts, method, axis_order)
 
     sm = jax.shard_map(shard_fn, mesh=mesh,
                        in_specs=spec, out_specs=spec, check_vma=False)
