@@ -39,6 +39,8 @@ def add_method_flags(p: argparse.ArgumentParser) -> None:
                    help="pack all quantities per direction into one buffer")
     p.add_argument("--allgather", action="store_true",
                    help="all-gather control strategy")
+    p.add_argument("--pallas-dma", action="store_true",
+                   help="explicit inter-chip RDMA (Pallas) exchange")
 
 
 def methods_from_args(args) -> Method:
@@ -49,6 +51,8 @@ def methods_from_args(args) -> Method:
         m |= Method.PpermutePacked
     if getattr(args, "allgather", False):
         m |= Method.AllGather
+    if getattr(args, "pallas_dma", False):
+        m |= Method.PallasDMA
     return m if m != Method.NONE else Method.Default
 
 

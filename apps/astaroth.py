@@ -24,6 +24,17 @@ def main() -> None:
     ap.add_argument("--paraview-init", action="store_true")
     ap.add_argument("--paraview-final", action="store_true")
     ap.add_argument("--prefix", default="")
+    ap.add_argument("--overlap", action="store_true",
+                    help="interior/exterior comm-compute overlap per substep")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="checkpoint directory (the working AC_start_step "
+                         "analog — the reference's conf knob is never "
+                         "restored, astaroth/astaroth.conf:36-38)")
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="save every N iterations (0: only at exit)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from the latest checkpoint in "
+                         "--checkpoint-dir")
     add_method_flags(ap)
     add_device_flags(ap)
     args = ap.parse_args()
@@ -46,12 +57,37 @@ def main() -> None:
     gz = args.nz * mesh_shape.z
     m = Astaroth(gx, gy, gz, params=prm, mesh_shape=mesh_shape,
                  dtype=np.float64 if args.f64 else np.float32,
-                 methods=methods_from_args(args))
+                 methods=methods_from_args(args), overlap=args.overlap)
     m.init()
+    start_iter = 0
+    if args.checkpoint_dir and args.resume:
+        from stencil_tpu.utils.checkpoint import restore_domain
+        start_iter, extra = restore_domain(m.dd, args.checkpoint_dir)
+        if extra:
+            m._w = extra
+        print(f"# resumed from step {start_iter}")
     if args.paraview_init:
         m.dd.write_paraview(args.prefix + "init")
 
-    stats = timed_samples(m.step, m.block, args.iters)
+    if args.checkpoint_dir and args.checkpoint_every:
+        from stencil_tpu.utils.checkpoint import save_domain
+
+        it = start_iter
+
+        def step_ckpt():
+            nonlocal it
+            m.step()
+            it += 1
+            if it % args.checkpoint_every == 0:
+                save_domain(m.dd, args.checkpoint_dir, it, extra=m._w)
+
+        stats = timed_samples(step_ckpt, m.block, args.iters)
+    else:
+        stats = timed_samples(m.step, m.block, args.iters)
+    if args.checkpoint_dir:
+        from stencil_tpu.utils.checkpoint import save_domain
+        save_domain(m.dd, args.checkpoint_dir,
+                    start_iter + args.iters + 2, extra=m._w)
 
     # exchange-only timing (3 exchanges per iteration); warm the
     # standalone exchange program first so compile time is excluded

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Exchange-strategy comparison sweep.
+
+Reference parity: bin/bench_alltoallv.cu (compares exchange patterns
+over a comm matrix) + bin/bench_mpi_pack.cu (pack-kernel+contiguous
+send vs MPI derived datatypes). The TPU analog sweeps every exchange
+Method on one configuration — per-quantity slab ppermute vs packed
+single-buffer ppermute vs all-gather vs explicit Pallas RDMA — and
+reports trimean seconds and B/s for each, one CSV line per method.
+"""
+
+import argparse
+
+from _common import (add_device_flags, apply_device_flags, csv_line,
+                     timed_samples)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--x", type=int, default=64, help="per-device x size")
+    ap.add_argument("--y", type=int, default=64)
+    ap.add_argument("--z", type=int, default=64)
+    ap.add_argument("--radius", "-r", type=int, default=2)
+    ap.add_argument("--fields", type=int, default=4)
+    ap.add_argument("--iters", "-n", type=int, default=20)
+    add_device_flags(ap)
+    args = ap.parse_args()
+    apply_device_flags(args)
+
+    import jax
+    import numpy as np
+
+    from stencil_tpu.distributed import DistributedDomain
+    from stencil_tpu.parallel.mesh import default_mesh_shape
+    from stencil_tpu.parallel.methods import Method
+    from stencil_tpu.utils.timers import device_sync
+
+    ndev = len(jax.devices())
+    mesh_shape = default_mesh_shape(ndev)
+    for method in (Method.PpermuteSlab, Method.PpermutePacked,
+                   Method.AllGather, Method.PallasDMA):
+        dd = DistributedDomain(args.x * mesh_shape.x, args.y * mesh_shape.y,
+                               args.z * mesh_shape.z)
+        dd.set_mesh_shape(mesh_shape)
+        dd.set_radius(args.radius)
+        dd.set_methods(method)
+        for i in range(args.fields):
+            dd.add_data(f"q{i}", np.float32)
+        dd.realize()
+        stats = timed_samples(dd.exchange, lambda: device_sync(dd.curr),
+                              args.iters)
+        total = dd.exchange_bytes_total()
+        tm = stats.trimean()
+        print(csv_line("bench_methods", method, ndev,
+                       args.x, args.y, args.z, args.radius, args.fields,
+                       total, f"{tm:.6e}", f"{total / tm:.6e}"))
+
+
+if __name__ == "__main__":
+    main()

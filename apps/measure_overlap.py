@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Comm/compute overlap study on the Jacobi-3D step.
+
+Reference parity: bin/measure_buf_exchange.cu (overlap study with a
+clock-spin kernel riding alongside the exchange). The TPU analog times
+four programs at the same size — exchange only, fused step (exchange +
+stencil in program order), overlapped step (interior split off the
+exchange's data dependencies) — and reports how much of the exchange
+the overlapped schedule hides:
+
+    overlap_efficiency = (t_fused - t_overlap) / t_exchange
+"""
+
+import argparse
+
+from _common import add_device_flags, apply_device_flags, csv_line, timed_samples
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--x", type=int, default=64, help="per-device x size")
+    ap.add_argument("--y", type=int, default=64)
+    ap.add_argument("--z", type=int, default=64)
+    ap.add_argument("--iters", "-n", type=int, default=20)
+    add_device_flags(ap)
+    args = ap.parse_args()
+    apply_device_flags(args)
+
+    import jax
+    import numpy as np
+
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.parallel.mesh import default_mesh_shape
+    from stencil_tpu.utils.timers import device_sync
+
+    ndev = len(jax.devices())
+    mesh_shape = default_mesh_shape(ndev)
+    gx, gy, gz = (args.x * mesh_shape.x, args.y * mesh_shape.y,
+                  args.z * mesh_shape.z)
+
+    results = {}
+    fused = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape, dtype=np.float32)
+    fused.init()
+    stats = timed_samples(fused.step, fused.block, args.iters)
+    results["fused"] = stats.trimean()
+
+    dd = fused.dd
+    stats = timed_samples(dd.exchange, lambda: device_sync(dd.curr),
+                          args.iters)
+    results["exchange_only"] = stats.trimean()
+
+    over = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape, dtype=np.float32,
+                    overlap=True)
+    over.init()
+    stats = timed_samples(over.step, over.block, args.iters)
+    results["overlap"] = stats.trimean()
+
+    hidden = results["fused"] - results["overlap"]
+    eff = hidden / results["exchange_only"] if results["exchange_only"] else 0.0
+    print(csv_line("measure_overlap", ndev, gx, gy, gz,
+                   f"{results['exchange_only']:.6e}",
+                   f"{results['fused']:.6e}",
+                   f"{results['overlap']:.6e}",
+                   f"{eff:.3f}"))
+
+
+if __name__ == "__main__":
+    main()

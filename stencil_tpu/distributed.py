@@ -38,6 +38,7 @@ from .local_domain import (LocalDomain, get_exterior as _dom_exterior,
 from .parallel.exchange import exchanged_bytes_per_sweep, make_exchange
 from .parallel.mesh import make_mesh, mesh_dim
 from .parallel.methods import Method, pick_method
+from .numerics import div_ceil
 from .partition import RankPartition, partition_dims_even
 from .placement import Placement, PlacementStrategy, make_placement
 from .topology import Boundary, Topology
@@ -64,6 +65,7 @@ class DistributedDomain:
         self.placement: Optional[Placement] = None
         self.topology: Optional[Topology] = None
         self.local_size: Optional[Dim3] = None
+        self.rem = Dim3(0, 0, 0)
         self.curr: Dict[str, jnp.ndarray] = {}
         self.next_: Dict[str, jnp.ndarray] = {}
         self._exchange_fn = None
@@ -127,18 +129,33 @@ class DistributedDomain:
             dim = self._mesh_shape
             if dim.flatten() != n:
                 raise ValueError(f"mesh shape {dim} != device count {n}")
-            if self.size % dim != Dim3(0, 0, 0):
-                raise ValueError(f"grid {self.size} not divisible by mesh {dim}")
         else:
-            dim = partition_dims_even(self.size, n)
+            try:
+                dim = partition_dims_even(self.size, n)
+            except ValueError:
+                # no exact factorization: fall back to the reference's
+                # greedy split with +-1 remainder subdomains
+                dim = RankPartition(self.size, n).dim()
         part = RankPartition.from_dim(self.size, dim)
-        self.local_size = self.size // dim
-        if self.local_size.any_lt(1):
-            raise ValueError(f"zero-extent subdomains: {self.local_size}")
-        if any(self.local_size[a] < self.radius.face(a, 1) or
-               self.local_size[a] < self.radius.face(a, -1)
+        # per-shard capacity = ceil sizes; uneven shards are one short
+        # (reference: partition.hpp:55-69)
+        self.local_size = Dim3(*(div_ceil(self.size[a], dim[a])
+                                 for a in range(3)))
+        self.rem = self.size % dim
+        if self.rem != Dim3(0, 0, 0) and pick_method(self.methods) != \
+                Method.PpermuteSlab:
+            raise NotImplementedError(
+                f"grid {self.size} over mesh {dim} has uneven (+-1) "
+                f"subdomains, supported only by Method.PpermuteSlab")
+        min_local = [self.local_size[a] - (1 if self.rem[a] else 0)
+                     for a in range(3)]
+        if any(m < 1 for m in min_local):
+            raise ValueError(f"zero-extent subdomains: grid {self.size} "
+                             f"over mesh {dim}")
+        if any(min_local[a] < self.radius.face(a, 1) or
+               min_local[a] < self.radius.face(a, -1)
                for a in range(3)):
-            raise ValueError(f"subdomain {self.local_size} smaller than "
+            raise ValueError(f"subdomain {min_local} smaller than "
                              f"radius {self.radius}")
         self.setup_seconds["partition"] = time.perf_counter() - t0
 
@@ -169,7 +186,8 @@ class DistributedDomain:
 
         # --- plan: build the exchange program --------------------------
         t0 = time.perf_counter()
-        self._exchange_fn = make_exchange(self.mesh, self.radius, self.methods)
+        self._exchange_fn = make_exchange(self.mesh, self.radius, self.methods,
+                                          rem=self.rem)
         counts = mesh_dim(self.mesh)
         self._bytes_per_axis = {"x": 0, "y": 0, "z": 0}
         for q in self._names:
@@ -287,20 +305,22 @@ class DistributedDomain:
         """Assemble the full global interior (z,y,x-ordered) on host by
         stripping per-shard halo padding."""
         dim = self.placement.dim()
-        local = self.local_size
-        pr = raw_size(local, self.radius)
+        pr = raw_size(self.local_size, self.radius)
         lo = self.radius.pad_lo()
         host = np.asarray(self.curr[name])
         out = np.empty(zyx_shape(self.size), dtype=host.dtype)
         for bz in range(dim.z):
             for by in range(dim.y):
                 for bx in range(dim.x):
-                    blk = host[bz * pr.z + lo.z: bz * pr.z + lo.z + local.z,
-                               by * pr.y + lo.y: by * pr.y + lo.y + local.y,
-                               bx * pr.x + lo.x: bx * pr.x + lo.x + local.x]
-                    out[bz * local.z:(bz + 1) * local.z,
-                        by * local.y:(by + 1) * local.y,
-                        bx * local.x:(bx + 1) * local.x] = blk
+                    idx = Dim3(bx, by, bz)
+                    sz = self.placement.subdomain_size(idx)
+                    org = self.placement.subdomain_origin(idx)
+                    blk = host[bz * pr.z + lo.z: bz * pr.z + lo.z + sz.z,
+                               by * pr.y + lo.y: by * pr.y + lo.y + sz.y,
+                               bx * pr.x + lo.x: bx * pr.x + lo.x + sz.x]
+                    out[org.z:org.z + sz.z,
+                        org.y:org.y + sz.y,
+                        org.x:org.x + sz.x] = blk
         return out
 
     def set_interior(self, name: str, values: np.ndarray) -> None:
@@ -308,19 +328,21 @@ class DistributedDomain:
         padded field (initial conditions)."""
         assert tuple(values.shape) == zyx_shape(self.size)
         dim = self.placement.dim()
-        local = self.local_size
-        pr = raw_size(local, self.radius)
+        pr = raw_size(self.local_size, self.radius)
         lo = self.radius.pad_lo()
         host = np.zeros(zyx_shape(pr * dim), dtype=self._dtypes[name])
         for bz in range(dim.z):
             for by in range(dim.y):
                 for bx in range(dim.x):
-                    host[bz * pr.z + lo.z: bz * pr.z + lo.z + local.z,
-                         by * pr.y + lo.y: by * pr.y + lo.y + local.y,
-                         bx * pr.x + lo.x: bx * pr.x + lo.x + local.x] = \
-                        values[bz * local.z:(bz + 1) * local.z,
-                               by * local.y:(by + 1) * local.y,
-                               bx * local.x:(bx + 1) * local.x]
+                    idx = Dim3(bx, by, bz)
+                    sz = self.placement.subdomain_size(idx)
+                    org = self.placement.subdomain_origin(idx)
+                    host[bz * pr.z + lo.z: bz * pr.z + lo.z + sz.z,
+                         by * pr.y + lo.y: by * pr.y + lo.y + sz.y,
+                         bx * pr.x + lo.x: bx * pr.x + lo.x + sz.x] = \
+                        values[org.z:org.z + sz.z,
+                               org.y:org.y + sz.y,
+                               org.x:org.x + sz.x]
         sharding = NamedSharding(self.mesh, P("z", "y", "x"))
         self.curr[name] = jax.device_put(jnp.asarray(host), sharding)
 
@@ -328,16 +350,15 @@ class DistributedDomain:
         """CSV dumps, one file per subdomain, rows ``Z,Y,X,q0,...``
         (reference: src/stencil.cu:1188-1264)."""
         interiors = {q: self.interior_to_host(q) for q in self._names}
-        dim = self.placement.dim()
-        local = self.local_size
         for i in range(self.num_subdomains()):
             idx = self.placement.part.dimensionize(i)
             org = self.placement.subdomain_origin(idx)
+            sz = self.placement.subdomain_size(idx)
             with open(f"{prefix}{i}.txt", "w") as f:
                 f.write("Z,Y,X," + ",".join(self._names) + "\n")
-                for lz in range(local.z):
-                    for ly in range(local.y):
-                        for lx in range(local.x):
+                for lz in range(sz.z):
+                    for ly in range(sz.y):
+                        for lx in range(sz.x):
                             gz, gy, gx = org.z + lz, org.y + ly, org.x + lx
                             vals = ",".join(
                                 repr(interiors[q][gz, gy, gx])

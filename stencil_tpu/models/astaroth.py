@@ -213,7 +213,8 @@ class Astaroth:
                  mesh_shape: Optional[Dim3Like] = None,
                  dtype=jnp.float32,
                  devices: Optional[Sequence] = None,
-                 methods: Method = Method.PpermutePacked) -> None:
+                 methods: Method = Method.PpermutePacked,
+                 overlap: bool = False) -> None:
         self.prm = params or MhdParams()
         self.dd = DistributedDomain(nx, ny, nz, devices=devices)
         self.dd.set_radius(Radius.constant(RADIUS))
@@ -224,6 +225,7 @@ class Astaroth:
             self.dd.add_data(q, dtype)
         self.dd.realize()
         self._dtype = np.dtype(dtype)
+        self._overlap = overlap
         # RK3 accumulators (interior-shaped, no halos)
         self._w: Optional[Dict[str, jnp.ndarray]] = None
         self._build_step()
@@ -260,8 +262,11 @@ class Astaroth:
         method = pick_method(dd.methods)
         dt = prm.dt
 
-        def substep(fields, w, s):
-            fields = dispatch_exchange(fields, radius, counts, method)
+        rem = dd.rem
+
+        def substep_fused(fields, w, s):
+            fields = dispatch_exchange(fields, radius, counts, method,
+                                       rem=rem)
             data = {q: FieldData(fields[q], inv_ds, pad_lo, local)
                     for q in FIELDS}
             rates = mhd_rates(data, prm, self._dtype)
@@ -277,6 +282,43 @@ class Astaroth:
                 new_f[q] = lax.dynamic_update_slice(
                     fields[q], uq, (pad_lo.z, pad_lo.y, pad_lo.x))
             return new_f, new_w
+
+        def substep_overlap(fields, w, s):
+            """Interior rates overlap the exchange (the reference's
+            per-substep interior/exchange/exterior choreography,
+            astaroth/astaroth.cu:552-646, as one program)."""
+            from ..parallel.overlap import overlapped_update
+
+            alpha = jnp.asarray(RK3_ALPHA[s], self._dtype)
+            beta = jnp.asarray(RK3_BETA[s], self._dtype)
+            dt_ = jnp.asarray(dt, self._dtype)
+
+            def upd(blocks, dims, off):
+                data = {q: FieldData(blocks[q], inv_ds, pad_lo, dims)
+                        for q in FIELDS}
+                rates = mhd_rates(data, prm, self._dtype)
+                out = {}
+                for q in FIELDS:
+                    w_blk = lax.slice(
+                        w[q], (off[2], off[1], off[0]),
+                        (off[2] + dims.z, off[1] + dims.y, off[0] + dims.x))
+                    wq = alpha * w_blk + dt_ * rates[q]
+                    out[f"w:{q}"] = wq
+                    out[f"f:{q}"] = data[q].value + beta * wq
+                return out
+
+            fields_ex, parts = overlapped_update(fields, radius, counts,
+                                                 method, upd)
+            new_f = {q: lax.dynamic_update_slice(
+                fields_ex[q], parts[f"f:{q}"],
+                (pad_lo.z, pad_lo.y, pad_lo.x)) for q in FIELDS}
+            new_w = {q: parts[f"w:{q}"] for q in FIELDS}
+            return new_f, new_w
+
+        if self._overlap and rem != Dim3(0, 0, 0):
+            raise NotImplementedError("overlap mode requires an evenly "
+                                      "divisible grid")
+        substep = substep_overlap if self._overlap else substep_fused
 
         def shard_iter(fields, w):
             for s in range(3):

@@ -38,17 +38,33 @@ COLD_TEMP = 0.0  # reference: bin/jacobi3d.cu:11
 
 
 def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
-                      gsize: Dim3, origin_xyz, method: Method):
+                      gsize: Dim3, origin_xyz, method: Method,
+                      kernel: str = "xla", rem: Dim3 = Dim3(0, 0, 0)):
     """One fused Jacobi step on one shard: exchange + 7-point update +
     Dirichlet sphere sources. ``origin_xyz`` is the shard's global
     origin (traced axis_index-derived inside shard_map, or static
-    (0,0,0) single-chip). Shared by Jacobi3D and the driver entry."""
+    (0,0,0) single-chip). Shared by Jacobi3D and the driver entry.
+    ``kernel``: "xla" (fused slicing) or "pallas" (z-plane-pipelined
+    VMEM kernel, ops/pallas_stencil.py)."""
     hot_c = Dim3(gsize.x // 3, gsize.y // 2, gsize.z // 2)
     cold_c = Dim3(gsize.x * 2 // 3, gsize.y // 2, gsize.z // 2)
     sph_r = gsize.x // 10
 
-    p = dispatch_exchange({"temp": p}, radius, counts, method)["temp"]
-    new = jacobi7(p, radius, local)
+    p = dispatch_exchange({"temp": p}, radius, counts, method,
+                          rem=rem)["temp"]
+    if kernel == "pallas":
+        from ..ops.pallas_stencil import jacobi7_pallas
+        new = jacobi7_pallas(p, radius, local)
+    else:
+        new = jacobi7(p, radius, local)
+    new = _apply_sources(new, origin_xyz, local, hot_c, cold_c, sph_r)
+    return write_interior(p, new, radius)
+
+
+def _apply_sources(new, origin_xyz, local: Dim3, hot_c: Dim3, cold_c: Dim3,
+                   sph_r: int):
+    """Re-impose the Dirichlet hot/cold spheres
+    (reference: bin/jacobi3d.cu:40-63)."""
     gz, gy, gx = global_coords(origin_xyz, local)
 
     def dist2(c: Dim3):
@@ -58,7 +74,34 @@ def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
                     jnp.asarray(HOT_TEMP, new.dtype), new)
     new = jnp.where(dist2(cold_c) <= sph_r * sph_r,
                     jnp.asarray(COLD_TEMP, new.dtype), new)
-    return write_interior(p, new, radius)
+    return new
+
+
+def jacobi_shard_step_overlap(p, radius: Radius, counts: Dim3, local: Dim3,
+                              gsize: Dim3, origin_xyz, method: Method,
+                              kernel: str = "xla"):
+    """Overlapped variant of ``jacobi_shard_step``: the deep-interior
+    update is computed from pre-exchange owned data so XLA can schedule
+    it against the in-flight halo transfers; thin exterior shells are
+    computed after (the reference's interior-launch / exchange /
+    exterior-launch choreography, bin/jacobi3d.cu:296-377, as one
+    program)."""
+    from ..parallel.overlap import overlapped_update
+
+    hot_c = Dim3(gsize.x // 3, gsize.y // 2, gsize.z // 2)
+    cold_c = Dim3(gsize.x * 2 // 3, gsize.y // 2, gsize.z // 2)
+    sph_r = gsize.x // 10
+
+    def upd(blocks, dims, off):
+        blk = blocks["temp"]
+        if kernel == "pallas":
+            from ..ops.pallas_stencil import jacobi7_pallas
+            return {"temp": jacobi7_pallas(blk, radius, dims)}
+        return {"temp": jacobi7(blk, radius, dims)}
+
+    p_ex, new = overlapped_update({"temp": p}, radius, counts, method, upd)
+    out = _apply_sources(new["temp"], origin_xyz, local, hot_c, cold_c, sph_r)
+    return write_interior(p_ex["temp"], out, radius)
 
 
 class Jacobi3D:
@@ -69,7 +112,8 @@ class Jacobi3D:
                  dtype=jnp.float32,
                  devices: Optional[Sequence] = None,
                  methods: Method = Method.Default,
-                 placement=None, output_prefix: str = "") -> None:
+                 placement=None, output_prefix: str = "",
+                 kernel: str = "xla", overlap: bool = False) -> None:
         self.dd = DistributedDomain(x, y, z, devices=devices)
         self.dd.set_radius(1)
         self.dd.set_methods(methods)
@@ -82,6 +126,8 @@ class Jacobi3D:
         self.dd.add_data("temp", dtype)
         self.dd.realize()
         self._dtype = dtype
+        self._kernel = kernel
+        self._overlap = overlap
         self._build_step()
 
     # -- initial conditions (reference: bin/jacobi3d.cu:18-27) ---------
@@ -98,13 +144,22 @@ class Jacobi3D:
         local = dd.local_size
         gsize = dd.size
         method = pick_method(self.dd.methods)
+        kernel = self._kernel
+        rem = dd.rem
+        if self._overlap and rem != Dim3(0, 0, 0):
+            raise NotImplementedError("overlap mode requires an evenly "
+                                      "divisible grid")
+        step_fn = (jacobi_shard_step_overlap if self._overlap
+                   else jacobi_shard_step)
 
         def shard_step(p):
-            origin = (lax.axis_index("x") * local.x,
-                      lax.axis_index("y") * local.y,
-                      lax.axis_index("z") * local.z)
-            return jacobi_shard_step(p, radius, counts, local, gsize,
-                                     origin, method)
+            from ..parallel.exchange import shard_origin
+            origin = shard_origin(local, rem)
+            if self._overlap:
+                return step_fn(p, radius, counts, local, gsize,
+                               origin, method, kernel)
+            return step_fn(p, radius, counts, local, gsize,
+                           origin, method, kernel, rem)
 
         spec = P("z", "y", "x")
         sm = jax.shard_map(shard_step, mesh=dd.mesh, in_specs=spec,

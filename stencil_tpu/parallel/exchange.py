@@ -69,16 +69,49 @@ def _shift_from_minus(block, axis_name: str, n: int):
     return lax.ppermute(block, axis_name, [(i, (i + 1) % n) for i in range(n)])
 
 
+def shard_interior_len(axis: int, capacity: int, rem: Dim3):
+    """This shard's actual interior extent along ``axis``: the +-1
+    remainder rule (reference: partition.hpp:55-69) as a traced value —
+    the first ``rem`` shards hold ``capacity`` points, the rest one
+    fewer. Static ``capacity`` when the axis divides evenly."""
+    r = rem[axis]
+    if r == 0:
+        return capacity
+    i = lax.axis_index(AXIS_NAME[axis])
+    return jnp.int32(capacity) - (i >= jnp.int32(r)).astype(jnp.int32)
+
+
+def shard_origin(local: Dim3, rem: Dim3) -> Tuple:
+    """Traced (ox, oy, oz) global origin of this shard's interior
+    (reference: partition.hpp:71-86 _remainder_origin), valid inside
+    shard_map. ``local`` is the per-shard capacity (ceil sizes)."""
+    out = []
+    for a in range(3):
+        i = lax.axis_index(AXIS_NAME[a])
+        o = i * jnp.int32(local[a])
+        if rem[a] != 0:
+            o = o - jnp.maximum(i - jnp.int32(rem[a]), jnp.int32(0))
+        out.append(o)
+    return tuple(out)
+
+
 def exchange_shard(arr: jnp.ndarray, radius: Radius,
                    mesh_counts: Dim3,
-                   axis_order: Tuple[int, ...] = (0, 1, 2)) -> jnp.ndarray:
+                   axis_order: Tuple[int, ...] = (0, 1, 2),
+                   rem: Dim3 = Dim3(0, 0, 0)) -> jnp.ndarray:
     """Fill all halo regions of one padded shard via sequential axis
     sweeps. Must be traced inside ``shard_map`` over mesh axes
     ('x','y','z') when the corresponding mesh_counts entry is > 1.
 
-    ``arr``: padded (z,y,x) block; interior extent along grid axis a is
-    ``arr.shape[AXIS_TO_DIM[a]] - r_lo - r_hi``.
+    ``arr``: padded (z,y,x) block; interior *capacity* along grid axis a
+    is ``arr.shape[AXIS_TO_DIM[a]] - r_lo - r_hi``.
     ``mesh_counts``: subdomain count along each grid axis.
+    ``rem``: per-axis remainder counts for uneven (+-1) subdomains
+    (reference: partition.hpp:55-69). Shards allocate to the capacity;
+    a short shard's halo is placed immediately after its actual
+    interior (dynamic position), keeping interior+halo contiguous so
+    stencil reads stay static slices. The slack row at the top of a
+    short shard's allocation is dead space.
     """
     for a in axis_order:
         r_lo = radius.face(a, -1)
@@ -90,19 +123,20 @@ def exchange_shard(arr: jnp.ndarray, radius: Radius,
         n_dev = mesh_counts[a]
         alloc = arr.shape[dim]
         interior = alloc - r_lo - r_hi
+        # actual interior length of this shard (traced when uneven)
+        L = shard_interior_len(a, interior, rem)
 
-        # fill the hi-side halo [r_lo+interior, alloc): data lives at the
+        # fill the hi-side halo [r_lo+L, r_lo+L+r_hi): data lives at the
         # +a neighbor's interior lo edge [r_lo, r_lo + r_hi)
         if r_hi > 0:
             src = lax.slice_in_dim(arr, r_lo, r_lo + r_hi, axis=dim)
             recv = _shift_from_plus(src, name, n_dev)
-            arr = lax.dynamic_update_slice_in_dim(arr, recv, r_lo + interior,
+            arr = lax.dynamic_update_slice_in_dim(arr, recv, r_lo + L,
                                                   axis=dim)
         # fill the lo-side halo [0, r_lo): data lives at the -a
-        # neighbor's interior hi edge [r_lo+interior-r_lo, r_lo+interior)
+        # neighbor's interior hi edge [L, L + r_lo)
         if r_lo > 0:
-            src = lax.slice_in_dim(arr, r_lo + interior - r_lo,
-                                   r_lo + interior, axis=dim)
+            src = lax.dynamic_slice_in_dim(arr, L, r_lo, axis=dim)
             recv = _shift_from_minus(src, name, n_dev)
             arr = lax.dynamic_update_slice_in_dim(arr, recv, 0, axis=dim)
     return arr
@@ -222,23 +256,33 @@ def _single_axis_radius(radius: Radius, axis: int) -> Radius:
 
 def dispatch_exchange(fields: Dict[str, jnp.ndarray], radius: Radius,
                       mesh_counts: Dim3, method: Method,
-                      axis_order: Tuple[int, ...] = (0, 1, 2)
-                      ) -> Dict[str, jnp.ndarray]:
+                      axis_order: Tuple[int, ...] = (0, 1, 2),
+                      rem: Dim3 = Dim3(0, 0, 0)) -> Dict[str, jnp.ndarray]:
     """Route a multi-quantity shard exchange to the selected strategy —
     the single dispatch point shared by the orchestrator and the fused
     model steps (the Method-routing analog of src/stencil.cu:371-458)."""
+    uneven = rem != Dim3(0, 0, 0)
+    if uneven and method != Method.PpermuteSlab:
+        raise NotImplementedError(
+            f"uneven (+-1 remainder) subdomains are only supported by "
+            f"Method.PpermuteSlab, not {method}")
+    if method == Method.PallasDMA:
+        from .pallas_exchange import exchange_shard_pallas
+        return {k: exchange_shard_pallas(v, radius, mesh_counts, axis_order)
+                for k, v in fields.items()}
     if method == Method.PpermutePacked:
         return exchange_shard_packed(fields, radius, mesh_counts, axis_order)
     if method == Method.AllGather:
         return {k: exchange_shard_allgather(v, radius, mesh_counts, axis_order)
                 for k, v in fields.items()}
-    return {k: exchange_shard(v, radius, mesh_counts, axis_order)
+    return {k: exchange_shard(v, radius, mesh_counts, axis_order, rem)
             for k, v in fields.items()}
 
 
 def make_exchange(mesh: Mesh, radius: Radius,
                   methods: Method = Method.Default,
-                  axis_order: Tuple[int, ...] = (0, 1, 2)):
+                  axis_order: Tuple[int, ...] = (0, 1, 2),
+                  rem: Dim3 = Dim3(0, 0, 0)):
     """Build a jitted multi-quantity halo exchange over ``mesh``.
 
     Returns ``exchange(fields: dict[str, Array]) -> dict[str, Array]``
@@ -253,7 +297,8 @@ def make_exchange(mesh: Mesh, radius: Radius,
     spec = P("z", "y", "x")
 
     def shard_fn(fields: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
-        return dispatch_exchange(fields, radius, counts, method, axis_order)
+        return dispatch_exchange(fields, radius, counts, method, axis_order,
+                                 rem)
 
     sm = jax.shard_map(shard_fn, mesh=mesh,
                        in_specs=spec, out_specs=spec, check_vma=False)

@@ -45,13 +45,12 @@ def pick_method(methods: "Method") -> "Method":
     src/stencil.cu:371-458 — on TPU every pair rides the same ICI, so
     one strategy is picked globally).
 
-    PallasDMA is not implemented yet: selecting it alongside other
-    flags falls through to the next priority; selecting it alone raises.
+    PallasDMA (explicit inter-chip RDMA, parallel/pallas_exchange.py)
+    wins when requested — it is the opt-in manual-transport path, like
+    the reference's direct-write Colo* methods.
     """
-    for m in (Method.PpermutePacked, Method.PpermuteSlab, Method.AllGather):
+    for m in (Method.PallasDMA, Method.PpermutePacked, Method.PpermuteSlab,
+              Method.AllGather):
         if m in methods:
             return m
-    if Method.PallasDMA in methods:
-        raise NotImplementedError("Method.PallasDMA is not implemented yet; "
-                                  "combine with a ppermute method as fallback")
     raise ValueError(f"no usable method in {methods}")

@@ -1,0 +1,86 @@
+"""Profiling / tracing scopes (SURVEY.md section 5.1).
+
+The analog of the reference's NVTX ranges + named streams + stat
+reductions (reference: src/stencil.cu:311,1003-1080 nvtx ranges;
+timer.hpp/rt.hpp pass-through timers; STENCIL_SETUP_STATS /
+STENCIL_EXCHANGE_STATS barrier+MPI_Wtime+MPI_Reduce(MAX) aggregation,
+src/stencil.cu:36-48,1174-1181). On TPU: ``jax.named_scope`` labels ops
+in the XLA profile the way NVTX labels CUDA streams, and
+``jax.profiler`` produces the nsys-equivalent trace viewable in
+TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def scope(name: str) -> Iterator[None]:
+    """Label both traced ops (named_scope -> XLA metadata) and host
+    wall time (TraceAnnotation -> profiler timeline) — the NVTX range
+    analog."""
+    with jax.named_scope(name):
+        with jax.profiler.TraceAnnotation(name):
+            yield
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device+host profile to ``log_dir`` (the nsys recipe in
+    the reference README, README.md:96-135; view with TensorBoard or
+    Perfetto)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PhaseTimer:
+    """Named wall-clock phases with the max-over-processes reduction the
+    reference's setup stats use (single-process: identity)."""
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] = (self.seconds.get(name, 0.0)
+                                  + time.perf_counter() - t0)
+
+    def reduced(self) -> Dict[str, float]:
+        if jax.process_count() == 1:
+            return dict(self.seconds)
+        from jax.experimental import multihost_utils
+        import numpy as np
+        names = sorted(self.seconds)
+        vals = np.asarray([self.seconds[n] for n in names])
+        reduced = multihost_utils.process_allgather(vals).max(axis=0)
+        return dict(zip(names, reduced.tolist()))
+
+
+def setup_stats_report(dd) -> str:
+    """One-line setup-time report (the STENCIL_SETUP_STATS print,
+    reference: src/stencil.cu:205-236)."""
+    parts = [f"{k}={v:.6f}s" for k, v in dd.setup_seconds.items()]
+    return "setup: " + " ".join(parts)
+
+
+def exchange_stats_report(dd) -> str:
+    """Exchange-time report (STENCIL_EXCHANGE_STATS analog; requires
+    ``dd.enable_timing(True)``)."""
+    if not dd.exchange_seconds:
+        return "exchange: no samples (enable_timing first)"
+    from ..numerics import trimean
+    xs = dd.exchange_seconds
+    return (f"exchange: n={len(xs)} min={min(xs):.6e}s "
+            f"trimean={trimean(xs):.6e}s")

@@ -1,0 +1,82 @@
+"""Checkpoint/resume roundtrips (SURVEY.md section 5.4 modernization)."""
+
+import numpy as np
+import pytest
+
+from stencil_tpu.utils.checkpoint import restore_domain, save_domain
+
+
+def test_jacobi_checkpoint_resume(tmp_path):
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    n = 16
+    a = Jacobi3D(n, n, n, mesh_shape=(2, 2, 2), dtype=np.float32)
+    a.init()
+    a.step()
+    a.step()
+    save_domain(a.dd, str(tmp_path / "ckpt"), step=2)
+    a.step()
+    want = a.temperature()
+
+    b = Jacobi3D(n, n, n, mesh_shape=(2, 2, 2), dtype=np.float32)
+    step, extra = restore_domain(b.dd, str(tmp_path / "ckpt"))
+    assert step == 2
+    assert extra == {}
+    b.step()
+    np.testing.assert_array_equal(b.temperature(), want)
+
+
+def test_checkpoint_reshard_onto_different_mesh(tmp_path):
+    """Restore onto a different mesh decomposition: the elastic-resume
+    capability the reference lacks entirely (SURVEY.md section 5.3/5.4)."""
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    n = 16
+    a = Jacobi3D(n, n, n, mesh_shape=(2, 2, 2), dtype=np.float32)
+    a.init()
+    a.step()
+    save_domain(a.dd, str(tmp_path / "ckpt"), step=1)
+    a.step()
+    want = a.temperature()
+
+    b = Jacobi3D(n, n, n, mesh_shape=(8, 1, 1), dtype=np.float32)
+    step, _ = restore_domain(b.dd, str(tmp_path / "ckpt"))
+    assert step == 1
+    b.step()
+    np.testing.assert_allclose(b.temperature(), want, atol=1e-6)
+
+
+def test_checkpoint_rejects_mismatched_domain(tmp_path):
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    a = Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float32)
+    a.init()
+    save_domain(a.dd, str(tmp_path / "ckpt"), step=0)
+
+    b = Jacobi3D(32, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float32)
+    with pytest.raises(Exception):
+        restore_domain(b.dd, str(tmp_path / "ckpt"))
+
+
+def test_astaroth_checkpoint_with_accumulators(tmp_path):
+    from stencil_tpu.models.astaroth import Astaroth, MhdParams
+
+    prm = MhdParams()
+    a = Astaroth(16, 16, 16, params=prm, mesh_shape=(2, 2, 2),
+                 dtype=np.float64)
+    a.init()
+    a.step()
+    save_domain(a.dd, str(tmp_path / "ckpt"), step=1, extra=a._w)
+    a.step()
+    want = {q: a.field(q) for q in ("lnrho", "uux", "ss")}
+
+    b = Astaroth(16, 16, 16, params=prm, mesh_shape=(2, 2, 2),
+                 dtype=np.float64)
+    step, extra = restore_domain(b.dd, str(tmp_path / "ckpt"))
+    assert step == 1
+    assert set(extra) == set(a._w)
+    b._w = extra
+    b.step()
+    for q in want:
+        np.testing.assert_allclose(b.field(q), want[q], rtol=1e-12,
+                                   atol=1e-14)

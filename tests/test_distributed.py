@@ -177,8 +177,15 @@ def test_rejects_bad_configs():
     dd = DistributedDomain(7, 7, 7)
     dd.set_radius(1)
     dd.add_data("q", np.float32)
+    dd.realize()  # 7^3 over 8 devices: uneven (+-1) subdomains
+    assert dd.rem != (0, 0, 0)
+
+    dd = DistributedDomain(4, 4, 4)
+    dd.set_radius(1)
+    dd.add_data("q", np.float32)
+    dd.set_mesh_shape((2, 2, 1))  # 4 != 8 devices
     with pytest.raises(ValueError):
-        dd.realize()  # 7^3 not divisible over 8 devices
+        dd.realize()
 
     dd = DistributedDomain(8, 8, 8)
     dd.set_radius(8)  # radius larger than 4^3 subdomain

@@ -98,7 +98,8 @@ def mesh222():
 class TestExchangeOracle:
     @pytest.mark.parametrize("method", [Method.PpermuteSlab,
                                         Method.PpermutePacked,
-                                        Method.AllGather])
+                                        Method.AllGather,
+                                        Method.PallasDMA])
     def test_radius1_2x2x2(self, mesh222, method):
         gsize = Dim3(8, 8, 8)
         radius = Radius.constant(1)
@@ -127,6 +128,26 @@ class TestExchangeOracle:
         out = ex({"q": arr})["q"]
         # only face halos on padded sides exist; check full padded region
         check_halos(np.asarray(out), gsize, mesh222, radius)
+
+    def test_pallas_dma_radius2(self, mesh222):
+        gsize = Dim3(8, 8, 8)
+        radius = Radius.constant(2)
+        arr = make_padded_global(gsize, mesh222, radius)
+        ex = make_exchange(mesh222, radius, Method.PallasDMA)
+        out = ex({"q": arr})["q"]
+        check_halos(np.asarray(out), gsize, mesh222, radius)
+
+    def test_pallas_dma_asymmetric_1d(self):
+        # uncentered kernel over a deep 1D ring: +x 2, -x 1
+        mesh = make_mesh((8, 1, 1))
+        gsize = Dim3(16, 4, 4)
+        radius = Radius.constant(0)
+        radius.set_dir((1, 0, 0), 2)
+        radius.set_dir((-1, 0, 0), 1)
+        arr = make_padded_global(gsize, mesh, radius)
+        ex = make_exchange(mesh, radius, Method.PallasDMA)
+        out = ex({"q": arr})["q"]
+        check_halos(np.asarray(out), gsize, mesh, radius)
 
     def test_anisotropic_mesh_1d(self):
         mesh = make_mesh((8, 1, 1))

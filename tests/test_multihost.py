@@ -1,0 +1,90 @@
+"""Multi-slice (DCN-tier) mesh layout + profiling scope tests."""
+
+import jax
+import numpy as np
+import pytest
+
+from stencil_tpu.geometry import Dim3
+from stencil_tpu.parallel.mesh import mesh_dim
+from stencil_tpu.parallel.multihost import (dcn_bytes_per_exchange,
+                                            make_multihost_mesh,
+                                            slice_groups)
+
+
+def test_slice_groups_single_process():
+    groups = slice_groups()
+    assert sum(len(g) for g in groups) == len(jax.devices())
+
+
+def test_multihost_mesh_blocks_dcn_axis():
+    """With 2 fake slices of 4 devices, the z (DCN) axis must be blocked:
+    all subdomains with z-index 0 on slice 0, z-index 1 on slice 1."""
+    devs = jax.devices()[:8]
+    groups = [devs[:4], devs[4:]]
+    mesh = make_multihost_mesh((2, 2, 2), dcn_axis=2, groups=groups)
+    assert mesh_dim(mesh) == Dim3(2, 2, 2)
+    arr = mesh.devices  # indexed [x, y, z]
+    g0 = {d.id for d in devs[:4]}
+    for ix in range(2):
+        for iy in range(2):
+            assert arr[ix, iy, 0].id in g0
+            assert arr[ix, iy, 1].id not in g0
+
+
+def test_multihost_mesh_dcn_axis_x():
+    devs = jax.devices()[:8]
+    groups = [devs[:2], devs[2:4], devs[4:6], devs[6:]]
+    mesh = make_multihost_mesh((4, 2, 1), dcn_axis=0, groups=groups)
+    arr = mesh.devices
+    for ix in range(4):
+        grp = {d.id for d in groups[ix]}
+        for iy in range(2):
+            assert arr[ix, iy, 0].id in grp
+
+
+def test_multihost_mesh_validates():
+    devs = jax.devices()[:8]
+    groups = [devs[:4], devs[4:]]
+    with pytest.raises(ValueError):
+        make_multihost_mesh((1, 1, 8), dcn_axis=0, groups=groups)  # 1 % 2
+    with pytest.raises(ValueError):
+        make_multihost_mesh((2, 2, 2), dcn_axis=2,
+                            groups=[devs[:3], devs[3:]])
+
+
+def test_exchange_on_multihost_mesh_and_dcn_bytes():
+    """The ripple oracle still holds on a slice-blocked mesh, and the
+    DCN byte counter reports the designated axis."""
+    from stencil_tpu.distributed import DistributedDomain
+
+    devs = jax.devices()[:8]
+    groups = [devs[:4], devs[4:]]
+    mesh = make_multihost_mesh((2, 2, 2), dcn_axis=2, groups=groups)
+    order = [mesh.devices[ix, iy, iz]
+             for iz in range(2) for iy in range(2) for ix in range(2)]
+    dd = DistributedDomain(8, 8, 8, devices=order)
+    dd.set_mesh_shape((2, 2, 2))
+    dd.set_radius(1)
+    dd.add_data("q", np.float32)
+    dd.realize()
+    dd.exchange()
+    assert dcn_bytes_per_exchange(dd, dcn_axis=2) > 0
+
+
+def test_profiling_scopes_and_reports():
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.utils.profiling import (PhaseTimer, scope,
+                                             exchange_stats_report,
+                                             setup_stats_report)
+
+    pt = PhaseTimer()
+    with pt.phase("build"):
+        j = Jacobi3D(8, 8, 8, mesh_shape=(2, 2, 2), dtype=np.float32)
+    j.init()
+    with scope("jacobi-step"):
+        j.step()
+    assert pt.reduced()["build"] > 0
+    assert "partition" in setup_stats_report(j.dd)
+    j.dd.enable_timing(True)
+    j.dd.exchange()
+    assert "trimean" in exchange_stats_report(j.dd)

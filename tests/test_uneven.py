@@ -1,0 +1,169 @@
+"""Uneven (+-1 remainder) subdomain support.
+
+The reference supports non-divisible grids via +-1-sized subdomains
+(reference: partition.hpp:55-86; pinned by test_cpu_partition.cpp).
+XLA SPMD shards are equal-capacity, so short shards place their halo at
+a dynamic offset right after the actual interior; these tests pin the
+data-plane behavior against the dense oracle and a direct halo check.
+"""
+
+import numpy as np
+import pytest
+
+from stencil_tpu.distributed import DistributedDomain
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.local_domain import raw_size
+from stencil_tpu.parallel.methods import Method
+
+
+def ripple(x, y, z):
+    r = (3.0, 7.0, 1.0, 5.0)
+    return (x + r[x % 4]) + 10.0 * (y + r[y % 4]) + 100.0 * (z + r[z % 4])
+
+
+def _ripple_grid(size: Dim3) -> np.ndarray:
+    gx = np.arange(size.x)
+    gy = np.arange(size.y)
+    gz = np.arange(size.z)
+    rx = gx + np.asarray([3.0, 7.0, 1.0, 5.0])[gx % 4]
+    ry = gy + np.asarray([3.0, 7.0, 1.0, 5.0])[gy % 4]
+    rz = gz + np.asarray([3.0, 7.0, 1.0, 5.0])[gz % 4]
+    return (rz[:, None, None] * 100.0 + ry[None, :, None] * 10.0
+            + rx[None, None, :])
+
+
+def test_uneven_exchange_halos_match_wrap():
+    """9-point axis over 2 shards -> sizes 5 and 4; halos must hold the
+    periodic-wrap neighbor values at the dynamic positions."""
+    size = Dim3(9, 8, 8)
+    dd = DistributedDomain(size.x, size.y, size.z)
+    dd.set_mesh_shape((2, 2, 2))
+    dd.set_radius(1)
+    dd.add_data("q", np.float64)
+    dd.realize()
+    assert dd.rem == Dim3(1, 0, 0)
+    vals = _ripple_grid(size)
+    dd.set_interior("q", vals)
+    dd.exchange()
+
+    host = np.asarray(dd.curr["q"])
+    pr = raw_size(dd.local_size, dd.radius)
+    lo = dd.radius.pad_lo()
+    dim = dd.placement.dim()
+    bad = 0
+    for bz in range(dim.z):
+        for by in range(dim.y):
+            for bx in range(dim.x):
+                idx = Dim3(bx, by, bz)
+                sz = dd.placement.subdomain_size(idx)
+                org = dd.placement.subdomain_origin(idx)
+                blk = host[bz * pr.z:(bz + 1) * pr.z,
+                           by * pr.y:(by + 1) * pr.y,
+                           bx * pr.x:(bx + 1) * pr.x]
+                # x-axis lo halo [0, 1) and hi halo [lo.x+sz.x, +1)
+                for lz in range(sz.z):
+                    for ly in range(sz.y):
+                        gy, gz = org.y + ly, org.z + lz
+                        want_lo = ripple((org.x - 1) % size.x, gy, gz)
+                        got_lo = blk[lo.z + lz, lo.y + ly, 0]
+                        want_hi = ripple((org.x + sz.x) % size.x, gy, gz)
+                        got_hi = blk[lo.z + lz, lo.y + ly, lo.x + sz.x]
+                        bad += (got_lo != want_lo) + (got_hi != want_hi)
+    assert bad == 0
+
+
+@pytest.mark.parametrize("n", [17, 18])
+def test_uneven_jacobi_matches_dense_oracle(n):
+    """17^3 over a 2x2x2 mesh -> 9/8-point shards every axis; the
+    distributed solver must track the dense single-array reference
+    through steps (the strongest uneven-path test)."""
+    from stencil_tpu.models.jacobi import Jacobi3D, dense_reference_step
+
+    j = Jacobi3D(n, n, n, mesh_shape=(2, 2, 2), dtype=np.float64)
+    if n % 2:
+        assert j.dd.rem == Dim3(1, 1, 1)
+    j.init()
+    temp = j.temperature()
+    hot = (n // 3, n // 2, n // 2)
+    cold = (2 * n // 3, n // 2, n // 2)
+    for _ in range(3):
+        temp = dense_reference_step(temp, hot, cold, n // 10)
+        j.step()
+    np.testing.assert_allclose(j.temperature(), temp, rtol=1e-12, atol=1e-12)
+
+
+def test_uneven_rejects_unsupported_methods():
+    dd = DistributedDomain(9, 8, 8)
+    dd.set_mesh_shape((2, 2, 2))
+    dd.set_radius(1)
+    dd.set_methods(Method.PpermutePacked)
+    dd.add_data("q", np.float32)
+    with pytest.raises(NotImplementedError):
+        dd.realize()
+
+
+def test_auto_partition_falls_back_to_uneven():
+    """A prime grid over 8 devices has no exact factorization; realize
+    must fall back to the greedy +-1 split instead of failing."""
+    dd = DistributedDomain(17, 17, 17)
+    dd.set_radius(1)
+    dd.add_data("q", np.float32)
+    dd.realize()
+    assert dd.placement.dim().flatten() == 8
+    assert dd.rem != Dim3(0, 0, 0)
+    dd.exchange()
+
+
+def test_uneven_astaroth_matches_single_device():
+    """MHD on an uneven grid must match the 1-device run (regression:
+    substeps once dropped dd.rem, silently corrupting wrap halos)."""
+    import jax
+
+    from stencil_tpu.models.astaroth import Astaroth, MhdParams
+
+    prm = MhdParams()
+    multi = Astaroth(9, 8, 8, params=prm, mesh_shape=(2, 2, 2),
+                     dtype=np.float64, methods=Method.PpermuteSlab)
+    single = Astaroth(9, 8, 8, params=prm, mesh_shape=(1, 1, 1),
+                      dtype=np.float64, methods=Method.PpermuteSlab,
+                      devices=jax.devices()[:1])
+    multi.init()
+    single.init()
+    for _ in range(2):
+        multi.step()
+        single.step()
+    for q in ("lnrho", "uux", "ss", "ax"):
+        np.testing.assert_allclose(multi.field(q), single.field(q),
+                                   rtol=1e-12, atol=1e-13)
+
+
+def test_uneven_checkpoint_roundtrip(tmp_path):
+    """Checkpoints of uneven domains store the true dd.size interior
+    (regression: capacity-shaped extraction wrote unrestorable files)."""
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.utils.checkpoint import restore_domain, save_domain
+
+    a = Jacobi3D(9, 8, 8, mesh_shape=(2, 2, 2), dtype=np.float32)
+    a.init()
+    a.step()
+    save_domain(a.dd, str(tmp_path / "ck"), step=1)
+    a.step()
+    want = a.temperature()
+
+    b = Jacobi3D(9, 8, 8, mesh_shape=(2, 2, 2), dtype=np.float32)
+    step, _ = restore_domain(b.dd, str(tmp_path / "ck"))
+    assert step == 1
+    b.step()
+    np.testing.assert_allclose(b.temperature(), want, atol=1e-6)
+
+
+def test_uneven_set_get_roundtrip():
+    size = Dim3(10, 9, 11)
+    dd = DistributedDomain(size.x, size.y, size.z)
+    dd.set_mesh_shape((2, 2, 2))
+    dd.set_radius(1)
+    dd.add_data("q", np.float64)
+    dd.realize()
+    vals = _ripple_grid(size)
+    dd.set_interior("q", vals)
+    np.testing.assert_array_equal(dd.interior_to_host("q"), vals)
