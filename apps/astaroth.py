@@ -69,25 +69,25 @@ def main() -> None:
     if args.paraview_init:
         m.dd.write_paraview(args.prefix + "init")
 
-    if args.checkpoint_dir and args.checkpoint_every:
+    # count every iteration actually taken (warmups included) so saved
+    # step numbers always match the integrated state
+    it = start_iter
+    last_saved = None
+
+    def counted_step():
+        nonlocal it, last_saved
+        m.step()
+        it += 1
+        if (args.checkpoint_dir and args.checkpoint_every
+                and it % args.checkpoint_every == 0):
+            from stencil_tpu.utils.checkpoint import save_domain
+            save_domain(m.dd, args.checkpoint_dir, it, extra=m._w)
+            last_saved = it
+
+    stats = timed_samples(counted_step, m.block, args.iters)
+    if args.checkpoint_dir and last_saved != it:
         from stencil_tpu.utils.checkpoint import save_domain
-
-        it = start_iter
-
-        def step_ckpt():
-            nonlocal it
-            m.step()
-            it += 1
-            if it % args.checkpoint_every == 0:
-                save_domain(m.dd, args.checkpoint_dir, it, extra=m._w)
-
-        stats = timed_samples(step_ckpt, m.block, args.iters)
-    else:
-        stats = timed_samples(m.step, m.block, args.iters)
-    if args.checkpoint_dir:
-        from stencil_tpu.utils.checkpoint import save_domain
-        save_domain(m.dd, args.checkpoint_dir,
-                    start_iter + args.iters + 2, extra=m._w)
+        save_domain(m.dd, args.checkpoint_dir, it, extra=m._w)
 
     # exchange-only timing (3 exchanges per iteration); warm the
     # standalone exchange program first so compile time is excluded

@@ -33,15 +33,21 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> int:
     """Bring up the JAX distributed runtime (no-op when single-process
-    or already initialized). Returns the process index."""
-    if jax.process_count() > 1 or coordinator_address is None:
-        return jax.process_index()
-    try:
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
-    except RuntimeError:
-        pass  # already initialized
+    or already initialized). Returns the process index.
+
+    Must run before anything initializes the local XLA backend — do not
+    query devices/process_count first (that would initialize a
+    single-process backend and make distributed init fail)."""
+    if coordinator_address is not None:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
+        except RuntimeError as e:
+            # tolerate repeat calls only; surface real init failures
+            if "already" not in str(e).lower():
+                raise
     return jax.process_index()
 
 

@@ -50,11 +50,8 @@ def _axis_slice(ndim: int, dim: int, lo: int, hi: int) -> Tuple:
 
 
 def _interpret_mode():
-    try:
-        on_tpu = jax.default_backend() == "tpu"
-    except Exception:
-        on_tpu = False
-    return False if on_tpu else pltpu.InterpretParams()
+    from ..ops.pallas_stencil import on_tpu
+    return False if on_tpu() else pltpu.InterpretParams()
 
 
 def _exchange_axis_pallas(arr: jnp.ndarray, axis: int, r_lo: int, r_hi: int,

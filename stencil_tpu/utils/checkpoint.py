@@ -74,26 +74,14 @@ def restore_state(directory: str,
 # ----------------------------------------------------------------------
 # DistributedDomain integration
 # ----------------------------------------------------------------------
-def _interior_extract_fn(dd):
-    """Jitted global-padded -> global-interior view (device-side, stays
-    sharded): checkpoints are mesh-independent so they can be restored
-    onto a different decomposition."""
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
-
-    lo = dd.radius.pad_lo()
-    local = dd.local_size
-    spec = P("z", "y", "x")
-
-    def shard(p):
-        return lax.slice(p, (lo.z, lo.y, lo.x),
-                         (lo.z + local.z, lo.y + local.y, lo.x + local.x))
-
-    return jax.jit(jax.shard_map(shard, mesh=dd.mesh, in_specs=spec,
-                                 out_specs=spec, check_vma=False))
-
-
-def _interior_insert_fn(dd):
+def _interior_fns(dd):
+    """Jitted global-padded <-> global-interior converters (device-side,
+    stay sharded): checkpoints are mesh-independent so they can be
+    restored onto a different decomposition. Cached on the domain so
+    periodic checkpoints don't retrace/recompile every save."""
+    cached = getattr(dd, "_ckpt_interior_fns", None)
+    if cached is not None:
+        return cached
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
@@ -102,14 +90,22 @@ def _interior_insert_fn(dd):
     local = dd.local_size
     spec = P("z", "y", "x")
 
-    def shard(interior):
+    def extract_shard(p):
+        return lax.slice(p, (lo.z, lo.y, lo.x),
+                         (lo.z + local.z, lo.y + local.y, lo.x + local.x))
+
+    def insert_shard(interior):
         padded = jnp.zeros((local.z + lo.z + hi.z, local.y + lo.y + hi.y,
                             local.x + lo.x + hi.x), dtype=interior.dtype)
         return lax.dynamic_update_slice(padded, interior,
                                         (lo.z, lo.y, lo.x))
 
-    return jax.jit(jax.shard_map(shard, mesh=dd.mesh, in_specs=spec,
-                                 out_specs=spec, check_vma=False))
+    fns = tuple(
+        jax.jit(jax.shard_map(f, mesh=dd.mesh, in_specs=spec,
+                              out_specs=spec, check_vma=False))
+        for f in (extract_shard, insert_shard))
+    dd._ckpt_interior_fns = fns
+    return fns
 
 
 def domain_meta(dd) -> Dict[str, Any]:
@@ -128,7 +124,7 @@ def save_domain(dd, directory: str, step: int,
     arrays, e.g. RK accumulators) at ``step``."""
     from ..geometry import Dim3
     if dd.rem == Dim3(0, 0, 0):
-        extract = _interior_extract_fn(dd)
+        extract, _ = _interior_fns(dd)
         arrays = {q: extract(v) for q, v in dd.curr.items()}
     else:
         # uneven shards: per-shard interior extents differ, so the
@@ -163,22 +159,29 @@ def restore_domain(dd, directory: str, step: Optional[int] = None
         cur = dd.curr[q]
         targets[q] = jax.ShapeDtypeStruct(
             ishape, cur.dtype, sharding=repl if uneven else cur.sharding)
-    step_found = latest_step(directory) if step is None else step
-    if step_found is None:
-        raise FileNotFoundError(f"no checkpoint in {directory}")
-    # extras are described in the JSON meta record (saved alongside)
+    # one manager for step lookup, the meta probe, and the restore
     import orbax.checkpoint as ocp
     mgr = _manager(directory)
-    probe = mgr.restore(step_found,
-                        args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
-    mgr.close()
-    saved_meta = dict(probe["meta"] or {})
-    cur0 = dd.curr[dd._names[0]]
-    for k, desc in (saved_meta.get("extra") or {}).items():
-        targets[f"extra:{k}"] = jax.ShapeDtypeStruct(
-            tuple(desc["shape"]), jnp.dtype(desc["dtype"]),
-            sharding=cur0.sharding)
-    step_out, arrays, meta = restore_state(directory, targets, step_found)
+    try:
+        step_found = mgr.latest_step() if step is None else step
+        if step_found is None:
+            raise FileNotFoundError(f"no checkpoint in {directory}")
+        # extras are described in the JSON meta record (saved alongside)
+        probe = mgr.restore(
+            step_found, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+        saved_meta = dict(probe["meta"] or {})
+        cur0 = dd.curr[dd._names[0]]
+        for k, desc in (saved_meta.get("extra") or {}).items():
+            targets[f"extra:{k}"] = jax.ShapeDtypeStruct(
+                tuple(desc["shape"]), jnp.dtype(desc["dtype"]),
+                sharding=cur0.sharding)
+        out = mgr.restore(step_found, args=ocp.args.Composite(
+            state=ocp.args.StandardRestore(targets),
+            meta=ocp.args.JsonRestore()))
+    finally:
+        mgr.close()
+    step_out, arrays, meta = step_found, dict(out["state"]), dict(
+        out["meta"] or {})
     if meta.get("size") and list(dd.size) != meta["size"]:
         raise ValueError(f"checkpoint size {meta['size']} != domain "
                          f"{list(dd.size)}")
@@ -187,7 +190,7 @@ def restore_domain(dd, directory: str, step: Optional[int] = None
                          f"{list(dd._names)}")
     from ..geometry import Dim3
     if dd.rem == Dim3(0, 0, 0):
-        insert = _interior_insert_fn(dd)
+        _, insert = _interior_fns(dd)
         for q in dd._names:
             dd.curr[q] = insert(arrays[q])
     else:
