@@ -26,6 +26,11 @@ def main() -> None:
     ap.add_argument("--prefix", default="")
     ap.add_argument("--overlap", action="store_true",
                     help="interior/exterior comm-compute overlap per substep")
+    ap.add_argument("--kernel", default="auto",
+                    choices=("auto", "wrap", "xla"),
+                    help="compute path: fused Pallas megakernel (wrap, "
+                         "single-chip), XLA slicing (xla), or pick by "
+                         "hardware (auto)")
     ap.add_argument("--checkpoint-dir", default="",
                     help="checkpoint directory (the working AC_start_step "
                          "analog — the reference's conf knob is never "
@@ -57,7 +62,8 @@ def main() -> None:
     gz = args.nz * mesh_shape.z
     m = Astaroth(gx, gy, gz, params=prm, mesh_shape=mesh_shape,
                  dtype=np.float64 if args.f64 else np.float32,
-                 methods=methods_from_args(args), overlap=args.overlap)
+                 methods=methods_from_args(args), overlap=args.overlap,
+                 kernel=args.kernel)
     m.init()
     start_iter = 0
     if args.checkpoint_dir and args.resume:
@@ -81,16 +87,19 @@ def main() -> None:
         if (args.checkpoint_dir and args.checkpoint_every
                 and it % args.checkpoint_every == 0):
             from stencil_tpu.utils.checkpoint import save_domain
+            m.sync_domain()
             save_domain(m.dd, args.checkpoint_dir, it, extra=m._w)
             last_saved = it
 
     stats = timed_samples(counted_step, m.block, args.iters)
     if args.checkpoint_dir and last_saved != it:
         from stencil_tpu.utils.checkpoint import save_domain
+        m.sync_domain()
         save_domain(m.dd, args.checkpoint_dir, it, extra=m._w)
 
     # exchange-only timing (3 exchanges per iteration); warm the
     # standalone exchange program first so compile time is excluded
+    m.sync_domain()
     m.dd.exchange()
     m.block()
     m.dd.enable_timing(True)

@@ -126,6 +126,9 @@ class Jacobi3D:
         self.dd.add_data("temp", dtype)
         self.dd.realize()
         self._dtype = dtype
+        if kernel not in ("auto", "wrap", "xla", "pallas"):
+            raise ValueError(
+                f"kernel must be auto|wrap|xla|pallas, got {kernel!r}")
         self._kernel = kernel
         self._overlap = overlap
         self._build_step()

@@ -51,6 +51,22 @@ class TestDistributedParity:
 
 
 class TestStability:
+    def test_wrap_megakernel_matches_xla(self):
+        """The fused Pallas substep megakernel (ops/pallas_mhd.py,
+        single-chip fast path) against the slicing formulation."""
+        size = (16, 16, 16)
+        a = Astaroth(*size, mesh_shape=(1, 1, 1), dtype=np.float64,
+                     devices=jax.devices()[:1], kernel="xla")
+        b = Astaroth(*size, mesh_shape=(1, 1, 1), dtype=np.float64,
+                     devices=jax.devices()[:1], kernel="wrap")
+        for m in (a, b):
+            m.init()
+            m.step()
+            m.step()
+        for q in FIELDS:
+            np.testing.assert_allclose(b.field(q), a.field(q),
+                                       rtol=1e-11, atol=1e-13, err_msg=q)
+
     def test_fields_stay_finite(self):
         m = Astaroth(16, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float64)
         m.init()
