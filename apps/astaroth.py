@@ -27,10 +27,10 @@ def main() -> None:
     ap.add_argument("--overlap", action="store_true",
                     help="interior/exterior comm-compute overlap per substep")
     ap.add_argument("--kernel", default="auto",
-                    choices=("auto", "wrap", "xla"),
-                    help="compute path: fused Pallas megakernel (wrap, "
-                         "single-chip), XLA slicing (xla), or pick by "
-                         "hardware (auto)")
+                    choices=("auto", "wrap", "halo", "xla"),
+                    help="compute path: fused Pallas megakernel (wrap: "
+                         "single-chip; halo: multi-chip slab layout), "
+                         "XLA slicing (xla), or pick by hardware (auto)")
     ap.add_argument("--checkpoint-dir", default="",
                     help="checkpoint directory (the working AC_start_step "
                          "analog — the reference's conf knob is never "
@@ -52,11 +52,15 @@ def main() -> None:
     import numpy as np
 
     from stencil_tpu.models.astaroth import Astaroth, MhdParams
-    from stencil_tpu.parallel.mesh import default_mesh_shape
+    from stencil_tpu.parallel.mesh import (default_mesh_shape,
+                                           default_mesh_shape_xfree)
 
     prm = MhdParams.from_conf(args.conf) if args.conf else MhdParams()
     ndev = len(jax.devices())
-    mesh_shape = default_mesh_shape(ndev)
+    # halo-capable paths want the lane (x) axis unsharded
+    mesh_shape = (default_mesh_shape_xfree(ndev)
+                  if args.kernel in ("auto", "halo") and not args.overlap
+                  else default_mesh_shape(ndev))
     gx = args.nx * mesh_shape.x
     gy = args.ny * mesh_shape.y
     gz = args.nz * mesh_shape.z
@@ -68,6 +72,8 @@ def main() -> None:
     start_iter = 0
     if args.checkpoint_dir and args.resume:
         from stencil_tpu.utils.checkpoint import restore_domain
+        m.sync_domain()   # flush + drop the interior-resident cache so
+        # the restored dd.curr is what the next iteration extracts
         start_iter, extra = restore_domain(m.dd, args.checkpoint_dir)
         if extra:
             m._w = extra

@@ -28,6 +28,12 @@ def main() -> None:
     ap.add_argument("--period", type=int, default=0,
                     help="paraview dump every N samples")
     ap.add_argument("--f64", action="store_true")
+    ap.add_argument("--kernel", default="auto",
+                    choices=("auto", "wrap", "halo", "xla", "pallas"),
+                    help="compute path: fused Pallas (wrap: single-chip "
+                         "periodic; halo: multi-chip slab layout), XLA "
+                         "slicing (xla), padded-layout Pallas (pallas), "
+                         "or pick by hardware (auto)")
     add_method_flags(ap)
     add_placement_flags(ap)
     add_device_flags(ap)
@@ -41,10 +47,14 @@ def main() -> None:
     import numpy as np
 
     from stencil_tpu.models.jacobi import Jacobi3D
-    from stencil_tpu.parallel.mesh import default_mesh_shape
+    from stencil_tpu.parallel.mesh import (default_mesh_shape,
+                                           default_mesh_shape_xfree)
 
     ndev = len(jax.devices())
-    mesh_shape = default_mesh_shape(ndev)
+    # halo-capable paths want the lane (x) axis unsharded
+    mesh_shape = (default_mesh_shape_xfree(ndev)
+                  if args.kernel in ("auto", "halo")
+                  else default_mesh_shape(ndev))
     # weak scaling: global = local x mesh (bin/jacobi3d.cu:181-205)
     gx, gy, gz = (args.x * mesh_shape.x, args.y * mesh_shape.y,
                   args.z * mesh_shape.z)
@@ -53,7 +63,7 @@ def main() -> None:
                  dtype=np.float64 if args.f64 else np.float32,
                  methods=methods,
                  placement=placement_from_args(args),
-                 output_prefix=args.prefix)
+                 output_prefix=args.prefix, kernel=args.kernel)
     j.init()
     if args.paraview:
         j.dd.write_paraview(args.prefix + "jacobi3d_init")

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Single-chip kernel A/B bench: wrap vs halo vs xla compute paths.
+
+Measures the fused-kernel iteration rate for Jacobi-3D (512^3 default)
+and the Astaroth MHD integrator (256^3 default) on the current backend,
+per kernel mode and block shape — the tuning harness behind the
+BASELINE.md single-chip numbers (reference's bench ethos:
+bin/jacobi3d.cu:383-392 CSV, trimean statistics).
+
+Usage: python scripts/bench_kernels.py [--model jacobi|mhd|both]
+       [--size N] [--iters N] [--kernels wrap,halo,xla] [--blocks ...]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_jacobi(size, iters, kernels, blocks):
+    import jax
+    import numpy as np
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.numerics import trimean
+
+    for kernel in kernels:
+        try:
+            j = Jacobi3D(size, size, size, mesh_shape=(1, 1, 1),
+                         devices=jax.devices()[:1], kernel=kernel)
+        except ValueError as e:
+            print(f"jacobi,{kernel},SKIP,{e}")
+            continue
+        if kernel in ("wrap", "halo") and blocks:
+            _patch_jacobi_blocks(j, kernel, blocks)
+        j.init()
+        j.run(5)
+        j.block()
+        window = max(iters // 4, 1)
+        rates = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            j.run(window)
+            j.block()
+            rates.append(window / (time.perf_counter() - t0))
+        print(f"jacobi,{kernel},{size},{trimean(rates):.2f} iters/s,"
+              f"min {min(rates):.2f},max {max(rates):.2f}")
+        del j
+
+
+def _patch_jacobi_blocks(j, kernel, blocks):
+    """Rebuild the step with explicit (bz, by) via functools.partial on
+    the kernel module entry (tuning hook, not a public knob)."""
+    import functools
+    from stencil_tpu.ops import pallas_halo, pallas_stencil
+
+    bz, by = blocks
+    if kernel == "wrap":
+        orig = pallas_stencil.jacobi7_wrap_pallas
+        pallas_stencil.jacobi7_wrap_pallas = functools.partial(
+            orig, block_z=bz, block_y=by)
+        j._build_wrap_step()
+        pallas_stencil.jacobi7_wrap_pallas = orig
+    else:
+        orig = pallas_halo.jacobi7_halo_pallas
+        pallas_halo.jacobi7_halo_pallas = functools.partial(
+            orig, block_z=bz, block_y=by)
+        j._build_halo_step()
+        pallas_halo.jacobi7_halo_pallas = orig
+
+
+def bench_mhd(size, iters, kernels, blocks):
+    import jax
+    import numpy as np
+    from stencil_tpu.models.astaroth import Astaroth
+    from stencil_tpu.numerics import trimean
+
+    for kernel in kernels:
+        try:
+            m = Astaroth(size, size, size, mesh_shape=(1, 1, 1),
+                         devices=jax.devices()[:1], kernel=kernel)
+        except ValueError as e:
+            print(f"mhd,{kernel},SKIP,{e}")
+            continue
+        if kernel in ("wrap", "halo") and blocks:
+            _patch_mhd_blocks(m, kernel, blocks)
+        m.init()
+        m.run(2)
+        m.block()
+        window = max(iters // 4, 1)
+        rates = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            m.run(window)
+            m.block()
+            rates.append(window / (time.perf_counter() - t0))
+        print(f"mhd,{kernel},{size},{trimean(rates):.2f} iters/s,"
+              f"min {min(rates):.2f},max {max(rates):.2f}")
+        del m
+
+
+def _patch_mhd_blocks(m, kernel, blocks):
+    import functools
+    from stencil_tpu.ops import pallas_mhd
+
+    bz, by = blocks
+    if kernel == "wrap":
+        orig = pallas_mhd.mhd_substep_wrap_pallas
+        pallas_mhd.mhd_substep_wrap_pallas = functools.partial(
+            orig, block_z=bz, block_y=by)
+        m._build_wrap_step()
+        pallas_mhd.mhd_substep_wrap_pallas = orig
+    else:
+        m._halo_blocks = (bz, by)
+        m._build_halo_step()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="both",
+                    choices=("jacobi", "mhd", "both"))
+    ap.add_argument("--size", type=int, default=0,
+                    help="cube edge (default 512 jacobi / 256 mhd)")
+    ap.add_argument("--iters", type=int, default=0)
+    ap.add_argument("--kernels", default="wrap,halo,xla")
+    ap.add_argument("--blocks", default="",
+                    help="bz,by override for pallas kernels")
+    args = ap.parse_args()
+    kernels = args.kernels.split(",")
+    blocks = (tuple(int(v) for v in args.blocks.split(","))
+              if args.blocks else None)
+
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    if args.model in ("jacobi", "both"):
+        size = args.size or (512 if on_tpu else 32)
+        iters = args.iters or (200 if on_tpu else 4)
+        bench_jacobi(size, iters, kernels, blocks)
+    if args.model in ("mhd", "both"):
+        size = args.size or (256 if on_tpu else 16)
+        iters = args.iters or (20 if on_tpu else 2)
+        bench_mhd(size, iters, kernels, blocks)
+
+
+if __name__ == "__main__":
+    main()

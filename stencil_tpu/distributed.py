@@ -73,6 +73,10 @@ class DistributedDomain:
         self.setup_seconds: Dict[str, float] = {}
         self.exchange_seconds: List[float] = []
         self._timing = False
+        # called (with the quantity name) BEFORE set_interior replaces a
+        # field, so models holding interior-resident caches can flush
+        # them first (models register via on_interior_write)
+        self._on_interior_write: List = []
 
     # ------------------------------------------------------------------
     # configuration (reference: stencil.hpp:134-158)
@@ -323,9 +327,17 @@ class DistributedDomain:
                         org.x:org.x + sz.x] = blk
         return out
 
+    def on_interior_write(self, cb) -> None:
+        """Register a callback invoked before ``set_interior`` writes —
+        the hook models use to keep interior-resident fast-path caches
+        coherent (flush-then-invalidate)."""
+        self._on_interior_write.append(cb)
+
     def set_interior(self, name: str, values: np.ndarray) -> None:
         """Scatter a global (z,y,x) interior array into the sharded
         padded field (initial conditions)."""
+        for cb in self._on_interior_write:
+            cb(name)
         assert tuple(values.shape) == zyx_shape(self.size)
         dim = self.placement.dim()
         pr = raw_size(self.local_size, self.radius)

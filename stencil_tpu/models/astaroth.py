@@ -222,16 +222,35 @@ class Astaroth:
         self.dd.set_methods(methods)
         if mesh_shape is not None:
             self.dd.set_mesh_shape(mesh_shape)
+        else:
+            from ..ops.pallas_stencil import on_tpu
+            if (len(self.dd._devices) > 1 and not overlap
+                    and (kernel == "halo"
+                         or (kernel == "auto" and on_tpu()))):
+                # prefer an x-unsharded decomposition so the fused halo
+                # megakernel path is available (ops/pallas_halo.py)
+                from ..partition import partition_dims_even_xfree
+                shape = partition_dims_even_xfree(
+                    Dim3(nx, ny, nz), len(self.dd._devices), align=8)
+                if shape is not None:
+                    self.dd.set_mesh_shape(shape)
         for q in FIELDS:
             self.dd.add_data(q, dtype)
         self.dd.realize()
         self._dtype = np.dtype(dtype)
         self._overlap = overlap
-        if kernel not in ("auto", "wrap", "xla"):
-            raise ValueError(f"kernel must be auto|wrap|xla, got {kernel!r}")
+        if kernel not in ("auto", "wrap", "halo", "xla"):
+            raise ValueError(
+                f"kernel must be auto|wrap|halo|xla, got {kernel!r}")
         self._kernel = kernel
         # RK3 accumulators (interior-shaped, no halos)
         self._w: Optional[Dict[str, jnp.ndarray]] = None
+        # interior-resident fast-path state (wrap/halo kernels); any
+        # external write to dd.curr must go through sync_domain() — the
+        # set_interior hook below keeps it coherent automatically
+        self._inner: Optional[Dict[str, jnp.ndarray]] = None
+        self._insert = None
+        self.dd.on_interior_write(lambda name: self.sync_domain())
         self._build_step()
 
     # -- initial conditions (reference: astaroth/astaroth.cu:509-528) --
@@ -325,19 +344,32 @@ class Astaroth:
         # single-chip fast path: the fused Pallas "solve" megakernel
         # with periodic wrap in-kernel (ops/pallas_mhd.py) — ~25x the
         # slicing formulation at 256^3
-        wrap_ok = (counts == Dim3(1, 1, 1) and rem == Dim3(0, 0, 0)
-                   and not self._overlap
+        aligned = (rem == Dim3(0, 0, 0) and not self._overlap
                    and local.z % 8 == 0 and local.y % 8 == 0)
+        wrap_ok = counts == Dim3(1, 1, 1) and aligned
+        # multi-device fast path: interior-resident shards + slab
+        # exchange + fused halo megakernel (ops/pallas_halo.py)
+        halo_ok = counts.x == 1 and aligned
         kernel = self._kernel
         if kernel == "auto":
             from ..ops.pallas_stencil import on_tpu
-            kernel = "wrap" if (wrap_ok and on_tpu()
-                                and self._dtype == np.float32) else "xla"
+            if on_tpu() and self._dtype == np.float32:
+                kernel = ("wrap" if wrap_ok
+                          else "halo" if halo_ok else "xla")
+            else:
+                kernel = "xla"
         if kernel == "wrap":
             if not wrap_ok:
                 raise ValueError("kernel='wrap' needs a (1,1,1) mesh, even "
                                  "grid, z/y multiples of 8, overlap off")
             self._build_wrap_step()
+            return
+        if kernel == "halo":
+            if not halo_ok:
+                raise ValueError("kernel='halo' needs an x-unsharded mesh, "
+                                 "even grid, local z/y multiples of 8, "
+                                 "overlap off")
+            self._build_halo_step()
             return
         substep = substep_overlap if self._overlap else substep_fused
 
@@ -406,29 +438,98 @@ class Astaroth:
         # loops would otherwise pay extract+insert (3 extra full-field
         # HBM passes) every iteration. dd.curr is materialized lazily
         # via sync_domain() when the padded domain is accessed.
-        self._wrap_inner: Optional[Dict[str, jnp.ndarray]] = None
-        self._wrap_extract = extract
-        self._wrap_insert = insert
+        self._insert = insert
+        self._install_inner_iter(extract, loop)
 
+    def _build_halo_step(self) -> None:
+        """Multi-device fused substeps: interior-resident shards, thin
+        slab ppermutes, one fused Pallas megakernel per substep — so an
+        N-chip mesh keeps single-chip per-chip throughput (the analog
+        of the reference's fused solve kernel running at every scale,
+        astaroth/astaroth.cu:552-646; see ops/pallas_halo.py).
+
+        Same extract / substep-loop / insert program split (and
+        interior-resident caching) as wrap mode, but each program is
+        shard_map'ped over the mesh."""
+        from ..ops.pallas_halo import (ESUB, R as HALO_R, mhd_halo_blocks,
+                                       mhd_substep_halo_pallas)
+        from ..parallel.exchange import exchange_interior_slabs
+
+        dd = self.dd
+        lo = dd.radius.pad_lo()
+        local = dd.local_size
+        counts = mesh_dim(dd.mesh)
+        prm = self.prm
+        dt = prm.dt
+        blk_z, blk_y = getattr(self, "_halo_blocks", None) or (8, 32)
+        bz, by = mhd_halo_blocks(local.z, local.y, blk_z, blk_y)
+        spec = P("z", "y", "x")
+        fields_spec = {q: spec for q in FIELDS}
+
+        def extract_shard(fields):
+            return {q: lax.slice(
+                p, (lo.z, lo.y, lo.x),
+                (lo.z + local.z, lo.y + local.y, lo.x + local.x))
+                for q, p in fields.items()}
+
+        extract = jax.jit(jax.shard_map(
+            extract_shard, mesh=dd.mesh, in_specs=(fields_spec,),
+            out_specs=fields_spec, check_vma=False))
+
+        def loop_shard(inner, w, n):
+            def body(_, fw):
+                f, wk = fw
+                for s in range(3):
+                    slabs = {q: exchange_interior_slabs(
+                        f[q], counts, rz=bz, ry=ESUB,
+                        radius_rows=HALO_R, y_z_extended=True)
+                        for q in FIELDS}
+                    f, wk = mhd_substep_halo_pallas(f, wk, slabs, s,
+                                                    prm, dt, block_z=bz,
+                                                    block_y=by)
+                return f, wk
+            return lax.fori_loop(0, n, body, (inner, w))
+
+        loop = jax.jit(jax.shard_map(
+            loop_shard, mesh=dd.mesh,
+            in_specs=(fields_spec, fields_spec, P()),
+            out_specs=(fields_spec, fields_spec), check_vma=False),
+            donate_argnums=(0, 1))
+
+        def insert_shard(fields, inner):
+            return {q: lax.dynamic_update_slice(
+                fields[q], inner[q], (lo.z, lo.y, lo.x))
+                for q in fields}
+
+        self._insert = jax.jit(jax.shard_map(
+            insert_shard, mesh=dd.mesh, in_specs=(fields_spec, fields_spec),
+            out_specs=fields_spec, check_vma=False), donate_argnums=0)
+        self._install_inner_iter(extract, loop)
+
+    def _install_inner_iter(self, extract, loop) -> None:
+        """Shared interior-resident iteration protocol for the wrap and
+        halo fast paths: ``self._inner`` caches the interior state
+        between calls; ``sync_domain()`` flushes it into ``dd.curr``
+        (and runs automatically before any ``dd.set_interior``)."""
         def iteration_n(fields, w, n):
-            inner = self._wrap_inner
+            inner = self._inner
             if inner is None:
                 inner = extract(fields)
             inner, w = loop(inner, w, n)
-            self._wrap_inner = dict(inner)
+            self._inner = dict(inner)
             return fields, w
 
         self._iter_n = iteration_n
         self._iter = lambda f, w: iteration_n(f, w, jnp.asarray(1, jnp.int32))
 
     def sync_domain(self) -> None:
-        """Materialize interior-resident wrap-mode state back into the
-        padded ``dd.curr`` fields (no-op otherwise). Required before
-        accessing ``self.dd`` directly (checkpoint, paraview)."""
-        if getattr(self, "_wrap_inner", None) is not None:
-            self.dd.curr = dict(self._wrap_insert(self.dd.curr,
-                                                  self._wrap_inner))
-            self._wrap_inner = None
+        """Materialize interior-resident fast-path state back into the
+        padded ``dd.curr`` fields (no-op otherwise). Runs automatically
+        before ``dd.set_interior`` writes (init, checkpoint restore);
+        call it manually before reading/writing ``dd.curr`` directly."""
+        if self._inner is not None:
+            self.dd.curr = dict(self._insert(self.dd.curr, self._inner))
+            self._inner = None
 
     def _ensure_w(self) -> None:
         if self._w is None:
@@ -456,14 +557,15 @@ class Astaroth:
 
     def block(self) -> None:
         from ..utils.timers import device_sync
-        inner = getattr(self, "_wrap_inner", None)
+        inner = self._inner
         device_sync(inner["lnrho"] if inner is not None
                     else self.dd.curr["lnrho"])
 
     def field(self, name: str) -> np.ndarray:
-        inner = getattr(self, "_wrap_inner", None)
+        inner = self._inner
         if inner is not None:
-            # wrap mode on one device: the interior array IS the global
+            # fast paths keep the interior resident: the cached array IS
+            # the (sharded) global interior, no halo stripping needed
             return np.asarray(inner[name])
         return self.dd.interior_to_host(name)
 

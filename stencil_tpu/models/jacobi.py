@@ -37,6 +37,15 @@ HOT_TEMP = 1.0   # reference: bin/jacobi3d.cu:12
 COLD_TEMP = 0.0  # reference: bin/jacobi3d.cu:11
 
 
+def sphere_geometry(gsize: Dim3):
+    """Hot/cold Dirichlet sphere centers and radius for a global grid
+    (reference: bin/jacobi3d.cu:255-260): hot at x/3, cold at 2x/3,
+    both mid-(y,z), radius x/10. Returns (hot_xyz, cold_xyz, r)."""
+    hot = Dim3(gsize.x // 3, gsize.y // 2, gsize.z // 2)
+    cold = Dim3(gsize.x * 2 // 3, gsize.y // 2, gsize.z // 2)
+    return hot, cold, gsize.x // 10
+
+
 def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
                       gsize: Dim3, origin_xyz, method: Method,
                       kernel: str = "xla", rem: Dim3 = Dim3(0, 0, 0)):
@@ -46,9 +55,7 @@ def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
     (0,0,0) single-chip). Shared by Jacobi3D and the driver entry.
     ``kernel``: "xla" (fused slicing) or "pallas" (z-plane-pipelined
     VMEM kernel, ops/pallas_stencil.py)."""
-    hot_c = Dim3(gsize.x // 3, gsize.y // 2, gsize.z // 2)
-    cold_c = Dim3(gsize.x * 2 // 3, gsize.y // 2, gsize.z // 2)
-    sph_r = gsize.x // 10
+    hot_c, cold_c, sph_r = sphere_geometry(gsize)
 
     p = dispatch_exchange({"temp": p}, radius, counts, method,
                           rem=rem)["temp"]
@@ -88,9 +95,7 @@ def jacobi_shard_step_overlap(p, radius: Radius, counts: Dim3, local: Dim3,
     program)."""
     from ..parallel.overlap import overlapped_update
 
-    hot_c = Dim3(gsize.x // 3, gsize.y // 2, gsize.z // 2)
-    cold_c = Dim3(gsize.x * 2 // 3, gsize.y // 2, gsize.z // 2)
-    sph_r = gsize.x // 10
+    hot_c, cold_c, sph_r = sphere_geometry(gsize)
 
     def upd(blocks, dims, off):
         blk = blocks["temp"]
@@ -123,12 +128,26 @@ class Jacobi3D:
             self.dd.set_output_prefix(output_prefix)
         if mesh_shape is not None:
             self.dd.set_mesh_shape(mesh_shape)
+        else:
+            from ..ops.pallas_stencil import on_tpu
+            if (len(self.dd._devices) > 1 and not overlap
+                    and (kernel == "halo"
+                         or (kernel == "auto" and on_tpu()))):
+                # prefer an x-unsharded decomposition so the fused halo
+                # kernel path is available (ops/pallas_halo.py: cutting
+                # the lane axis is the worst TPU choice anyway); other
+                # paths keep the cube-like partition_dims_even choice
+                from ..partition import partition_dims_even_xfree
+                shape = partition_dims_even_xfree(
+                    Dim3(x, y, z), len(self.dd._devices))
+                if shape is not None:
+                    self.dd.set_mesh_shape(shape)
         self.dd.add_data("temp", dtype)
         self.dd.realize()
         self._dtype = dtype
-        if kernel not in ("auto", "wrap", "xla", "pallas"):
+        if kernel not in ("auto", "wrap", "halo", "xla", "pallas"):
             raise ValueError(
-                f"kernel must be auto|wrap|xla|pallas, got {kernel!r}")
+                f"kernel must be auto|wrap|halo|xla|pallas, got {kernel!r}")
         self._kernel = kernel
         self._overlap = overlap
         self._build_step()
@@ -155,18 +174,32 @@ class Jacobi3D:
         # single-chip fast path: periodic wrap fused INTO the stencil
         # kernel (no halo storage, no exchange program) — the TPU-native
         # answer to the reference's same-GPU PeerAccessSender shortcut
+        radius_ok = all(radius.face(a, s) == 1
+                        for a in range(3) for s in (-1, 1))
         wrap_ok = (counts == Dim3(1, 1, 1) and rem == Dim3(0, 0, 0)
-                   and not self._overlap
-                   and all(radius.face(a, s) == 1
-                           for a in range(3) for s in (-1, 1)))
+                   and not self._overlap and radius_ok)
+        # the multi-device fast path: interior-resident shards + slab
+        # exchange + fused halo kernel (ops/pallas_halo.py)
+        halo_ok = (counts.x == 1 and rem == Dim3(0, 0, 0)
+                   and not self._overlap and radius_ok)
         if kernel == "auto":
             from ..ops.pallas_stencil import on_tpu
-            kernel = "wrap" if (wrap_ok and on_tpu()) else "xla"
+            if on_tpu():
+                kernel = ("wrap" if wrap_ok
+                          else "halo" if halo_ok else "xla")
+            else:
+                kernel = "xla"
         if kernel == "wrap":
             if not wrap_ok:
                 raise ValueError("kernel='wrap' needs a (1,1,1) mesh, "
                                  "radius 1, even grid, overlap off")
             self._build_wrap_step()
+            return
+        if kernel == "halo":
+            if not halo_ok:
+                raise ValueError("kernel='halo' needs an x-unsharded "
+                                 "mesh, radius 1, even grid, overlap off")
+            self._build_halo_step()
             return
         step_fn = (jacobi_shard_step_overlap if self._overlap
                    else jacobi_shard_step)
@@ -201,9 +234,7 @@ class Jacobi3D:
         lo = dd.radius.pad_lo()
         local = dd.local_size
         gsize = dd.size
-        hot = (gsize.x // 3, gsize.y // 2, gsize.z // 2)
-        cold = (gsize.x * 2 // 3, gsize.y // 2, gsize.z // 2)
-        sph_r = gsize.x // 10
+        hot, cold, sph_r = sphere_geometry(gsize)
 
         def steps(p, n):
             inner = lax.slice(p, (lo.z, lo.y, lo.x),
@@ -218,6 +249,47 @@ class Jacobi3D:
 
         self._step_n = jax.jit(steps, donate_argnums=0)
         self._step = jax.jit(lambda p: steps(p, 1), donate_argnums=0)
+
+    def _build_halo_step(self) -> None:
+        """Multi-device fused steps: interior-resident shards, thin slab
+        ppermutes, one fused Pallas kernel per step — so an N-chip mesh
+        keeps single-chip per-chip throughput (the analog of the
+        reference's fused solve kernel running at every scale,
+        astaroth/astaroth.cu:552-646; see ops/pallas_halo.py)."""
+        from ..ops.pallas_halo import jacobi7_halo_pallas
+        from ..parallel.exchange import (exchange_interior_slabs,
+                                         shard_origin)
+
+        dd = self.dd
+        lo = dd.radius.pad_lo()
+        local = dd.local_size
+        counts = mesh_dim(dd.mesh)
+        gsize = dd.size
+        hot, cold, sph_r = sphere_geometry(gsize)
+        esub = 8 if local.y % 8 == 0 else 1
+
+        def shard_steps(p, n):
+            ox, oy, oz = shard_origin(local, Dim3(0, 0, 0))
+            org = jnp.stack([oz, oy, ox]).astype(jnp.int32)
+            inner = lax.slice(p, (lo.z, lo.y, lo.x),
+                              (lo.z + local.z, lo.y + local.y,
+                               lo.x + local.x))
+
+            def body(_, q):
+                slabs = exchange_interior_slabs(q, counts, rz=1, ry=esub)
+                return jacobi7_halo_pallas(q, slabs, org, hot, cold, sph_r)
+
+            inner = lax.fori_loop(0, n, body, inner)
+            # halos go stale; nothing reads them before the next
+            # exchange, and temperature() reads the interior only
+            return lax.dynamic_update_slice(p, inner, (lo.z, lo.y, lo.x))
+
+        spec = P("z", "y", "x")
+        sm = jax.shard_map(shard_steps, mesh=dd.mesh, in_specs=(spec, P()),
+                           out_specs=spec, check_vma=False)
+        self._step_n = jax.jit(sm, donate_argnums=0)
+        self._step = jax.jit(
+            lambda p: sm(p, jnp.asarray(1, jnp.int32)), donate_argnums=0)
 
     def step(self) -> None:
         """One iteration: exchange + 7-point update + sources."""

@@ -1,0 +1,428 @@
+"""Halo-aware fused Pallas kernels for the multi-device slab layout.
+
+These kernels close the gap the single-chip ``*_wrap_pallas`` kernels
+leave open: those fuse the periodic wrap into the kernel and therefore
+only work on a (1,1,1) mesh, while any real multi-chip mesh used to fall
+back to the XLA slicing formulation (~3.5x slower for Jacobi, ~24x for
+MHD). Here the shard stays *interior-resident* (unpadded, so the (y, x)
+dims keep their natural (8, 128) HBM tiling) and the halo arrives as
+thin, separately-exchanged slab arrays (see
+``parallel.exchange.exchange_interior_slabs``); the kernel assembles
+each block's stencil window from
+
+* in-shard neighbor blocks (clamped, non-wrapping index maps), and
+* the slab arrays at shard edges (selected by ``program_id``),
+
+so an N-chip mesh runs the same one-read-one-write fused compute the
+wrap kernels deliver on one chip. This is the TPU answer to the
+reference running its fused ``solve`` kernel at every scale
+(reference: astaroth/user_kernels.h:383-453 launched per-region from
+astaroth/astaroth.cu:552-646, and bin/jacobi3d.cu:296-377).
+
+Layout contract (all even-grid; ESUB = 8 sublane tile):
+
+* field shard: interior (Z, Y, X), no padding;
+* z slabs: (rz, Y, X) — data from the z-neighbors (lo slab holds the
+  minus-neighbor's top rz rows, hi slab the plus-neighbor's bottom rz);
+* y slabs: (Z, ry, X) for Jacobi, (Z + 2*rz, ry, X) for MHD — the MHD
+  variant is z-extended so yz edge/corner data rides along (the
+  sequential-sweep corner rule, SURVEY.md section 7 step 3);
+* x is NOT mesh-sharded (mesh x-axis must be 1): the lane dimension is
+  the worst axis to cut on TPU, so the orchestrator prefers z/y
+  decompositions and the periodic x wrap stays in-kernel
+  (``pltpu.roll`` / window concat).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..geometry import Dim3
+from .pallas_stencil import default_interpret
+
+ESUB = 8  # f32 sublane tile; slab row granularity
+R = 3     # MHD stencil radius (6th order)
+
+
+def _shrink_block(dim: int, block: int, mult: int = 1) -> int:
+    """Largest power-of-two-ish block <= ``block`` that divides ``dim``
+    and is a multiple of ``mult`` (or equals mult)."""
+    b = block
+    while b > mult and dim % b:
+        b //= 2
+    if b < mult or dim % b:
+        b = mult
+    assert dim % b == 0, (dim, block, mult)
+    return b
+
+
+def jacobi7_halo_pallas(interior: jnp.ndarray,
+                        slabs: Dict[str, jnp.ndarray],
+                        origin_zyx: jnp.ndarray,
+                        hot_c: Tuple[int, int, int],
+                        cold_c: Tuple[int, int, int], sph_r: int,
+                        block_z: int = 16, block_y: int = 128,
+                        interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Fused 7-point Jacobi step + Dirichlet sphere sources on one
+    interior-resident (Z, Y, X) shard with exchanged halo slabs.
+
+    ``slabs``: ``{"zlo": (rz,Y,X), "zhi": (rz,Y,X), "ylo": (Z,e,X),
+    "yhi": (Z,e,X)}`` per the ``exchange_interior_slabs`` alignment
+    contract: the adjacent planes are ``zlo[-1]`` / ``zhi[0]`` and the
+    adjacent rows ``ylo[:, -1]`` / ``yhi[:, 0]`` (e is ESUB when Y
+    allows, else 1; y slabs must NOT be z-extended).
+    ``origin_zyx`` is this shard's global interior origin (int32
+    (3,), traced under shard_map) for the sphere sources. x must be
+    unsharded (periodic x wrap is done in-kernel via ``pltpu.roll``).
+
+    Semantics match ``jacobi7_wrap_pallas`` (which is the special case
+    where every slab is the shard's own wrapped edge).
+    """
+    if interpret is None:
+        interpret = default_interpret()
+    Z, Y, X = interior.shape
+    esub = slabs["ylo"].shape[1]
+    rz = slabs["zlo"].shape[0]
+    assert slabs["zlo"].shape == (rz, Y, X), slabs["zlo"].shape
+    assert slabs["ylo"].shape == (Z, esub, X), (
+        "jacobi halo kernel wants y slabs without z extension",
+        slabs["ylo"].shape)
+    bz = _shrink_block(Z, block_z)
+    by = _shrink_block(Y, block_y, esub)
+    dt = jnp.dtype(interior.dtype)
+    hx, hy, hz = hot_c
+    cx, cy, cz = cold_c
+    r2 = sph_r * sph_r
+    nzb = Z // bz
+    nyb = Y // by
+    byb = by // esub
+
+    def kern(org, zprev, main, znext, yprev, ynext,
+             zlo, zhi, ylo, yhi, out):
+        kz = pl.program_id(0)
+        ky = pl.program_id(1)
+        c = main[...]                              # (bz, by, X)
+        ym_slab = jnp.where(ky == 0, ylo[...], yprev[...])
+        yp_slab = jnp.where(ky == nyb - 1, yhi[...], ynext[...])
+        ext = jnp.concatenate([ym_slab[:, esub - 1:esub], c,
+                               yp_slab[:, 0:1]], axis=1)
+        ym = ext[:, :by]
+        yp = ext[:, 2:]
+        xm = pltpu.roll(c, 1, 2)
+        xp = pltpu.roll(c, X - 1, 2)
+        lat = ym + yp + xm + xp
+        zm0 = jnp.where(kz == 0, zlo[0], zprev[0])
+        zp_last = jnp.where(kz == nzb - 1, zhi[0], znext[0])
+        oz = org[0]
+        oy = org[1]
+        ox = org[2]
+        gy = (oy + ky * by
+              + jax.lax.broadcasted_iota(jnp.int32, (by, X), 0))
+        gx = ox + jax.lax.broadcasted_iota(jnp.int32, (by, X), 1)
+        d2yx_h = (gx - hx) ** 2 + (gy - hy) ** 2
+        d2yx_c = (gx - cx) ** 2 + (gy - cy) ** 2
+        for r in range(bz):
+            zm = zm0 if r == 0 else c[r - 1]
+            zp = zp_last if r == bz - 1 else c[r + 1]
+            new = (lat[r] + zm + zp) * dt.type(1.0 / 6.0)
+            gz = oz + kz * bz + r
+            new = jnp.where(d2yx_h + (gz - hz) ** 2 <= r2,
+                            dt.type(1.0), new)
+            new = jnp.where(d2yx_c + (gz - cz) ** 2 <= r2,
+                            dt.type(0.0), new)
+            out[r] = new
+
+    # NB index maps: in-shard neighbor specs clamp at the shard edge
+    # (the clamped block is loaded but unused — the kernel selects the
+    # slab instead); slab specs pin to block 0 when the grid row cannot
+    # use them so Pallas's revisit cache skips the refetch.
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),                  # origin
+        pl.BlockSpec((1, by, X),
+                     lambda kz, ky: (jnp.maximum(kz * bz - 1, 0), ky, 0)),
+        pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)),
+        pl.BlockSpec((1, by, X),
+                     lambda kz, ky: (jnp.minimum(kz * bz + bz, Z - 1),
+                                     ky, 0)),
+        pl.BlockSpec((bz, esub, X),
+                     lambda kz, ky: (kz, jnp.maximum(ky * byb - 1, 0), 0)),
+        pl.BlockSpec((bz, esub, X),
+                     lambda kz, ky: (kz, jnp.minimum(ky * byb + byb,
+                                                     Y // esub - 1), 0)),
+        pl.BlockSpec((1, by, X),
+                     lambda kz, ky: (rz - 1, jnp.where(kz == 0, ky, 0), 0)),
+        pl.BlockSpec((1, by, X),
+                     lambda kz, ky: (0, jnp.where(kz == nzb - 1, ky, 0), 0)),
+        pl.BlockSpec((bz, esub, X), lambda kz, ky: (kz, 0, 0)),
+        pl.BlockSpec((bz, esub, X), lambda kz, ky: (kz, 0, 0)),
+    ]
+    return pl.pallas_call(
+        kern,
+        grid=(nzb, nyb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)),
+        out_shape=jax.ShapeDtypeStruct((Z, Y, X), interior.dtype),
+        interpret=interpret,
+    )(jnp.asarray(origin_zyx, jnp.int32), interior, interior, interior,
+      interior, interior, slabs["zlo"], slabs["zhi"], slabs["ylo"],
+      slabs["yhi"])
+
+
+def mhd_halo_blocks(Z: int, Y: int, block_z: int = 8,
+                    block_y: int = 32) -> Tuple[int, int]:
+    """The (bz, by) blocking the MHD halo kernel will use for a
+    (Z, Y, ·) shard — exposed so the slab exchange can size its z slabs
+    to match (zlo/zhi must be (bz, Y, X); see mhd_substep_halo_pallas).
+    Both are multiples of ESUB and divide Z / Y."""
+    assert Z % ESUB == 0 and Y % ESUB == 0, (Z, Y)
+    bz, by = block_z, block_y
+    while bz > ESUB and Z % bz:
+        bz -= ESUB
+    while by > ESUB and Y % by:
+        by -= ESUB
+    assert bz % ESUB == 0 and by % ESUB == 0 and Z % bz == 0 and Y % by == 0
+    return bz, by
+
+
+def _mhd_segment_specs(Z: int, Y: int, X: int, bz: int, by: int):
+    """The 21 BlockSpecs covering one field's (bz+2R, by+2R, X)
+    neighborhood on the slab layout. Segment grid: z in {-,0,+} x
+    y in {-,0,+}; edge/corner segments carry one spec per possible
+    source (in-shard / z slab / y slab) and the kernel selects by
+    ``program_id`` — clamped in-shard maps load an unused block at the
+    shard edge, and slab maps pin to a constant block when their grid
+    row cannot need them (Pallas's revisit cache then skips the fetch).
+
+    Spec order (per field): main; zm_y0(in, zs); zp_y0(in, zs);
+    z0_ym(in, ys); z0_yp(in, ys); zm_ym(in, zs, ys); zm_yp(in, zs, ys);
+    zp_ym(in, zs, ys); zp_yp(in, zs, ys). Input order matches
+    ``_mhd_inputs_for_field``.
+
+    Index-map geometry: the interior array A is (Z, Y, X); z slabs
+    (bz, Y, X) with the adjacent planes at zlo[-1] / zhi[0]; y slabs
+    (Z + 2*bz, ry=ESUB, X), z origin at -bz (z-extended so yz corner
+    data rides along).
+    """
+    bzb = bz // ESUB
+    byb = by // ESUB
+    nzb8 = Z // ESUB
+    nyb8 = Y // ESUB
+    nzg = Z // bz
+    nyg = Y // by
+
+    def clampz(k):            # z-minus 8-row block, in-shard (8-units)
+        return jnp.maximum(k * bzb - 1, 0)
+
+    def clampZ(k):            # z-plus
+        return jnp.minimum(k * bzb + bzb, nzb8 - 1)
+
+    def clampy(k):            # y-minus (8-units)
+        return jnp.maximum(k * byb - 1, 0)
+
+    def clampY(k):            # y-plus
+        return jnp.minimum(k * byb + byb, nyb8 - 1)
+
+    main = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
+    specs = [
+        main,
+        # zm_y0: rows z in [kz*bz-8, kz*bz)
+        pl.BlockSpec((ESUB, by, X), lambda kz, ky: (clampz(kz), ky, 0)),
+        pl.BlockSpec((ESUB, by, X),
+                     lambda kz, ky: (bzb - 1,
+                                     jnp.where(kz == 0, ky, 0), 0)),
+        # zp_y0: rows z in [kz*bz+bz, +8)
+        pl.BlockSpec((ESUB, by, X), lambda kz, ky: (clampZ(kz), ky, 0)),
+        pl.BlockSpec((ESUB, by, X),
+                     lambda kz, ky: (0, jnp.where(kz == nzg - 1, ky, 0),
+                                     0)),
+        # z0_ym: rows y in [ky*by-8, ky*by)
+        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz, clampy(ky), 0)),
+        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0)),
+        # z0_yp
+        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz, clampY(ky), 0)),
+        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0)),
+        # zm_ym corner (8, 8, X)
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (clampz(kz), clampy(ky), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (bzb - 1,
+                                     jnp.where(kz == 0, clampy(ky), 0), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: ((kz + 1) * bzb - 1, 0, 0)),
+        # zm_yp
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (clampz(kz), clampY(ky), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (bzb - 1,
+                                     jnp.where(kz == 0, clampY(ky), 0), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: ((kz + 1) * bzb - 1, 0, 0)),
+        # zp_ym
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (clampZ(kz), clampy(ky), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (0, jnp.where(kz == nzg - 1,
+                                                  clampy(ky), 0), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: ((kz + 2) * bzb, 0, 0)),
+        # zp_yp
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (clampZ(kz), clampY(ky), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (0, jnp.where(kz == nzg - 1,
+                                                  clampY(ky), 0), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: ((kz + 2) * bzb, 0, 0)),
+    ]
+    return specs
+
+
+def _mhd_inputs_for_field(f, slabs):
+    """Input arrays matching ``_mhd_segment_specs`` order."""
+    zlo, zhi = slabs["zlo"], slabs["zhi"]
+    ylo, yhi = slabs["ylo"], slabs["yhi"]
+    return [f,
+            f, zlo,          # zm_y0
+            f, zhi,          # zp_y0
+            f, ylo,          # z0_ym
+            f, yhi,          # z0_yp
+            f, zlo, ylo,     # zm_ym
+            f, zlo, yhi,     # zm_yp
+            f, zhi, ylo,     # zp_ym
+            f, zhi, yhi]     # zp_yp
+
+
+def _mhd_select_window(refs, nzg: int, nyg: int) -> jnp.ndarray:
+    """Assemble one field's (bz+2R, by+2R, X+2R) stencil window from
+    the 21 segment refs (order: _mhd_segment_specs), selecting slab
+    sources at shard edges and wrapping x in-core (x unsharded =>
+    in-window wrap IS the global periodic wrap)."""
+    kz = pl.program_id(0)
+    ky = pl.program_id(1)
+    at_zlo = kz == 0
+    at_zhi = kz == nzg - 1
+    at_ylo = ky == 0
+    at_yhi = ky == nyg - 1
+    (main, zm0_in, zm0_zs, zp0_in, zp0_zs, ym0_in, ym0_ys, yp0_in,
+     yp0_ys, mm_in, mm_zs, mm_ys, mp_in, mp_zs, mp_ys, pm_in, pm_zs,
+     pm_ys, pp_in, pp_zs, pp_ys) = refs
+    zm_y0 = jnp.where(at_zlo, zm0_zs[...], zm0_in[...])
+    zp_y0 = jnp.where(at_zhi, zp0_zs[...], zp0_in[...])
+    z0_ym = jnp.where(at_ylo, ym0_ys[...], ym0_in[...])
+    z0_yp = jnp.where(at_yhi, yp0_ys[...], yp0_in[...])
+    # corners: the y slab is z-extended, so a y-edge corner always
+    # comes from it (covering simultaneous z edges); otherwise the z
+    # slab covers z-edge corners at interior y
+    zm_ym = jnp.where(at_ylo, mm_ys[...],
+                      jnp.where(at_zlo, mm_zs[...], mm_in[...]))
+    zm_yp = jnp.where(at_yhi, mp_ys[...],
+                      jnp.where(at_zlo, mp_zs[...], mp_in[...]))
+    zp_ym = jnp.where(at_ylo, pm_ys[...],
+                      jnp.where(at_zhi, pm_zs[...], pm_in[...]))
+    zp_yp = jnp.where(at_yhi, pp_ys[...],
+                      jnp.where(at_zhi, pp_zs[...], pp_in[...]))
+    c = main[...]
+    rows = [
+        jnp.concatenate([zm_ym[ESUB - R:, ESUB - R:], zm_y0[ESUB - R:, :],
+                         zm_yp[ESUB - R:, :R]], axis=1),
+        jnp.concatenate([z0_ym[:, ESUB - R:], c, z0_yp[:, :R]], axis=1),
+        jnp.concatenate([zp_ym[:R, ESUB - R:], zp_y0[:R, :],
+                         zp_yp[:R, :R]], axis=1),
+    ]
+    w = jnp.concatenate(rows, axis=0)
+    return jnp.concatenate([w[..., -R:], w, w[..., :R]], axis=-1)
+
+
+def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
+                            w: Dict[str, jnp.ndarray],
+                            slabs: Dict[str, Dict[str, jnp.ndarray]],
+                            s: int, prm, dt_phys: float,
+                            block_z: int = 8, block_y: int = 32,
+                            interpret: Optional[bool] = None
+                            ) -> Tuple[Dict[str, jnp.ndarray],
+                                       Dict[str, jnp.ndarray]]:
+    """One fused RK3 MHD substep on interior-resident (Z, Y, X) shards
+    with exchanged halo slabs — the multi-device counterpart of
+    ``pallas_mhd.mhd_substep_wrap_pallas`` (same RHS evaluation via
+    ``mhd_rates`` on an in-core window, same Williamson update;
+    reference: astaroth/user_kernels.h:383-453 solve +
+    kernels.cu:63-90 integrate_substep), for shards on a z/y-sharded
+    mesh (x unsharded, wrap in-core).
+
+    ``slabs[q]`` comes from ``exchange_interior_slabs(fields[q],
+    counts, rz=bz, ry=ESUB, radius_rows=R, y_z_extended=True)`` with
+    (bz, _) = ``mhd_halo_blocks(Z, Y, block_z, block_y)``.
+    Returns (new_fields, new_w).
+    """
+    from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
+    from .fd6 import FieldData
+
+    if interpret is None:
+        interpret = default_interpret()
+    Z, Y, X = fields[FIELDS[0]].shape
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
+    for q in FIELDS:
+        assert slabs[q]["zlo"].shape == (bz, Y, X), slabs[q]["zlo"].shape
+        assert slabs[q]["ylo"].shape == (Z + 2 * bz, ESUB, X), \
+            slabs[q]["ylo"].shape
+    dtype = fields[FIELDS[0]].dtype
+    inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
+    alpha = float(RK3_ALPHA[s])
+    beta = float(RK3_BETA[s])
+    dt_ = float(dt_phys)
+    pad_lo = Dim3(R, R, R)
+    interior = Dim3(X, by, bz)
+    nzg = Z // bz
+    nyg = Y // by
+    nseg = 21
+    nf = len(FIELDS)
+
+    main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
+
+    def kern(*refs):
+        field_refs = refs[:nseg * nf]
+        w_refs = refs[nseg * nf:nseg * nf + nf]
+        out_f = refs[nseg * nf + nf:nseg * nf + 2 * nf]
+        out_w = refs[nseg * nf + 2 * nf:]
+        data = {}
+        for i, q in enumerate(FIELDS):
+            win = _mhd_select_window(field_refs[nseg * i:nseg * (i + 1)],
+                                     nzg, nyg)
+            data[q] = FieldData(win, inv_ds, pad_lo, interior)
+        rates = mhd_rates(data, prm, dtype)
+        dta = jnp.dtype(dtype)
+        for i, q in enumerate(FIELDS):
+            wq = dta.type(alpha) * w_refs[i][...] + dta.type(dt_) * rates[q]
+            out_w[i][...] = wq
+            out_f[i][...] = data[q].value + dta.type(beta) * wq
+
+    in_specs = []
+    inputs = []
+    for q in FIELDS:
+        in_specs.extend(_mhd_segment_specs(Z, Y, X, bz, by))
+        inputs.extend(_mhd_inputs_for_field(fields[q], slabs[q]))
+    for q in FIELDS:
+        in_specs.append(main_spec)
+        inputs.append(w[q])
+    out_shape = [jax.ShapeDtypeStruct((Z, Y, X), dtype)
+                 for _ in range(2 * nf)]
+    out_specs = [main_spec] * (2 * nf)
+
+    outs = pl.pallas_call(
+        kern,
+        grid=(nzg, nyg),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*inputs)
+    new_f = {q: outs[i] for i, q in enumerate(FIELDS)}
+    new_w = {q: outs[nf + i] for i, q in enumerate(FIELDS)}
+    return new_f, new_w

@@ -142,6 +142,91 @@ def exchange_shard(arr: jnp.ndarray, radius: Radius,
     return arr
 
 
+def exchange_interior_slabs(p: jnp.ndarray, mesh_counts: Dim3,
+                            rz: int, ry: int, radius_rows: int = 0,
+                            y_z_extended: bool = False
+                            ) -> Dict[str, jnp.ndarray]:
+    """Exchange halo SLABS of one interior-resident (unpadded) shard —
+    the data plane of the fused halo kernels (ops/pallas_halo.py).
+
+    Unlike ``exchange_shard`` (which fills halo regions of a padded
+    allocation in place), this returns the four slab arrays the halo
+    kernels consume, leaving the shard untouched:
+
+    * ``zlo`` (rz, Y, X): the z-minus neighbor's TOP rows, right-
+      aligned (the row adjacent to this shard is ``zlo[-1]``);
+    * ``zhi`` (rz, Y, X): the z-plus neighbor's BOTTOM rows, left-
+      aligned (adjacent row is ``zhi[0]``);
+    * ``ylo`` / ``yhi``: the y-minus (y-plus) neighbor's LAST (FIRST)
+      rows, right-/left-aligned in an ry-row buffer — shape
+      (Z, ry, X), or (Z + 2*rz, ry, X) when ``y_z_extended`` (the y
+      sources then span the z halo too, so yz edge/corner data
+      propagates — the sequential-sweep corner rule, reference
+      src/stencil.cu:331-464 collapsed per SURVEY.md §7).
+
+    ``rz``/``ry`` are the buffer row counts the kernels' block specs
+    want (block-aligned); ``radius_rows`` (default ``min(rz, ry)``)
+    is how many rows actually cross the wire — only the stencil radius
+    is needed, the rest of each buffer is zero filler. On a 1-device
+    mesh axis the shift degenerates to the shard's own wrapped edge
+    (periodic). x must not be mesh-sharded (the halo kernels wrap x
+    in-kernel). Must be traced inside ``shard_map``.
+    """
+    Z = p.shape[0]
+    Y = p.shape[1]
+    X = p.shape[2]
+    nz = mesh_counts.z
+    ny = mesh_counts.y
+    r = radius_rows or min(rz, ry)
+    assert r <= rz and r <= ry, (r, rz, ry)
+    dt = p.dtype
+
+    def zfill(n, yext):
+        return jnp.zeros((n, yext, X), dt)
+
+    def yfill(zext, n):
+        return jnp.zeros((zext, n, X), dt)
+
+    # r-row wire transfers (reference sends exactly the halo bytes,
+    # src/packer.cu:78-82; buffers are padded to block-aligned rows)
+    zlo_r = _shift_from_minus(lax.slice_in_dim(p, Z - r, Z, axis=0), "z", nz)
+    zhi_r = _shift_from_plus(lax.slice_in_dim(p, 0, r, axis=0), "z", nz)
+    if y_z_extended:
+        # this shard's y-edge columns spanning z in [-r, Z+r): own
+        # interior plus the just-received z slabs (corner ride-along)
+        def ysrc(y0, y1):
+            return jnp.concatenate(
+                [zlo_r[:, y0:y1], p[:, y0:y1], zhi_r[:, y0:y1]], axis=0)
+        zext = Z + 2 * rz
+        zoff = rz - r
+    else:
+        def ysrc(y0, y1):
+            return p[:, y0:y1]
+        zext = Z
+        zoff = 0
+    ylo_r = _shift_from_minus(ysrc(Y - r, Y), "y", ny)
+    yhi_r = _shift_from_plus(ysrc(0, r), "y", ny)
+
+    zlo = (zlo_r if rz == r
+           else jnp.concatenate([zfill(rz - r, Y), zlo_r], axis=0))
+    zhi = (zhi_r if rz == r
+           else jnp.concatenate([zhi_r, zfill(rz - r, Y)], axis=0))
+
+    def yembed(recv, align_hi: bool):
+        out = recv
+        if ry != r:
+            pad = yfill(out.shape[0], ry - r)
+            out = (jnp.concatenate([pad, out], axis=1) if align_hi
+                   else jnp.concatenate([out, pad], axis=1))
+        if zoff:
+            zpad = jnp.zeros((zoff, ry, X), dt)
+            out = jnp.concatenate([zpad, out, zpad], axis=0)
+        return out
+
+    return {"zlo": zlo, "zhi": zhi,
+            "ylo": yembed(ylo_r, True), "yhi": yembed(yhi_r, False)}
+
+
 def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                           mesh_counts: Dim3,
                           axis_order: Tuple[int, ...] = (0, 1, 2)

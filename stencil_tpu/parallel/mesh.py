@@ -90,6 +90,19 @@ def default_mesh_shape(n: int) -> Dim3:
     return Dim3(*dims)
 
 
+def default_mesh_shape_xfree(n: int) -> Dim3:
+    """Near-square (1, dy, dz) factorization of ``n`` — the x-unsharded
+    decomposition the fused halo kernels want (ops/pallas_halo.py)."""
+    from ..numerics import prime_factors
+    dims = [1, 1]
+    for f in prime_factors(n):
+        if f < 2:
+            continue
+        dims[dims.index(min(dims))] *= f
+    dims.sort(reverse=True)
+    return Dim3(1, dims[1], dims[0])
+
+
 def mesh_dim(mesh: Mesh) -> Dim3:
     """Subdomain-grid shape (x, y, z) of a 3D mesh."""
     return Dim3(mesh.shape["x"], mesh.shape["y"], mesh.shape["z"])

@@ -11,7 +11,7 @@ area x (radius+ + radius-) is smallest (reference: partition.hpp:167-208).
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 from .geometry import Dim3, Dim3Like, Radius
 from .numerics import div_ceil, prime_factors
@@ -206,4 +206,34 @@ def partition_dims_even(size: Dim3Like, n: int) -> Dim3:
     def iface(d: Dim3) -> int:
         sx, sy, sz = size.x // d.x, size.y // d.y, size.z // d.z
         return sy * sz * (d.x > 1) + sx * sz * (d.y > 1) + sx * sy * (d.z > 1)
+    return min(best, key=iface)
+
+
+def partition_dims_even_xfree(size: Dim3Like, n: int,
+                              align: int = 1) -> Optional[Dim3]:
+    """An exact ``n``-way factorization (1, dy, dz) that leaves the
+    x (lane) axis unsharded, preferring the most cube-like (y, z) split
+    — the decomposition the fused halo kernels want (cutting the lane
+    dimension is the worst choice on TPU; see ops/pallas_halo.py).
+    ``align`` additionally requires the local y/z extents to be
+    multiples of it (the kernels' sublane-tile constraint).
+    Returns None when no such factorization divides ``size``.
+    """
+    size = Dim3.of(size)
+    best: List[Dim3] = []
+    for dy in range(1, n + 1):
+        if n % dy or size.y % dy:
+            continue
+        dz = n // dy
+        if size.z % dz:
+            continue
+        if (size.y // dy) % align or (size.z // dz) % align:
+            continue
+        best.append(Dim3(1, dy, dz))
+    if not best:
+        return None
+
+    def iface(d: Dim3) -> int:
+        sx, sy, sz = size.x, size.y // d.y, size.z // d.z
+        return sx * sz * (d.y > 1) + sx * sy * (d.z > 1)
     return min(best, key=iface)
