@@ -7,8 +7,8 @@ Prints ONE JSON line:
 North-star metric (BASELINE.md): jacobi3d iters/sec at 512^3, radius 1,
 measured with the reference's statistics (trimean over sample windows,
 bin/statistics.hpp analog). The reference publishes no numbers
-(BASELINE.md), so vs_baseline compares against the previous round's
-recorded result in BENCH_r*.json when present, else 1.0.
+(BASELINE.md), so vs_baseline compares against the BEST non-suspect
+result across all prior rounds' BENCH_r*.json when present, else 1.0.
 
 Timing note: on the axon TPU tunnel, block_until_ready does not drain
 execution; we fence with a device->host fetch (stencil_tpu.utils.timers).
@@ -75,9 +75,9 @@ def main() -> None:
 
     value = round(iters_per_sec, 2)
     metric = f"jacobi3d_{size}c_iters_per_sec"
-    baseline = _previous_round_value(metric)
+    baseline = _previous_round_value(metric, ndev)
     vs = round(value / baseline, 3) if baseline else 1.0
-    print(json.dumps({
+    rec = {
         "metric": metric,
         "value": value,
         "unit": "iters/s",
@@ -86,31 +86,48 @@ def main() -> None:
             "devices": ndev,
             "mesh": tuple(mesh_shape),
             "platform": str(jax.devices()[0].platform),
-            "exchange_GBps": round(exchange_gbs, 2),
+            # On one chip there is no wire traffic — report null, not a
+            # misleading 0.0 bandwidth.
+            "exchange_GBps": (round(exchange_gbs, 2)
+                              if total_halo_bytes else None),
             "exchange_s": round(ex_s, 6),
             "halo_bytes_per_exchange": total_halo_bytes,
         },
-    }))
+    }
+    # A run >2x SLOWER than the best prior round is almost certainly an
+    # environment glitch (BENCH_r03 recorded 25.95 vs 195.5 with no
+    # flag) — mark it so downstream tooling doesn't ingest it silently.
+    # Improvements are never flagged: they must be able to raise the
+    # baseline bar for subsequent rounds.
+    if baseline and value < 0.5 * baseline:
+        rec["suspect"] = True
+        rec["extra"]["suspect_reason"] = (
+            f">2x below best prior round ({baseline}); "
+            "likely environment glitch")
+    print(json.dumps(rec))
 
 
-def _previous_round_value(metric):
-    """Value of the latest prior round whose metric matches (files sort
-    numerically by round: BENCH_r10 after BENCH_r9)."""
-    import re
-
-    def round_no(p):
-        m = re.search(r"BENCH_r(\d+)\.json$", p)
-        return int(m.group(1)) if m else -1
-
+def _previous_round_value(metric, ndev):
+    """Best value across prior rounds whose metric AND device count
+    match (an 8-chip round must not become the bar for 1-chip runs).
+    The driver wraps this script's JSON line as {"n": .., "tail": ..,
+    "parsed": {...}} in BENCH_r*.json — unwrap that; also accept the
+    bare schema for hand-saved records. "Best" (not "latest") so one
+    glitched round (e.g. BENCH_r03's 25.95 vs 195.5) doesn't reset the
+    comparison bar."""
     best = None
-    for path in sorted(glob.glob("BENCH_r*.json"), key=round_no):
+    for path in glob.glob("BENCH_r*.json"):
         try:
             with open(path) as f:
                 rec = json.load(f)
+            if isinstance(rec.get("parsed"), dict):
+                rec = rec["parsed"]
             v = rec.get("value")
-            if (rec.get("metric") == metric
-                    and isinstance(v, (int, float)) and v > 0):
-                best = v
+            rec_dev = rec.get("extra", {}).get("devices")
+            if (rec.get("metric") == metric and rec_dev == ndev
+                    and isinstance(v, (int, float)) and v > 0
+                    and not rec.get("suspect")):
+                best = v if best is None else max(best, v)
         except Exception:
             pass
     return best

@@ -19,34 +19,54 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def bench_jacobi(size, iters, kernels, blocks):
-    import jax
-    import numpy as np
-    from stencil_tpu.models.jacobi import Jacobi3D
+def _bench_model(label, ctor, size, iters, kernels, blocks, patch_fn,
+                 warmup):
+    """Shared sweep loop: construct, optionally patch block shapes, warm
+    up, time 4 windows, print one CSV line per kernel. Any one kernel's
+    build/compile failure (e.g. a Mosaic scoped-VMEM OOM at an
+    aggressive block shape) prints a FAIL line and must not abort the
+    rest of the sweep."""
     from stencil_tpu.numerics import trimean
 
     for kernel in kernels:
         try:
-            j = Jacobi3D(size, size, size, mesh_shape=(1, 1, 1),
-                         devices=jax.devices()[:1], kernel=kernel)
-        except ValueError as e:
-            print(f"jacobi,{kernel},SKIP,{e}")
+            m = ctor(kernel)
+        except ValueError as e:  # unsupported config for this kernel
+            print(f"{label},{kernel},SKIP,{_one_line(e)}")
             continue
-        if kernel in ("wrap", "halo") and blocks:
-            _patch_jacobi_blocks(j, kernel, blocks)
-        j.init()
-        j.run(5)
-        j.block()
-        window = max(iters // 4, 1)
-        rates = []
-        for _ in range(4):
-            t0 = time.perf_counter()
-            j.run(window)
-            j.block()
-            rates.append(window / (time.perf_counter() - t0))
-        print(f"jacobi,{kernel},{size},{trimean(rates):.2f} iters/s,"
-              f"min {min(rates):.2f},max {max(rates):.2f}")
-        del j
+        except Exception as e:  # kernel build/compile failure
+            print(f"{label},{kernel},{size},FAIL,{_one_line(e)}")
+            continue
+        try:
+            if kernel in ("wrap", "halo") and blocks:
+                patch_fn(m, kernel, blocks)
+            m.init()
+            m.run(warmup)
+            m.block()
+            window = max(iters // 4, 1)
+            rates = []
+            for _ in range(4):
+                t0 = time.perf_counter()
+                m.run(window)
+                m.block()
+                rates.append(window / (time.perf_counter() - t0))
+            print(f"{label},{kernel},{size},{trimean(rates):.2f} iters/s,"
+                  f"min {min(rates):.2f},max {max(rates):.2f}")
+        except Exception as e:
+            print(f"{label},{kernel},{size},FAIL,{_one_line(e)}")
+        del m
+
+
+def bench_jacobi(size, iters, kernels, blocks):
+    import jax
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    def ctor(kernel):
+        return Jacobi3D(size, size, size, mesh_shape=(1, 1, 1),
+                        devices=jax.devices()[:1], kernel=kernel)
+
+    _bench_model("jacobi", ctor, size, iters, kernels, blocks,
+                 _patch_jacobi_blocks, warmup=5)
 
 
 def _patch_jacobi_blocks(j, kernel, blocks):
@@ -60,44 +80,30 @@ def _patch_jacobi_blocks(j, kernel, blocks):
         orig = pallas_stencil.jacobi7_wrap_pallas
         pallas_stencil.jacobi7_wrap_pallas = functools.partial(
             orig, block_z=bz, block_y=by)
-        j._build_wrap_step()
-        pallas_stencil.jacobi7_wrap_pallas = orig
+        try:
+            j._build_wrap_step()
+        finally:
+            pallas_stencil.jacobi7_wrap_pallas = orig
     else:
         orig = pallas_halo.jacobi7_halo_pallas
         pallas_halo.jacobi7_halo_pallas = functools.partial(
             orig, block_z=bz, block_y=by)
-        j._build_halo_step()
-        pallas_halo.jacobi7_halo_pallas = orig
+        try:
+            j._build_halo_step()
+        finally:
+            pallas_halo.jacobi7_halo_pallas = orig
 
 
 def bench_mhd(size, iters, kernels, blocks):
     import jax
-    import numpy as np
     from stencil_tpu.models.astaroth import Astaroth
-    from stencil_tpu.numerics import trimean
 
-    for kernel in kernels:
-        try:
-            m = Astaroth(size, size, size, mesh_shape=(1, 1, 1),
-                         devices=jax.devices()[:1], kernel=kernel)
-        except ValueError as e:
-            print(f"mhd,{kernel},SKIP,{e}")
-            continue
-        if kernel in ("wrap", "halo") and blocks:
-            _patch_mhd_blocks(m, kernel, blocks)
-        m.init()
-        m.run(2)
-        m.block()
-        window = max(iters // 4, 1)
-        rates = []
-        for _ in range(4):
-            t0 = time.perf_counter()
-            m.run(window)
-            m.block()
-            rates.append(window / (time.perf_counter() - t0))
-        print(f"mhd,{kernel},{size},{trimean(rates):.2f} iters/s,"
-              f"min {min(rates):.2f},max {max(rates):.2f}")
-        del m
+    def ctor(kernel):
+        return Astaroth(size, size, size, mesh_shape=(1, 1, 1),
+                        devices=jax.devices()[:1], kernel=kernel)
+
+    _bench_model("mhd", ctor, size, iters, kernels, blocks,
+                 _patch_mhd_blocks, warmup=2)
 
 
 def _patch_mhd_blocks(m, kernel, blocks):
@@ -109,11 +115,19 @@ def _patch_mhd_blocks(m, kernel, blocks):
         orig = pallas_mhd.mhd_substep_wrap_pallas
         pallas_mhd.mhd_substep_wrap_pallas = functools.partial(
             orig, block_z=bz, block_y=by)
-        m._build_wrap_step()
-        pallas_mhd.mhd_substep_wrap_pallas = orig
+        try:
+            m._build_wrap_step()
+        finally:
+            pallas_mhd.mhd_substep_wrap_pallas = orig
     else:
         m._halo_blocks = (bz, by)
         m._build_halo_step()
+
+
+def _one_line(e: Exception) -> str:
+    """First line of an exception message, CSV-safe."""
+    msg = f"{type(e).__name__}: {e}".splitlines()[0]
+    return msg.replace(",", ";")
 
 
 def main():
