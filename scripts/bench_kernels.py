@@ -77,13 +77,20 @@ def _patch_jacobi_blocks(j, kernel, blocks):
 
     bz, by = blocks
     if kernel == "wrap":
-        orig = pallas_stencil.jacobi7_wrap_pallas
+        # the wrap step runs pairs through the wrap2 kernel with a
+        # single-step tail — patch BOTH so the sweep measures what it
+        # reports
+        orig1 = pallas_stencil.jacobi7_wrap_pallas
+        orig2 = pallas_stencil.jacobi7_wrap2_pallas
         pallas_stencil.jacobi7_wrap_pallas = functools.partial(
-            orig, block_z=bz, block_y=by)
+            orig1, block_z=bz, block_y=by)
+        pallas_stencil.jacobi7_wrap2_pallas = functools.partial(
+            orig2, block_z=bz, block_y=by)
         try:
             j._build_wrap_step()
         finally:
-            pallas_stencil.jacobi7_wrap_pallas = orig
+            pallas_stencil.jacobi7_wrap_pallas = orig1
+            pallas_stencil.jacobi7_wrap2_pallas = orig2
     else:
         orig = pallas_halo.jacobi7_halo_pallas
         pallas_halo.jacobi7_halo_pallas = functools.partial(

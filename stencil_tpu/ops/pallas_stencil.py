@@ -178,8 +178,133 @@ def jacobi7_wrap_pallas(interior: jnp.ndarray,
         ],
         out_specs=pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)),
         out_shape=jax.ShapeDtypeStruct((Z, Y, X), interior.dtype),
+        # allow larger-than-default blockings in tuning sweeps (Mosaic's
+        # default scoped-VMEM ceiling is 16 MiB)
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(interior, interior, interior, interior, interior)
+
+
+def jacobi7_wrap2_pallas(interior: jnp.ndarray,
+                         hot_c: Tuple[int, int, int],
+                         cold_c: Tuple[int, int, int], sph_r: int,
+                         block_z: int = 16, block_y: int = 128,
+                         interpret: Optional[bool] = None) -> jnp.ndarray:
+    """TWO fused periodic Jacobi iterations (+ sphere sources after
+    each) in ONE HBM pass — temporal blocking. The single-step kernel is
+    bandwidth-bound at ~2.4 HBM passes per iteration; evaluating step
+    k+1 from step k's values while they are still in VMEM (recomputing a
+    1-cell ring of step-k values at block edges) costs the same traffic
+    per *pass* but advances two iterations, so the per-iteration traffic
+    nearly halves. Bit-identical to two ``jacobi7_wrap_pallas`` calls
+    (same op order per point; the edge ring is recomputed, not
+    approximated). Reference semantics: bin/jacobi3d.cu:40-85 applied
+    twice.
+
+    Each (bz, by, X) output block reads a wrapped (bz+4, by+4, X) input
+    window assembled from 9 wrapped segments (x wraps in-core via
+    ``pltpu.roll``). Needs bz even, Z % bz == 0, Y % 8 == 0, by % 8 == 0.
+    """
+    if interpret is None:
+        interpret = default_interpret()
+    Z, Y, X = interior.shape
+    if Z % 2 or Y % 8:
+        raise ValueError(f"wrap2 kernel needs even Z with an even "
+                         f"divisor block and Y % 8 == 0, got {(Z, Y)}")
+    bz, by = block_z, block_y
+    while bz > 2 and (Z % bz or bz % 2):
+        bz //= 2
+    if bz < 2 or Z % bz or bz % 2:
+        bz = 2
+    while by > 8 and (Y % by or by % 8):
+        by //= 2
+    if by < 8 or Y % by or by % 8:
+        by = 8
+    dt = jnp.dtype(interior.dtype)
+    hx, hy, hz = hot_c
+    cx, cy, cz = cold_c
+    r2 = sph_r * sph_r
+    bzh = bz // 2          # z index maps use 2-row granularity
+    nzh = Z // 2
+    byb = by // 8          # y index maps use 8-col granularity
+    nyb8 = Y // 8
+
+    def sources(vals, z0, y0, nz, ny):
+        """Re-impose Dirichlet spheres on a (nz, ny, X) region whose
+        global origin is (z0, y0, 0). Coords wrap modulo the global
+        size: the step-1 ring outside an edge block is the PERIODIC
+        neighbor, so its sphere test must use the wrapped position."""
+        gy = (y0 + jax.lax.broadcasted_iota(jnp.int32, (ny, X), 0)) % Y
+        gx = jax.lax.broadcasted_iota(jnp.int32, (ny, X), 1)
+        gz = (z0 + jax.lax.broadcasted_iota(jnp.int32, (nz, 1, 1), 0)) % Z
+        d2h = (gx - hx) ** 2 + (gy - hy) ** 2 + (gz - hz) ** 2
+        d2c = (gx - cx) ** 2 + (gy - cy) ** 2 + (gz - cz) ** 2
+        vals = jnp.where(d2h <= r2, dt.type(1.0), vals)
+        vals = jnp.where(d2c <= r2, dt.type(0.0), vals)
+        return vals
+
+    def jstep(w):
+        """One 7-point step on the interior of a (nz, ny, X) window:
+        returns (nz-2, ny-2, X); x is periodic in-core."""
+        zsum = w[:-2, 1:-1] + w[2:, 1:-1]
+        ysum = w[1:-1, :-2] + w[1:-1, 2:]
+        xm = pltpu.roll(w, 1, 2)
+        xp = pltpu.roll(w, X - 1, 2)
+        xsum = (xm + xp)[1:-1, 1:-1]
+        return (zsum + ysum + xsum) * dt.type(1.0 / 6.0)
+
+    def kern(main, zm, zp, ym, yp, mm, mp, pm, pp, out):
+        kz = pl.program_id(0)
+        ky = pl.program_id(1)
+        z0 = kz * bz
+        y0 = ky * by
+        # (bz+4, by+4, X) wrapped window: rows z0-2 .. z0+bz+2
+        top = jnp.concatenate([mm[:, 6:], zm[...], mp[:, :2]], axis=1)
+        mid = jnp.concatenate([ym[:, 6:], main[...], yp[:, :2]], axis=1)
+        bot = jnp.concatenate([pm[:, 6:], zp[...], pp[:, :2]], axis=1)
+        w = jnp.concatenate([top, mid, bot], axis=0)
+        s1 = jstep(w)                         # (bz+2, by+2, X)
+        s1 = sources(s1, z0 - 1, y0 - 1, bz + 2, by + 2)
+        s2 = jstep(s1)                        # (bz, by, X)
+        out[...] = sources(s2, z0, y0, bz, by)
+
+    in_specs = [
+        pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)),
+        # 2-plane z slabs just outside the block, periodic
+        pl.BlockSpec((2, by, X),
+                     lambda kz, ky: ((kz * bzh - 1) % nzh, ky, 0)),
+        pl.BlockSpec((2, by, X),
+                     lambda kz, ky: ((kz * bzh + bzh) % nzh, ky, 0)),
+        # 8-col y slabs just outside the block, periodic
+        pl.BlockSpec((bz, 8, X),
+                     lambda kz, ky: (kz, (ky * byb - 1) % nyb8, 0)),
+        pl.BlockSpec((bz, 8, X),
+                     lambda kz, ky: (kz, (ky * byb + byb) % nyb8, 0)),
+        # (2, 8, X) corners
+        pl.BlockSpec((2, 8, X),
+                     lambda kz, ky: ((kz * bzh - 1) % nzh,
+                                     (ky * byb - 1) % nyb8, 0)),
+        pl.BlockSpec((2, 8, X),
+                     lambda kz, ky: ((kz * bzh - 1) % nzh,
+                                     (ky * byb + byb) % nyb8, 0)),
+        pl.BlockSpec((2, 8, X),
+                     lambda kz, ky: ((kz * bzh + bzh) % nzh,
+                                     (ky * byb - 1) % nyb8, 0)),
+        pl.BlockSpec((2, 8, X),
+                     lambda kz, ky: ((kz * bzh + bzh) % nzh,
+                                     (ky * byb + byb) % nyb8, 0)),
+    ]
+    return pl.pallas_call(
+        kern,
+        grid=(Z // bz, Y // by),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)),
+        out_shape=jax.ShapeDtypeStruct((Z, Y, X), interior.dtype),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*([interior] * 9))
 
 
 # 6th-order central second-derivative coefficients (see ops/fd6.py)

@@ -75,6 +75,48 @@ def test_jacobi7_wrap_pallas_matches_oracle(bz, by):
     np.testing.assert_allclose(got, want, atol=1e-6)
 
 
+@pytest.mark.parametrize("bz,by", [(4, 8), (16, 128), (8, 16)])
+def test_jacobi7_wrap2_pallas_matches_two_steps(bz, by):
+    """The temporally-blocked pair kernel (two fused iterations per
+    HBM pass) against two dense reference steps — including sphere
+    sources re-imposed between the fused steps and periodic-wrap
+    coordinates for the step-1 edge ring."""
+    from stencil_tpu.models.jacobi import dense_reference_step
+    from stencil_tpu.ops.pallas_stencil import jacobi7_wrap2_pallas
+
+    n = 16
+    rng = np.random.default_rng(5)
+    t = rng.random((n, n, n)).astype(np.float32)
+    hot = (n // 3, n // 2, n // 2)
+    cold = (2 * n // 3, n // 2, n // 2)
+    want = dense_reference_step(
+        dense_reference_step(t, hot, cold, n // 10), hot, cold, n // 10)
+    got = np.asarray(jacobi7_wrap2_pallas(jnp.asarray(t), hot, cold,
+                                          n // 10, block_z=bz, block_y=by,
+                                          interpret=True))
+    np.testing.assert_allclose(got, want, atol=2e-6)
+
+
+def test_jacobi_model_wrap_pair_and_tail_matches_oracle():
+    """run(3) through the wrap path = one fused pair + one single-step
+    tail; must match three sequential dense steps."""
+    import jax
+
+    from stencil_tpu.models.jacobi import Jacobi3D, dense_reference_step
+
+    n = 16
+    j = Jacobi3D(n, n, n, mesh_shape=(1, 1, 1), dtype=np.float32,
+                 kernel="wrap", devices=jax.devices()[:1])
+    j.init()
+    temp = j.temperature()
+    hot = (n // 3, n // 2, n // 2)
+    cold = (2 * n // 3, n // 2, n // 2)
+    for _ in range(3):
+        temp = dense_reference_step(temp, hot, cold, n // 10)
+    j.run(3)
+    np.testing.assert_allclose(j.temperature(), temp, atol=2e-6)
+
+
 def test_jacobi_model_wrap_kernel_matches_oracle():
     import jax
 
