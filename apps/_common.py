@@ -56,6 +56,61 @@ def methods_from_args(args) -> Method:
     return m if m != Method.NONE else Method.Default
 
 
+def add_dcn_flags(p: argparse.ArgumentParser) -> None:
+    """Hierarchical slice/host tier (the reference's node-aware
+    NodePartition level, partition.hpp:120-256)."""
+    p.add_argument("--dcn-axis", default=None,
+                   choices=("x", "y", "z", "auto"),
+                   help="block this grid axis across slices/hosts so "
+                        "only its halo sweep crosses the DCN ('auto' "
+                        "derives it from the interface-minimizing "
+                        "split); omit for a flat single-tier mesh")
+    p.add_argument("--fake-slices", type=int, default=0, metavar="S",
+                   help="pretend the devices form S equal slices "
+                        "(testing the DCN tier without multihost "
+                        "hardware)")
+
+
+def dcn_from_args(args):
+    """(dcn_axis, dcn_groups) kwargs for the models."""
+    axis = getattr(args, "dcn_axis", None)
+    fake = getattr(args, "fake_slices", 0)
+    if axis is None and not fake:
+        return {}
+    groups = None
+    if fake:
+        import jax
+        devs = list(jax.devices())
+        if len(devs) % fake:
+            raise SystemExit(f"{len(devs)} devices not divisible into "
+                             f"{fake} fake slices")
+        per = len(devs) // fake
+        groups = [devs[i * per:(i + 1) * per] for i in range(fake)]
+    return {"dcn_axis": axis or "auto", "dcn_groups": groups}
+
+
+def dcn_mesh_shape(args, xfree: bool):
+    """The weak-scaling mesh shape when the DCN tier is requested:
+    the slice-blocked axis must be divisible by the slice count, which
+    the flat default_mesh_shape* helpers don't know about. Returns None
+    when no DCN tier is requested (callers fall back to the flat
+    helpers)."""
+    kw = dcn_from_args(args)
+    if not kw:
+        return None
+    import jax
+    from stencil_tpu.parallel.mesh import default_mesh_shape_dcn
+    from stencil_tpu.parallel.multihost import slice_groups
+    groups = kw["dcn_groups"] or slice_groups()
+    axis = {"x": 0, "y": 1, "z": 2}.get(kw["dcn_axis"], 2)
+    if xfree and axis == 0 and len(groups) > 1:
+        raise SystemExit("--dcn-axis x shards the lane axis, which the "
+                         "halo kernel path cannot use; pick y/z or "
+                         "--kernel xla")
+    return default_mesh_shape_dcn(len(jax.devices()), len(groups),
+                                  axis=axis, xfree=xfree)
+
+
 def add_placement_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trivial", action="store_true",
                    help="trivial placement instead of node-aware")

@@ -8,9 +8,9 @@ iteration loop, CSV line ``devices,nx,ny,nz,iter trimean,exch trimean``
 
 import argparse
 
-from _common import (add_device_flags, apply_device_flags,
-                     add_method_flags, csv_line, methods_from_args,
-                     timed_samples)
+from _common import (add_dcn_flags, add_device_flags, apply_device_flags,
+                     add_method_flags, csv_line, dcn_from_args,
+                     dcn_mesh_shape, methods_from_args, timed_samples)
 
 
 def main() -> None:
@@ -41,6 +41,7 @@ def main() -> None:
                     help="resume from the latest checkpoint in "
                          "--checkpoint-dir")
     add_method_flags(ap)
+    add_dcn_flags(ap)
     add_device_flags(ap)
     args = ap.parse_args()
     apply_device_flags(args)
@@ -52,22 +53,27 @@ def main() -> None:
     import numpy as np
 
     from stencil_tpu.models.astaroth import Astaroth, MhdParams
+    from stencil_tpu.ops.pallas_stencil import on_tpu
     from stencil_tpu.parallel.mesh import (default_mesh_shape,
                                            default_mesh_shape_xfree)
 
     prm = MhdParams.from_conf(args.conf) if args.conf else MhdParams()
     ndev = len(jax.devices())
-    # halo-capable paths want the lane (x) axis unsharded
-    mesh_shape = (default_mesh_shape_xfree(ndev)
-                  if args.kernel in ("auto", "halo") and not args.overlap
-                  else default_mesh_shape(ndev))
+    # halo-capable paths want the lane (x) axis unsharded; "auto" only
+    # selects them on TPU, so keep the cube-like mesh off-TPU
+    xfree = ((args.kernel == "halo"
+              or (args.kernel == "auto" and on_tpu()))
+             and not args.overlap)
+    mesh_shape = (dcn_mesh_shape(args, xfree)
+                  or (default_mesh_shape_xfree(ndev) if xfree
+                      else default_mesh_shape(ndev)))
     gx = args.nx * mesh_shape.x
     gy = args.ny * mesh_shape.y
     gz = args.nz * mesh_shape.z
     m = Astaroth(gx, gy, gz, params=prm, mesh_shape=mesh_shape,
                  dtype=np.float64 if args.f64 else np.float32,
                  methods=methods_from_args(args), overlap=args.overlap,
-                 kernel=args.kernel)
+                 kernel=args.kernel, **dcn_from_args(args))
     m.init()
     start_iter = 0
     if args.checkpoint_dir and args.resume:

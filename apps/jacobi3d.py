@@ -10,9 +10,10 @@ bin/jacobi3d.cu:181-205); CSV result line
 
 import argparse
 
-from _common import (add_device_flags, apply_device_flags,
+from _common import (add_dcn_flags, add_device_flags, apply_device_flags,
                      add_method_flags, add_placement_flags, csv_line,
-                     methods_from_args, placement_from_args, timed_samples)
+                     dcn_from_args, dcn_mesh_shape, methods_from_args,
+                     placement_from_args, timed_samples)
 
 
 def main() -> None:
@@ -36,6 +37,7 @@ def main() -> None:
                          "or pick by hardware (auto)")
     add_method_flags(ap)
     add_placement_flags(ap)
+    add_dcn_flags(ap)
     add_device_flags(ap)
     args = ap.parse_args()
     apply_device_flags(args)
@@ -47,14 +49,18 @@ def main() -> None:
     import numpy as np
 
     from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.ops.pallas_stencil import on_tpu
     from stencil_tpu.parallel.mesh import (default_mesh_shape,
                                            default_mesh_shape_xfree)
 
     ndev = len(jax.devices())
-    # halo-capable paths want the lane (x) axis unsharded
-    mesh_shape = (default_mesh_shape_xfree(ndev)
-                  if args.kernel in ("auto", "halo")
-                  else default_mesh_shape(ndev))
+    # halo-capable paths want the lane (x) axis unsharded; "auto" only
+    # selects them on TPU, so keep the cube-like mesh off-TPU
+    xfree = (args.kernel == "halo"
+             or (args.kernel == "auto" and on_tpu()))
+    mesh_shape = (dcn_mesh_shape(args, xfree)
+                  or (default_mesh_shape_xfree(ndev) if xfree
+                      else default_mesh_shape(ndev)))
     # weak scaling: global = local x mesh (bin/jacobi3d.cu:181-205)
     gx, gy, gz = (args.x * mesh_shape.x, args.y * mesh_shape.y,
                   args.z * mesh_shape.z)
@@ -63,7 +69,8 @@ def main() -> None:
                  dtype=np.float64 if args.f64 else np.float32,
                  methods=methods,
                  placement=placement_from_args(args),
-                 output_prefix=args.prefix, kernel=args.kernel)
+                 output_prefix=args.prefix, kernel=args.kernel,
+                 **dcn_from_args(args))
     j.init()
     if args.paraview:
         j.dd.write_paraview(args.prefix + "jacobi3d_init")

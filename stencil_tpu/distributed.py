@@ -60,6 +60,12 @@ class DistributedDomain:
         self._mesh_shape: Optional[Dim3] = None
         self._output_prefix = os.environ.get("STENCIL_OUTPUT_PREFIX", "")
         self.boundary = Boundary.PERIODIC
+        # hierarchical DCN tier (set_dcn_axis); populated by realize()
+        self._dcn_requested = False
+        self._dcn_axis_req: Optional[int] = None
+        self._dcn_groups = None
+        self.dcn_axis: Optional[int] = None
+        self.n_slices: int = 1
         # populated by realize()
         self.mesh = None
         self.placement: Optional[Placement] = None
@@ -111,6 +117,24 @@ class DistributedDomain:
     def set_boundary(self, b: Boundary) -> None:
         self.boundary = b
 
+    def set_dcn_axis(self, axis: Union[int, str, None] = None,
+                     groups=None) -> None:
+        """Enable the hierarchical node/slice tier (the NodePartition
+        analog, reference: partition.hpp:120-256): one grid axis is
+        blocked across slices/hosts so only that axis's halo sweep
+        crosses the slow DCN while the others ride the ICI.
+
+        ``axis``: 0/'x', 1/'y', 2/'z', or None to derive it from
+        ``NodePartition``'s interface-minimizing split. ``groups``
+        injects an explicit device grouping (testing; otherwise
+        discovered from device slice/process attributes)."""
+        assert self.mesh is None, "set_dcn_axis before realize()"
+        if isinstance(axis, str):
+            axis = {"x": 0, "y": 1, "z": 2, "auto": None}[axis]
+        self._dcn_requested = True
+        self._dcn_axis_req = axis
+        self._dcn_groups = groups
+
     def enable_timing(self, on: bool = True) -> None:
         """The STENCIL_EXCHANGE_STATS analog — off by default because it
         synchronizes every exchange (reference: bin/jacobi3d.cu:149-153
@@ -128,11 +152,32 @@ class DistributedDomain:
         n = len(self._devices)
 
         t0 = time.perf_counter()
+        # --- DCN tier discovery (reference: partition.hpp:120-256) -----
+        groups = None
+        if self._dcn_requested:
+            from .parallel.multihost import slice_groups
+            groups = self._dcn_groups or slice_groups(self._devices)
+            self.n_slices = len(groups)
         # --- partition: choose the subdomain grid ----------------------
         if self._mesh_shape is not None:
             dim = self._mesh_shape
             if dim.flatten() != n:
                 raise ValueError(f"mesh shape {dim} != device count {n}")
+        elif self._dcn_requested and self.n_slices > 1:
+            # two-level interface-minimizing split: slices (DCN tier) x
+            # devices-per-slice (ICI tier)
+            from .partition import NodePartition
+            npart = NodePartition(self.size, self.radius, self.n_slices,
+                                  n // self.n_slices)
+            dim = npart.dim()
+            if self.size % dim != Dim3(0, 0, 0):
+                # XLA wants equal shards; fall back to an exact split,
+                # else the greedy +-1 split (same ladder as the flat
+                # path below)
+                try:
+                    dim = partition_dims_even(self.size, n)
+                except ValueError:
+                    dim = RankPartition(self.size, n).dim()
         else:
             try:
                 dim = partition_dims_even(self.size, n)
@@ -140,6 +185,8 @@ class DistributedDomain:
                 # no exact factorization: fall back to the reference's
                 # greedy split with +-1 remainder subdomains
                 dim = RankPartition(self.size, n).dim()
+        if self._dcn_requested:
+            self.dcn_axis = self._pick_dcn_axis(dim)
         part = RankPartition.from_dim(self.size, dim)
         # per-shard capacity = ceil sizes; uneven shards are one short
         # (reference: partition.hpp:55-69)
@@ -166,8 +213,24 @@ class DistributedDomain:
         # --- placement (reference: src/stencil.cu:201-239) -------------
         t0 = time.perf_counter()
         elem_sizes = [self._dtypes[q].itemsize for q in self._names]
-        self.placement = make_placement(self.strategy, part, self._devices,
-                                        self.radius, elem_sizes)
+        if self._dcn_requested and self.n_slices > 1:
+            # two-tier placement: the slice-blocked device order IS the
+            # assignment (subdomains along dcn_axis block onto slices);
+            # reject contradictory strategy requests rather than
+            # silently overriding an experiment's control placement
+            if self.strategy != PlacementStrategy.NodeAware:
+                raise ValueError(
+                    f"placement strategy {self.strategy.value!r} is "
+                    f"incompatible with the DCN tier (slice blocking "
+                    f"determines the placement)")
+            from .parallel.multihost import multihost_device_order
+            order = multihost_device_order(dim, self.dcn_axis,
+                                           groups=groups)
+            self.placement = Placement(part, order)
+        else:
+            self.placement = make_placement(self.strategy, part,
+                                            self._devices,
+                                            self.radius, elem_sizes)
         self.topology = Topology(dim, self.boundary)
         self.setup_seconds["placement"] = time.perf_counter() - t0
 
@@ -203,9 +266,36 @@ class DistributedDomain:
 
         if self._output_prefix:
             self._write_plan()
+        dcn = (f", dcn axis {'xyz'[self.dcn_axis]}x{self.n_slices}"
+               if self.dcn_axis is not None and self.n_slices > 1 else "")
         LOG_INFO(f"realized {self.size} over mesh {dim} "
                  f"(local {self.local_size}, padded {padded_local}, "
-                 f"method {pick_method(self.methods)})")
+                 f"method {pick_method(self.methods)}{dcn})")
+
+    def _pick_dcn_axis(self, dim: Dim3) -> int:
+        """The mesh axis blocked across slices: the requested one
+        (validated), else the axis NodePartition's interface rule would
+        cut — approximated as the divisible axis with the smallest
+        interface area (fewest DCN bytes)."""
+        ns = self.n_slices
+        if self._dcn_axis_req is not None:
+            a = self._dcn_axis_req
+            if ns > 1 and dim[a] % ns != 0:
+                raise ValueError(f"dcn axis {a} has {dim[a]} mesh rows, "
+                                 f"not divisible by {ns} slices")
+            return a
+        cands = [a for a in range(3) if ns <= 1 or dim[a] % ns == 0]
+        if not cands:
+            raise ValueError(f"no mesh axis of {dim} divisible by "
+                             f"{ns} slices; set_mesh_shape or "
+                             f"set_dcn_axis explicitly")
+        sizes = [self.size.x, self.size.y, self.size.z]
+
+        def iface(a):
+            other = [sizes[b] for b in range(3) if b != a]
+            return other[0] * other[1]
+
+        return min(cands, key=iface)
 
     # ------------------------------------------------------------------
     # iteration hot path
@@ -278,6 +368,22 @@ class DistributedDomain:
         counts = mesh_dim(self.mesh)
         return sum(v * counts.flatten() for v in self._bytes_per_axis.values())
 
+    def exchange_bytes_dcn(self) -> int:
+        """Bytes per exchange crossing the DCN tier, whole mesh: along
+        the DCN axis, ``n_slices`` of the ``counts[axis]`` periodic
+        shard boundaries are inter-slice (the reference's inter-node
+        byte counters, stencil.hpp:86-93)."""
+        if self.dcn_axis is None or self.n_slices <= 1:
+            return 0
+        counts = mesh_dim(self.mesh)
+        c = counts[self.dcn_axis]
+        per_shard = self._bytes_per_axis["xyz"[self.dcn_axis]]
+        return per_shard * counts.flatten() * self.n_slices // c
+
+    def exchange_bytes_ici(self) -> int:
+        """Bytes per exchange staying on the intra-slice ICI."""
+        return self.exchange_bytes_total() - self.exchange_bytes_dcn()
+
     def _write_plan(self) -> None:
         """Emit plan file + communication matrix (reference:
         src/stencil.cu:482-637: plan_<rank>.txt and the rank x rank
@@ -297,6 +403,13 @@ class DistributedDomain:
                 f.write(f"subdomain {i} idx {idx} -> device {dev}\n")
             for axis, b in self._bytes_per_axis.items():
                 f.write(f"bytes per shard per exchange, axis {axis}: {b}\n")
+            if self.dcn_axis is not None and self.n_slices > 1:
+                f.write(f"dcn axis: {'xyz'[self.dcn_axis]} "
+                        f"({self.n_slices} slices)\n")
+                f.write(f"bytes per exchange over DCN (whole mesh): "
+                        f"{self.exchange_bytes_dcn()}\n")
+                f.write(f"bytes per exchange over ICI (whole mesh): "
+                        f"{self.exchange_bytes_ici()}\n")
         from .placement import comm_bytes_matrix
         w = comm_bytes_matrix(self.placement.part, self.radius,
                               [self._dtypes[q].itemsize for q in self._names])

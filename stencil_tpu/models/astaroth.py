@@ -215,13 +215,21 @@ class Astaroth:
                  dtype=jnp.float32,
                  devices: Optional[Sequence] = None,
                  methods: Method = Method.PpermutePacked,
-                 overlap: bool = False, kernel: str = "auto") -> None:
+                 overlap: bool = False, kernel: str = "auto",
+                 dcn_axis=None, dcn_groups=None) -> None:
         self.prm = params or MhdParams()
         self.dd = DistributedDomain(nx, ny, nz, devices=devices)
         self.dd.set_radius(Radius.constant(RADIUS))
         self.dd.set_methods(methods)
+        if dcn_axis is not None or dcn_groups is not None:
+            self.dd.set_dcn_axis(dcn_axis, dcn_groups)
         if mesh_shape is not None:
             self.dd.set_mesh_shape(mesh_shape)
+        elif dcn_axis is not None or dcn_groups is not None:
+            # DCN tier with no explicit shape: let realize() derive the
+            # grid from NodePartition's two-level split, which knows the
+            # slice count (the auto x-free pick below does not)
+            pass
         else:
             from ..ops.pallas_stencil import on_tpu
             if (len(self.dd._devices) > 1 and not overlap

@@ -118,16 +118,24 @@ class Jacobi3D:
                  devices: Optional[Sequence] = None,
                  methods: Method = Method.Default,
                  placement=None, output_prefix: str = "",
-                 kernel: str = "auto", overlap: bool = False) -> None:
+                 kernel: str = "auto", overlap: bool = False,
+                 dcn_axis=None, dcn_groups=None) -> None:
         self.dd = DistributedDomain(x, y, z, devices=devices)
         self.dd.set_radius(1)
         self.dd.set_methods(methods)
+        if dcn_axis is not None or dcn_groups is not None:
+            self.dd.set_dcn_axis(dcn_axis, dcn_groups)
         if placement is not None:
             self.dd.set_placement(placement)
         if output_prefix:
             self.dd.set_output_prefix(output_prefix)
         if mesh_shape is not None:
             self.dd.set_mesh_shape(mesh_shape)
+        elif dcn_axis is not None or dcn_groups is not None:
+            # DCN tier with no explicit shape: let realize() derive the
+            # grid from NodePartition's two-level split, which knows the
+            # slice count (the auto x-free pick below does not)
+            pass
         else:
             from ..ops.pallas_stencil import on_tpu
             if (len(self.dd._devices) > 1 and not overlap

@@ -103,6 +103,22 @@ def default_mesh_shape_xfree(n: int) -> Dim3:
     return Dim3(1, dims[1], dims[0])
 
 
+def default_mesh_shape_dcn(n: int, n_slices: int, axis: int = 2,
+                           xfree: bool = False) -> Dim3:
+    """Mesh shape whose ``axis`` is divisible by ``n_slices`` (the
+    constraint the slice-blocked DCN tier needs): the slice factor goes
+    on ``axis`` and the per-slice remainder is factored near-cubic
+    (or x-unsharded when ``xfree``)."""
+    if n % n_slices:
+        raise ValueError(f"{n} devices not divisible into {n_slices} "
+                         f"slices")
+    base = (default_mesh_shape_xfree(n // n_slices) if xfree
+            else default_mesh_shape(n // n_slices))
+    dims = [base.x, base.y, base.z]
+    dims[axis] *= n_slices
+    return Dim3(*dims)
+
+
 def mesh_dim(mesh: Mesh) -> Dim3:
     """Subdomain-grid shape (x, y, z) of a 3D mesh."""
     return Dim3(mesh.shape["x"], mesh.shape["y"], mesh.shape["z"])

@@ -65,14 +65,15 @@ def slice_groups(devices: Optional[Sequence] = None) -> List[List]:
     return [groups[k] for k in sorted(groups)]
 
 
-def make_multihost_mesh(mesh_shape: Dim3Like, dcn_axis: int = 2,
-                        devices: Optional[Sequence] = None,
-                        groups: Optional[List[List]] = None):
-    """Build the 3D spatial mesh with ``dcn_axis`` blocked across
-    slices/hosts: subdomains whose ``dcn_axis`` index falls in slice
-    ``s``'s block are placed on slice ``s``'s devices, so only that
-    axis's halo sweep crosses the DCN (NodePartition's two-level split,
-    reference: partition.hpp:120-256, re-expressed as device order).
+def multihost_device_order(mesh_shape: Dim3Like, dcn_axis: int = 2,
+                           devices: Optional[Sequence] = None,
+                           groups: Optional[List[List]] = None) -> List:
+    """Device order (subdomain linear index, x fastest) for a 3D mesh
+    with ``dcn_axis`` blocked across slices/hosts: subdomains whose
+    ``dcn_axis`` index falls in slice ``s``'s block are placed on slice
+    ``s``'s devices, so only that axis's halo sweep crosses the DCN
+    (NodePartition's two-level split, reference: partition.hpp:120-256,
+    re-expressed as device order).
 
     ``groups`` injects an explicit device grouping (testing; otherwise
     discovered via ``slice_groups``).
@@ -103,7 +104,17 @@ def make_multihost_mesh(mesh_shape: Dim3Like, dcn_axis: int = 2,
                 g = idx // per_block
                 device_list.append(ordered[g][taken[g]])
                 taken[g] += 1
-    return make_mesh(shape, device_list)
+    return device_list
+
+
+def make_multihost_mesh(mesh_shape: Dim3Like, dcn_axis: int = 2,
+                        devices: Optional[Sequence] = None,
+                        groups: Optional[List[List]] = None):
+    """3D spatial mesh built from ``multihost_device_order`` — see
+    there for the slice-blocking rule."""
+    shape = Dim3.of(mesh_shape)
+    return make_mesh(shape, multihost_device_order(
+        shape, dcn_axis, devices=devices, groups=groups))
 
 
 def dcn_bytes_per_exchange(dd, dcn_axis: int = 2) -> int:
