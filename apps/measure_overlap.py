@@ -30,27 +30,66 @@ def main() -> None:
     import numpy as np
 
     from stencil_tpu.models.jacobi import Jacobi3D
-    from stencil_tpu.parallel.mesh import default_mesh_shape
+    from stencil_tpu.parallel.mesh import (default_mesh_shape,
+                                           default_mesh_shape_xfree)
     from stencil_tpu.utils.timers import device_sync
 
     ndev = len(jax.devices())
-    mesh_shape = default_mesh_shape(ndev)
+    # x-unsharded so the overlapped run can take the in-kernel RDMA
+    # path (ops/pallas_overlap.py) rather than the XLA-schedule split
+    mesh_shape = (default_mesh_shape_xfree(ndev) if ndev > 1
+                  else default_mesh_shape(ndev))
     gx, gy, gz = (args.x * mesh_shape.x, args.y * mesh_shape.y,
                   args.z * mesh_shape.z)
 
+    # all three programs use the same kernel family so the efficiency
+    # ratio is interpretable: fused = slab exchange THEN halo kernel
+    # (serialized); overlap = in-kernel RDMA hidden behind the compute;
+    # exchange_only = exactly the slab-exchange program fused runs.
     results = {}
-    fused = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape, dtype=np.float32)
+    kern = "halo" if ndev > 1 else "auto"
+    fused = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape, dtype=np.float32,
+                     kernel=kern)
     fused.init()
     stats = timed_samples(fused.step, fused.block, args.iters)
     results["fused"] = stats.trimean()
 
-    dd = fused.dd
-    stats = timed_samples(dd.exchange, lambda: device_sync(dd.curr),
-                          args.iters)
-    results["exchange_only"] = stats.trimean()
+    if ndev > 1:
+        from functools import partial
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from stencil_tpu.parallel.exchange import exchange_interior_slabs
+        from stencil_tpu.parallel.mesh import mesh_dim
+
+        dd = fused.dd
+        counts = mesh_dim(dd.mesh)
+        esub = 8 if dd.local_size.y % 8 == 0 else 1
+        spec = P("z", "y", "x")
+        sm = jax.jit(jax.shard_map(
+            partial(exchange_interior_slabs, mesh_counts=counts, rz=1,
+                    ry=esub),
+            mesh=dd.mesh, in_specs=spec, out_specs=spec,
+            check_vma=False))
+        q = jax.device_put(
+            np.zeros((gz, gy, gx), np.float32),
+            NamedSharding(dd.mesh, spec))
+        out = [None]
+
+        def ex_only():
+            out[0] = sm(q)
+
+        stats = timed_samples(ex_only, lambda: device_sync(out[0]),
+                              args.iters)
+        results["exchange_only"] = stats.trimean()
+    else:
+        dd = fused.dd
+        stats = timed_samples(dd.exchange, lambda: device_sync(dd.curr),
+                              args.iters)
+        results["exchange_only"] = stats.trimean()
 
     over = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape, dtype=np.float32,
-                    overlap=True)
+                    overlap=True, kernel=kern)
     over.init()
     stats = timed_samples(over.step, over.block, args.iters)
     results["overlap"] = stats.trimean()

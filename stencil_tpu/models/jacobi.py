@@ -190,25 +190,59 @@ class Jacobi3D:
         # exchange + fused halo kernel (ops/pallas_halo.py)
         halo_ok = (counts.x == 1 and rem == Dim3(0, 0, 0)
                    and not self._overlap and radius_ok)
+        # the overlapped fast path: in-kernel RDMA slab exchange hidden
+        # behind the interior compute (ops/pallas_overlap.py) — the
+        # reference's interior/exchange/exterior choreography as one
+        # kernel (bin/jacobi3d.cu:296-377)
+        overlap_ok = (self._overlap and counts.x == 1
+                      and rem == Dim3(0, 0, 0) and radius_ok
+                      and local.z >= 4 and local.y >= 2)
+        from ..ops.pallas_stencil import on_tpu
+        from ..utils.logging import LOG_INFO
+        # explicit kernel='halo' with overlap opts into the RDMA overlap
+        # kernel anywhere (tests run it interpreted); 'auto' only
+        # selects Pallas paths on real TPU hardware
+        if overlap_ok and (kernel == "halo"
+                           or (kernel == "auto" and on_tpu())):
+            self.kernel_path = "overlap"
+            self._build_overlap_step()
+            LOG_INFO("jacobi kernel path: overlap (in-kernel RDMA)")
+            return
         if kernel == "auto":
-            from ..ops.pallas_stencil import on_tpu
             if on_tpu():
                 kernel = ("wrap" if wrap_ok
                           else "halo" if halo_ok else "xla")
             else:
                 kernel = "xla"
+            why = ""
+            if kernel == "xla" and on_tpu():
+                blockers = []
+                if counts.x != 1:
+                    blockers.append("x-axis sharded")
+                if rem != Dim3(0, 0, 0):
+                    blockers.append("uneven (+-1) grid")
+                if self._overlap:
+                    blockers.append("overlap requested")
+                if not radius_ok:
+                    blockers.append("radius != 1")
+                why = f" (fast paths unavailable: {', '.join(blockers)})"
+            LOG_INFO(f"jacobi kernel path: {kernel}{why}")
         if kernel == "wrap":
             if not wrap_ok:
                 raise ValueError("kernel='wrap' needs a (1,1,1) mesh, "
                                  "radius 1, even grid, overlap off")
+            self.kernel_path = "wrap"
             self._build_wrap_step()
             return
         if kernel == "halo":
             if not halo_ok:
                 raise ValueError("kernel='halo' needs an x-unsharded "
-                                 "mesh, radius 1, even grid, overlap off")
+                                 "mesh, radius 1, even grid, overlap "
+                                 "off (or overlap with local z>=4)")
+            self.kernel_path = "halo"
             self._build_halo_step()
             return
+        self.kernel_path = f"{kernel}-overlap" if self._overlap else kernel
         step_fn = (jacobi_shard_step_overlap if self._overlap
                    else jacobi_shard_step)
 
@@ -275,23 +309,18 @@ class Jacobi3D:
         self._step = jax.jit(
             lambda p: steps(p, jnp.asarray(1, jnp.int32)), donate_argnums=0)
 
-    def _build_halo_step(self) -> None:
-        """Multi-device fused steps: interior-resident shards, thin slab
-        ppermutes, one fused Pallas kernel per step — so an N-chip mesh
-        keeps single-chip per-chip throughput (the analog of the
-        reference's fused solve kernel running at every scale,
-        astaroth/astaroth.cu:552-646; see ops/pallas_halo.py)."""
-        from ..ops.pallas_halo import jacobi7_halo_pallas
-        from ..parallel.exchange import (exchange_interior_slabs,
-                                         shard_origin)
+    def _build_interior_resident_steps(self, make_body) -> None:
+        """Shared scaffolding for the interior-resident multi-device
+        builders: slice the unpadded interior out of the padded shard,
+        fori_loop the per-iteration body from ``make_body(org)``, write
+        the interior back (halos go stale; nothing reads them before
+        the next exchange, and temperature() reads the interior only),
+        all inside one shard_map/jit with buffer donation."""
+        from ..parallel.exchange import shard_origin
 
         dd = self.dd
         lo = dd.radius.pad_lo()
         local = dd.local_size
-        counts = mesh_dim(dd.mesh)
-        gsize = dd.size
-        hot, cold, sph_r = sphere_geometry(gsize)
-        esub = 8 if local.y % 8 == 0 else 1
 
         def shard_steps(p, n):
             ox, oy, oz = shard_origin(local, Dim3(0, 0, 0))
@@ -299,14 +328,8 @@ class Jacobi3D:
             inner = lax.slice(p, (lo.z, lo.y, lo.x),
                               (lo.z + local.z, lo.y + local.y,
                                lo.x + local.x))
-
-            def body(_, q):
-                slabs = exchange_interior_slabs(q, counts, rz=1, ry=esub)
-                return jacobi7_halo_pallas(q, slabs, org, hot, cold, sph_r)
-
-            inner = lax.fori_loop(0, n, body, inner)
-            # halos go stale; nothing reads them before the next
-            # exchange, and temperature() reads the interior only
+            body = make_body(org)
+            inner = lax.fori_loop(0, n, lambda _, q: body(q), inner)
             return lax.dynamic_update_slice(p, inner, (lo.z, lo.y, lo.x))
 
         spec = P("z", "y", "x")
@@ -315,6 +338,49 @@ class Jacobi3D:
         self._step_n = jax.jit(sm, donate_argnums=0)
         self._step = jax.jit(
             lambda p: sm(p, jnp.asarray(1, jnp.int32)), donate_argnums=0)
+
+    def _build_halo_step(self) -> None:
+        """Multi-device fused steps: interior-resident shards, thin slab
+        ppermutes, one fused Pallas kernel per step — so an N-chip mesh
+        keeps single-chip per-chip throughput (the analog of the
+        reference's fused solve kernel running at every scale,
+        astaroth/astaroth.cu:552-646; see ops/pallas_halo.py)."""
+        from ..ops.pallas_halo import jacobi7_halo_pallas
+        from ..parallel.exchange import exchange_interior_slabs
+
+        dd = self.dd
+        local = dd.local_size
+        counts = mesh_dim(dd.mesh)
+        hot, cold, sph_r = sphere_geometry(dd.size)
+        esub = 8 if local.y % 8 == 0 else 1
+
+        def make_body(org):
+            def body(q):
+                slabs = exchange_interior_slabs(q, counts, rz=1, ry=esub)
+                return jacobi7_halo_pallas(q, slabs, org, hot, cold,
+                                           sph_r)
+            return body
+
+        self._build_interior_resident_steps(make_body)
+
+    def _build_overlap_step(self) -> None:
+        """Overlapped multi-device fused steps: ONE Pallas kernel per
+        iteration issues the slab RDMA, computes the interior while the
+        transfers fly, and fixes the faces once they land (the
+        reference's polled-transport overlap, src/stencil.cu:1081-1118,
+        as a single kernel; see ops/pallas_overlap.py)."""
+        from ..ops.pallas_overlap import jacobi7_overlap_pallas
+
+        counts = mesh_dim(self.dd.mesh)
+        hot, cold, sph_r = sphere_geometry(self.dd.size)
+
+        def make_body(org):
+            def body(q):
+                return jacobi7_overlap_pallas(q, org, hot, cold, sph_r,
+                                              counts)
+            return body
+
+        self._build_interior_resident_steps(make_body)
 
     def step(self) -> None:
         """One iteration: exchange + 7-point update + sources."""

@@ -69,6 +69,40 @@ def test_jacobi_overlap_matches_fused():
     np.testing.assert_allclose(b.temperature(), a.temperature(), atol=1e-6)
 
 
+def test_jacobi_overlap_kernel_in_kernel_rdma():
+    """overlap=True on an x-unsharded even mesh routes to the in-kernel
+    RDMA overlap kernel (ops/pallas_overlap.py) — interior computed
+    while slabs fly, faces fixed after. Must match the dense oracle
+    over several steps, odd and even counts (ripple analog of
+    reference src/stencil.cu:1081-1118 overlap choreography)."""
+    import jax
+
+    from stencil_tpu.models.jacobi import Jacobi3D, dense_reference_step
+
+    n = 32
+    for mesh_shape in [(1, 2, 4), (1, 4, 2)]:
+        # kernel="halo" + overlap opts into the RDMA overlap kernel
+        # even off-TPU (auto only takes it on hardware)
+        j = Jacobi3D(n, n, n, mesh_shape=mesh_shape, dtype=np.float32,
+                     overlap=True, kernel="halo")
+        # confirm the overlap kernel path was selected (not the XLA
+        # interior/exterior split)
+        assert j.kernel_path == "overlap", j.kernel_path
+        j.init()
+        temp = j.temperature()
+        hot = (n // 3, n // 2, n // 2)
+        cold = (2 * n // 3, n // 2, n // 2)
+        for _ in range(3):
+            temp = dense_reference_step(temp, hot, cold, n // 10)
+            j.step()
+        np.testing.assert_allclose(j.temperature(), temp, atol=2e-6,
+                                   err_msg=str(mesh_shape))
+        j.run(2)
+        for _ in range(2):
+            temp = dense_reference_step(temp, hot, cold, n // 10)
+        np.testing.assert_allclose(j.temperature(), temp, atol=2e-6)
+
+
 def test_astaroth_overlap_matches_fused():
     from stencil_tpu.models.astaroth import Astaroth, MhdParams
 
