@@ -187,9 +187,10 @@ class Jacobi3D:
         wrap_ok = (counts == Dim3(1, 1, 1) and rem == Dim3(0, 0, 0)
                    and not self._overlap and radius_ok)
         # the multi-device fast path: interior-resident shards + slab
-        # exchange + fused halo kernel (ops/pallas_halo.py)
-        halo_ok = (counts.x == 1 and rem == Dim3(0, 0, 0)
-                   and not self._overlap and radius_ok)
+        # exchange + fused halo kernel (ops/pallas_halo.py); uneven
+        # (+-1) z/y shards supported via the kernel's interior-length
+        # overlay (x is never sharded here, so rem.x is always 0)
+        halo_ok = (counts.x == 1 and not self._overlap and radius_ok)
         # the overlapped fast path: in-kernel RDMA slab exchange hidden
         # behind the interior compute (ops/pallas_overlap.py) — the
         # reference's interior/exchange/exterior choreography as one
@@ -219,8 +220,6 @@ class Jacobi3D:
                 blockers = []
                 if counts.x != 1:
                     blockers.append("x-axis sharded")
-                if rem != Dim3(0, 0, 0):
-                    blockers.append("uneven (+-1) grid")
                 if self._overlap:
                     blockers.append("overlap requested")
                 if not radius_ok:
@@ -237,8 +236,8 @@ class Jacobi3D:
         if kernel == "halo":
             if not halo_ok:
                 raise ValueError("kernel='halo' needs an x-unsharded "
-                                 "mesh, radius 1, even grid, overlap "
-                                 "off (or overlap with local z>=4)")
+                                 "mesh, radius 1, overlap off (or "
+                                 "overlap with local z>=4)")
             self.kernel_path = "halo"
             self._build_halo_step()
             return
@@ -321,9 +320,10 @@ class Jacobi3D:
         dd = self.dd
         lo = dd.radius.pad_lo()
         local = dd.local_size
+        rem = dd.rem
 
         def shard_steps(p, n):
-            ox, oy, oz = shard_origin(local, Dim3(0, 0, 0))
+            ox, oy, oz = shard_origin(local, rem)
             org = jnp.stack([oz, oy, ox]).astype(jnp.int32)
             inner = lax.slice(p, (lo.z, lo.y, lo.x),
                               (lo.z + local.z, lo.y + local.y,
@@ -346,19 +346,27 @@ class Jacobi3D:
         reference's fused solve kernel running at every scale,
         astaroth/astaroth.cu:552-646; see ops/pallas_halo.py)."""
         from ..ops.pallas_halo import jacobi7_halo_pallas
-        from ..parallel.exchange import exchange_interior_slabs
+        from ..parallel.exchange import (exchange_interior_slabs,
+                                         shard_interior_len)
 
         dd = self.dd
         local = dd.local_size
         counts = mesh_dim(dd.mesh)
+        rem = dd.rem
         hot, cold, sph_r = sphere_geometry(dd.size)
         esub = 8 if local.y % 8 == 0 else 1
 
         def make_body(org):
+            lens = jnp.stack([
+                jnp.asarray(shard_interior_len(2, local.z, rem)),
+                jnp.asarray(shard_interior_len(1, local.y, rem)),
+            ]).astype(jnp.int32)
+
             def body(q):
-                slabs = exchange_interior_slabs(q, counts, rz=1, ry=esub)
+                slabs = exchange_interior_slabs(q, counts, rz=1, ry=esub,
+                                                rem=rem)
                 return jacobi7_halo_pallas(q, slabs, org, hot, cold,
-                                           sph_r)
+                                           sph_r, interior_len_zy=lens)
             return body
 
         self._build_interior_resident_steps(make_body)

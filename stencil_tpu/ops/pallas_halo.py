@@ -104,6 +104,7 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
                         cold_c: Tuple[int, int, int], sph_r: int,
                         block_z: Optional[int] = None,
                         block_y: Optional[int] = None,
+                        interior_len_zy: Optional[jnp.ndarray] = None,
                         interpret: Optional[bool] = None) -> jnp.ndarray:
     """Fused 7-point Jacobi step + Dirichlet sphere sources on one
     interior-resident (Z, Y, X) shard with exchanged halo slabs.
@@ -117,12 +118,21 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
     (3,), traced under shard_map) for the sphere sources. x must be
     unsharded (periodic x wrap is done in-kernel via ``pltpu.roll``).
 
+    ``interior_len_zy``: traced int32 (2,) = this shard's ACTUAL
+    (z, y) interior extents for uneven (+-1) grids (reference:
+    partition.hpp:55-86) — (Z, Y) are then capacities with a dead tail
+    row/column on short shards; the stencil reads the neighbor slab at
+    row Lz-1 / column Ly-1 instead of the capacity edge, and dead cells
+    hold don't-care values. Omit for evenly divided grids.
+
     Semantics match ``jacobi7_wrap_pallas`` (which is the special case
     where every slab is the shard's own wrapped edge).
     """
     if interpret is None:
         interpret = default_interpret()
     Z, Y, X = interior.shape
+    if interior_len_zy is None:
+        interior_len_zy = jnp.array([Z, Y], jnp.int32)
     esub = slabs["ylo"].shape[1]
     rz = slabs["zlo"].shape[0]
     assert slabs["zlo"].shape == (rz, Y, X), slabs["zlo"].shape
@@ -149,10 +159,12 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
     nyb = Y // by
     byb = by // esub
 
-    def kern(org, zprev, main, znext, yprev, ynext,
+    def kern(org, lens, zprev, main, znext, yprev, ynext,
              zlo, zhi, ylo, yhi, out):
         kz = pl.program_id(0)
         ky = pl.program_id(1)
+        Lz = lens[0]
+        Ly = lens[1]
         c = main[...]                              # (bz, by, X)
         ym_slab = jnp.where(ky == 0, ylo[...], yprev[...])
         yp_slab = jnp.where(ky == nyb - 1, yhi[...], ynext[...])
@@ -160,6 +172,11 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
                                yp_slab[:, 0:1]], axis=1)
         ym = ext[:, :by]
         yp = ext[:, 2:]
+        # uneven overlay: the column at the shard's ACTUAL y end reads
+        # the y-plus slab, wherever it falls (equals the static pick
+        # when Ly == Y, so even grids pay only this select)
+        col = ky * by + jax.lax.broadcasted_iota(jnp.int32, (1, by, 1), 1)
+        yp = jnp.where(col == Ly - 1, yhi[:, 0:1], yp)
         xm = pltpu.roll(c, 1, 2)
         xp = pltpu.roll(c, X - 1, 2)
         lat = ym + yp + xm + xp
@@ -176,8 +193,12 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
         for r in range(bz):
             zm = zm0 if r == 0 else c[r - 1]
             zp = zp_last if r == bz - 1 else c[r + 1]
+            grow = kz * bz + r
+            # uneven overlay: the row at the shard's actual z end reads
+            # the z-plus slab
+            zp = jnp.where(grow == Lz - 1, zhi[0], zp)
             new = (lat[r] + zm + zp) * dt.type(1.0 / 6.0)
-            gz = oz + kz * bz + r
+            gz = oz + grow
             new = jnp.where(d2yx_h + (gz - hz) ** 2 <= r2,
                             dt.type(1.0), new)
             new = jnp.where(d2yx_c + (gz - cz) ** 2 <= r2,
@@ -190,6 +211,7 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
     # use them so Pallas's revisit cache skips the refetch.
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),                  # origin
+        pl.BlockSpec(memory_space=pltpu.SMEM),                  # lens
         pl.BlockSpec((1, by, X),
                      lambda kz, ky: (jnp.maximum(kz * bz - 1, 0), ky, 0)),
         pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)),
@@ -203,8 +225,12 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
                                                      Y // esub - 1), 0)),
         pl.BlockSpec((1, by, X),
                      lambda kz, ky: (rz - 1, jnp.where(kz == 0, ky, 0), 0)),
+        # zhi is read at the block holding row Lz-1: block nzb-1, or
+        # nzb-2 on a short (+-1) shard when bz == 1 — fetch the real
+        # y-block for both, pin elsewhere (revisit-cache skip)
         pl.BlockSpec((1, by, X),
-                     lambda kz, ky: (0, jnp.where(kz == nzb - 1, ky, 0), 0)),
+                     lambda kz, ky: (0, jnp.where(kz >= nzb - 2, ky, 0),
+                                     0)),
         pl.BlockSpec((bz, esub, X), lambda kz, ky: (kz, 0, 0)),
         pl.BlockSpec((bz, esub, X), lambda kz, ky: (kz, 0, 0)),
     ]
@@ -220,9 +246,10 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
-    )(jnp.asarray(origin_zyx, jnp.int32), interior, interior, interior,
-      interior, interior, slabs["zlo"], slabs["zhi"], slabs["ylo"],
-      slabs["yhi"])
+    )(jnp.asarray(origin_zyx, jnp.int32),
+      jnp.asarray(interior_len_zy, jnp.int32), interior, interior,
+      interior, interior, interior, slabs["zlo"], slabs["zhi"],
+      slabs["ylo"], slabs["yhi"])
 
 
 def mhd_halo_blocks(Z: int, Y: int, block_z: int = 8,

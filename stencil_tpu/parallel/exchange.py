@@ -144,7 +144,8 @@ def exchange_shard(arr: jnp.ndarray, radius: Radius,
 
 def exchange_interior_slabs(p: jnp.ndarray, mesh_counts: Dim3,
                             rz: int, ry: int, radius_rows: int = 0,
-                            y_z_extended: bool = False
+                            y_z_extended: bool = False,
+                            rem: Dim3 = Dim3(0, 0, 0)
                             ) -> Dict[str, jnp.ndarray]:
     """Exchange halo SLABS of one interior-resident (unpadded) shard —
     the data plane of the fused halo kernels (ops/pallas_halo.py).
@@ -171,6 +172,12 @@ def exchange_interior_slabs(p: jnp.ndarray, mesh_counts: Dim3,
     mesh axis the shift degenerates to the shard's own wrapped edge
     (periodic). x must not be mesh-sharded (the halo kernels wrap x
     in-kernel). Must be traced inside ``shard_map``.
+
+    ``rem``: uneven (+-1) subdomain counts. Shards are capacity-sized
+    with a dead tail row/column on short shards, so the hi-edge sends
+    come from the shard's ACTUAL last interior rows (dynamic slice at
+    ``shard_interior_len - r``, the partition.hpp:55-69 rule); lo-edge
+    sends start at 0 regardless. Not supported with ``y_z_extended``.
     """
     Z = p.shape[0]
     Y = p.shape[1]
@@ -179,6 +186,9 @@ def exchange_interior_slabs(p: jnp.ndarray, mesh_counts: Dim3,
     ny = mesh_counts.y
     r = radius_rows or min(rz, ry)
     assert r <= rz and r <= ry, (r, rz, ry)
+    uneven = rem != Dim3(0, 0, 0)
+    assert not (uneven and y_z_extended), \
+        "uneven shards unsupported with z-extended y slabs"
     dt = p.dtype
 
     def zfill(n, yext):
@@ -188,24 +198,42 @@ def exchange_interior_slabs(p: jnp.ndarray, mesh_counts: Dim3,
         return jnp.zeros((zext, n, X), dt)
 
     # r-row wire transfers (reference sends exactly the halo bytes,
-    # src/packer.cu:78-82; buffers are padded to block-aligned rows)
-    zlo_r = _shift_from_minus(lax.slice_in_dim(p, Z - r, Z, axis=0), "z", nz)
+    # src/packer.cu:78-82; buffers are padded to block-aligned rows).
+    # Hi-edge sends slice at the actual interior end (traced when
+    # uneven; shard_interior_len collapses to the static Z/Y otherwise).
+    Lz = shard_interior_len(2, Z, rem)
+    Ly = shard_interior_len(1, Y, rem)
+    if uneven and rem[2] != 0:
+        ztop = lax.dynamic_slice_in_dim(p, Lz - r, r, axis=0)
+    else:
+        ztop = lax.slice_in_dim(p, Z - r, Z, axis=0)
+    zlo_r = _shift_from_minus(ztop, "z", nz)
     zhi_r = _shift_from_plus(lax.slice_in_dim(p, 0, r, axis=0), "z", nz)
     if y_z_extended:
         # this shard's y-edge columns spanning z in [-r, Z+r): own
         # interior plus the just-received z slabs (corner ride-along)
-        def ysrc(y0, y1):
+        def ysrc_hi():
             return jnp.concatenate(
-                [zlo_r[:, y0:y1], p[:, y0:y1], zhi_r[:, y0:y1]], axis=0)
+                [zlo_r[:, Y - r:Y], p[:, Y - r:Y], zhi_r[:, Y - r:Y]],
+                axis=0)
+
+        def ysrc_lo():
+            return jnp.concatenate(
+                [zlo_r[:, 0:r], p[:, 0:r], zhi_r[:, 0:r]], axis=0)
         zext = Z + 2 * rz
         zoff = rz - r
     else:
-        def ysrc(y0, y1):
-            return p[:, y0:y1]
+        def ysrc_hi():
+            if uneven and rem[1] != 0:
+                return lax.dynamic_slice_in_dim(p, Ly - r, r, axis=1)
+            return p[:, Y - r:Y]
+
+        def ysrc_lo():
+            return p[:, 0:r]
         zext = Z
         zoff = 0
-    ylo_r = _shift_from_minus(ysrc(Y - r, Y), "y", ny)
-    yhi_r = _shift_from_plus(ysrc(0, r), "y", ny)
+    ylo_r = _shift_from_minus(ysrc_hi(), "y", ny)
+    yhi_r = _shift_from_plus(ysrc_lo(), "y", ny)
 
     zlo = (zlo_r if rz == r
            else jnp.concatenate([zfill(rz - r, Y), zlo_r], axis=0))

@@ -69,6 +69,80 @@ def test_jacobi_halo_matches_dense(mesh_shape):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.parametrize("gzyx,mesh_shape,blocks", [
+    ((9, 16, 16), (1, 1, 2), (1, 8)),    # bz=1: row Lz-1 in block nzb-2
+    ((9, 17, 16), (1, 2, 2), (1, 8)),    # + uneven y
+    ((10, 15, 16), (1, 2, 2), (2, 8)),   # uneven y only, small blocks
+])
+def test_jacobi_halo_uneven_small_blocks(gzyx, mesh_shape, blocks):
+    """Uneven (+-1) shards with explicit small blockings: the zhi slab
+    must be fetched with the true y-block wherever row Lz-1 falls
+    (regression: the revisit-cache pin to y-block 0 leaked into the
+    short shard's last interior row when bz == 1 and nyb > 1)."""
+    from stencil_tpu.parallel.exchange import shard_interior_len
+
+    gz, gy, gx = gzyx
+    mesh = make_mesh(mesh_shape,
+                     jax.devices()[:Dim3.of(mesh_shape).flatten()])
+    counts = mesh_dim(mesh)
+    from stencil_tpu.numerics import div_ceil
+    local = Dim3(gx, div_ceil(gy, counts.y), div_ceil(gz, counts.z))
+    rem = Dim3(0, gy % counts.y, gz % counts.z)
+    hot = (gx // 3, gy // 2, gz // 2)
+    cold = (gx * 2 // 3, gy // 2, gz // 2)
+    sph_r = gx // 10
+    esub = 8 if local.y % 8 == 0 else 1
+    bz, by = blocks
+
+    def shard_step(p):
+        ox, oy, oz = shard_origin(local, rem)
+        org = jnp.stack([oz, oy, ox]).astype(jnp.int32)
+        lens = jnp.stack([
+            jnp.asarray(shard_interior_len(2, local.z, rem)),
+            jnp.asarray(shard_interior_len(1, local.y, rem)),
+        ]).astype(jnp.int32)
+        slabs = exchange_interior_slabs(p, counts, rz=1, ry=esub,
+                                        rem=rem)
+        return jacobi7_halo_pallas(p, slabs, org, hot, cold, sph_r,
+                                   block_z=bz, block_y=by,
+                                   interior_len_zy=lens)
+
+    spec = P("z", "y", "x")
+    sm = jax.jit(jax.shard_map(shard_step, mesh=mesh, in_specs=spec,
+                               out_specs=spec, check_vma=False))
+    rng = np.random.default_rng(13)
+    # capacity-padded global: valid data in the per-shard interiors
+    capz = local.z * counts.z
+    capy = local.y * counts.y
+    init = rng.uniform(0.0, 1.0, (gz, gy, gx)).astype(np.float64)
+    want = dense_reference_step(init, hot, cold, sph_r)
+    # scatter into capacity layout
+    cap = np.zeros((capz, capy, gx))
+    for iz in range(counts.z):
+        for iy in range(counts.y):
+            Lz = local.z - (1 if rem.z and iz >= rem.z else 0)
+            Ly = local.y - (1 if rem.y and iy >= rem.y else 0)
+            oz = iz * local.z - max(iz - rem.z, 0) if rem.z else iz * local.z
+            oy = iy * local.y - max(iy - rem.y, 0) if rem.y else iy * local.y
+            cap[iz * local.z:iz * local.z + Lz,
+                iy * local.y:iy * local.y + Ly] = \
+                init[oz:oz + Lz, oy:oy + Ly]
+    got_cap = np.asarray(sm(jax.device_put(
+        jnp.asarray(cap), NamedSharding(mesh, spec))))
+    # gather back from capacity layout
+    got = np.zeros_like(want)
+    for iz in range(counts.z):
+        for iy in range(counts.y):
+            Lz = local.z - (1 if rem.z and iz >= rem.z else 0)
+            Ly = local.y - (1 if rem.y and iy >= rem.y else 0)
+            oz = iz * local.z - max(iz - rem.z, 0) if rem.z else iz * local.z
+            oy = iy * local.y - max(iy - rem.y, 0) if rem.y else iy * local.y
+            got[oz:oz + Lz, oy:oy + Ly] = \
+                got_cap[iz * local.z:iz * local.z + Lz,
+                        iy * local.y:iy * local.y + Ly]
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
+
+
 @pytest.mark.parametrize("mesh_shape", [(1, 2, 4), (1, 1, 1)])
 def test_jacobi3d_model_halo_kernel(mesh_shape):
     """Jacobi3D(kernel='halo') end-to-end through the orchestrator."""

@@ -92,6 +92,34 @@ def test_uneven_jacobi_matches_dense_oracle(n):
     np.testing.assert_allclose(j.temperature(), temp, rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.parametrize("size,mesh", [
+    ((16, 17, 18), (1, 2, 4)),   # uneven y (9/8 shards)
+    ((16, 16, 17), (1, 2, 4)),   # uneven z (5/4 shards)
+    ((16, 15, 13), (1, 4, 2)),   # uneven y and z
+])
+def test_uneven_halo_kernel_matches_dense_oracle(size, mesh):
+    """The fused halo-kernel fast path on uneven (+-1) shards: the
+    kernel's interior-length overlay reads the neighbor slab at the
+    shard's ACTUAL last row/column (reference: partition.hpp:55-86
+    supports +-1 everywhere; VERDICT r3 missing #5)."""
+    from stencil_tpu.models.jacobi import Jacobi3D, dense_reference_step
+
+    x, y, z = size
+    j = Jacobi3D(x, y, z, mesh_shape=mesh, dtype=np.float64,
+                 kernel="halo")
+    assert j.dd.rem != Dim3(0, 0, 0)
+    assert j.kernel_path == "halo"
+    j.init()
+    temp = j.temperature()
+    hot = (x // 3, y // 2, z // 2)
+    cold = (2 * x // 3, y // 2, z // 2)
+    for _ in range(3):
+        temp = dense_reference_step(temp, hot, cold, x // 10)
+    j.run(3)
+    np.testing.assert_allclose(j.temperature(), temp, rtol=1e-12,
+                               atol=1e-12)
+
+
 def test_uneven_rejects_unsupported_methods():
     dd = DistributedDomain(9, 8, 8)
     dd.set_mesh_shape((2, 2, 2))
