@@ -23,11 +23,8 @@ def add_device_flags(p: argparse.ArgumentParser) -> None:
 
 def apply_device_flags(args) -> None:
     """Must run before any jax device use (backend init is lazy)."""
-    n = getattr(args, "fake_cpu", 0)
-    if n:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n)
+    from stencil_tpu.utils.config import apply_fake_cpu
+    apply_fake_cpu(getattr(args, "fake_cpu", 0))
 
 
 def add_method_flags(p: argparse.ArgumentParser) -> None:

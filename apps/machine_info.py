@@ -3,12 +3,16 @@
 memory — the analog of bin/machine_info.cu (nodes, ranks, GPUs by
 UUID via the Machine model, reference: include/stencil/machine.hpp)."""
 
-import sys
+import argparse
 
-from _common import csv_line  # noqa: F401  (path setup side effect)
+from _common import add_device_flags, apply_device_flags
 
 
 def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_device_flags(ap)
+    apply_device_flags(ap.parse_args())
+
     import jax
 
     from stencil_tpu.parallel.mesh import default_mesh_shape, make_mesh

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# The full CI pipeline, runnable locally or from the workflow config
+# (the .travis.yml:1-20 analog): native build, unit tests on the
+# 8-device virtual CPU mesh, app smoke runs, and the multi-chip
+# certification sweep. No TPU required.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== 1/4 native build =="
+bash ci/build.sh
+
+echo "== 2/4 unit tests (8-device virtual CPU mesh) =="
+python -m pytest tests/ -q --maxfail=1
+
+echo "== 3/4 app smoke runs =="
+smoke() { echo "-- $*"; python "$@" > /dev/null; }
+( cd apps
+  smoke jacobi3d.py --x 8 --y 8 --z 8 --iters 2 --batch 1 --fake-cpu 8
+  smoke jacobi3d.py --x 8 --y 8 --z 8 --iters 2 --batch 1 --fake-cpu 8 \
+        --packed
+  smoke jacobi3d.py --x 8 --y 8 --z 8 --iters 2 --batch 1 --fake-cpu 8 \
+        --fake-slices 2 --dcn-axis z
+  smoke astaroth.py --nx 8 --ny 8 --nz 8 --iters 1 --fake-cpu 8
+  smoke bench_exchange.py --x 8 --y 8 --z 8 --iters 2 --fake-cpu 8
+  smoke machine_info.py --fake-cpu 8
+  smoke bench_qap.py --sizes 4 6
+)
+
+echo "== 4/4 multi-chip certification sweep =="
+python __graft_entry__.py 8 | tail -1
+
+echo "CI PASSED"

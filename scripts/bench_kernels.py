@@ -147,7 +147,11 @@ def main():
     ap.add_argument("--kernels", default="wrap,halo,xla")
     ap.add_argument("--blocks", default="",
                     help="bz,by override for pallas kernels")
+    ap.add_argument("--fake-cpu", type=int, default=0, metavar="N",
+                    help="run on N virtual CPU devices (smoke mode)")
     args = ap.parse_args()
+    from stencil_tpu.utils.config import apply_fake_cpu
+    apply_fake_cpu(args.fake_cpu)
     kernels = args.kernels.split(",")
     blocks = (tuple(int(v) for v in args.blocks.split(","))
               if args.blocks else None)

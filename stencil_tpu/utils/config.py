@@ -9,6 +9,17 @@ separate tables like AcMeshInfo's int_params/real_params.
 
 from __future__ import annotations
 
+
+def apply_fake_cpu(n: int) -> None:
+    """Point JAX at ``n`` virtual CPU devices (the analog of the
+    reference's GPU oversubscription, test/test_exchange.cu:52). Must
+    run before anything initializes the XLA backend; shared by the app
+    CLIs (--fake-cpu) and the bench/CI harnesses."""
+    if n:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+
 import re
 from typing import Dict, Tuple
 
