@@ -6,13 +6,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+mkdir -p stencil_tpu/_build
 g++ -O2 -shared -fPIC -std=c++17 \
-    stencil_tpu/csrc/qap.cpp -o stencil_tpu/_build/libstencil_qap.so \
-    2>/dev/null || {
-    mkdir -p stencil_tpu/_build
-    g++ -O2 -shared -fPIC -std=c++17 \
-        stencil_tpu/csrc/qap.cpp -o stencil_tpu/_build/libstencil_qap.so
-}
+    stencil_tpu/csrc/qap.cpp -o stencil_tpu/_build/libstencil_qap.so
 
 python - <<'EOF'
 from stencil_tpu import qap

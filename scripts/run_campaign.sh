@@ -24,10 +24,12 @@ else
 fi
 
 run() {  # run <logfile> <cmd...>; failures are recorded, not fatal
-    local log="$OUT/$1"; shift
+    local log="$OUT/$1" rc; shift
     echo "== $* (-> $log)"
-    if ! "$@" > "$log" 2> "$log.err"; then
-        echo "FAILED rc=$? (see $log.err)" | tee -a "$log"
+    "$@" > "$log" 2> "$log.err"
+    rc=$?
+    if [ "$rc" -ne 0 ]; then
+        echo "FAILED rc=$rc (see $log.err)" | tee -a "$log"
     fi
 }
 

@@ -7,7 +7,8 @@
 // C ABI consumed from Python via ctypes (stencil_tpu/qap.py).
 //
 // Cost model: cost(f) = sum_{a,b} w[a][b] * d[f[a]][f[b]], with the
-// convention that 0 * inf == 0 (cost_product, qap.hpp:16-21).
+// convention that 0 * inf == 0 (the reference's cost-product rule,
+// qap.hpp:16-21).
 
 #include <algorithm>
 #include <chrono>
@@ -17,18 +18,44 @@
 
 namespace {
 
-inline double cost_product(double we, double de) {
-  if (0 == we || 0 == de) return 0;
-  return we * de;
+using Perm = std::vector<int64_t>;
+
+// one term of the objective; zero traffic over an unreachable link
+// costs nothing (0 * inf == 0)
+inline double weighted_hop(double traffic, double hops) {
+  if (traffic == 0 || hops == 0) return 0;
+  return traffic * hops;
 }
 
-inline double cost(int64_t n, const double *w, const double *d,
-                   const std::vector<int64_t> &f) {
-  double ret = 0;
+double total_cost(int64_t n, const double *w, const double *d,
+                  const Perm &f) {
+  double acc = 0;
   for (int64_t a = 0; a < n; ++a)
     for (int64_t b = 0; b < n; ++b)
-      ret += cost_product(w[a * n + b], d[f[a] * n + f[b]]);
-  return ret;
+      acc += weighted_hop(w[a * n + b], d[f[a] * n + f[b]]);
+  return acc;
+}
+
+// Sum of every objective term that involves subdomain i or j under
+// permutation f — exactly the terms a swap of f[i]/f[j] changes.
+double pair_terms(int64_t n, const double *w, const double *d,
+                  const Perm &f, int64_t i, int64_t j) {
+  double acc = 0;
+  for (int64_t k = 0; k < n; ++k) {
+    acc += weighted_hop(w[i * n + k], d[f[i] * n + f[k]]);
+    acc += weighted_hop(w[j * n + k], d[f[j] * n + f[k]]);
+    if (k != i && k != j) {
+      acc += weighted_hop(w[k * n + i], d[f[k] * n + f[i]]);
+      acc += weighted_hop(w[k * n + j], d[f[k] * n + f[j]]);
+    }
+  }
+  return acc;
+}
+
+Perm identity(int64_t n) {
+  Perm f(n);
+  for (int64_t i = 0; i < n; ++i) f[i] = i;
+  return f;
 }
 
 }  // namespace
@@ -41,83 +68,66 @@ extern "C" {
 double qap_solve_exact(int64_t n, const double *w, const double *d,
                        int64_t *out_f, double timeout_s) {
   using Clock = std::chrono::steady_clock;
-  const auto stop = Clock::now() + std::chrono::duration_cast<Clock::duration>(
-                                       std::chrono::duration<double>(timeout_s));
-  std::vector<int64_t> f(n);
-  for (int64_t i = 0; i < n; ++i) f[i] = i;
-  std::vector<int64_t> best = f;
-  double best_cost = cost(n, w, d, f);
-  uint64_t iter = 0;
+  const auto deadline =
+      Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                         std::chrono::duration<double>(timeout_s));
+  Perm f = identity(n);
+  Perm winner = f;
+  double winner_cost = total_cost(n, w, d, f);
+  uint64_t tick = 0;
   while (std::next_permutation(f.begin(), f.end())) {
-    if ((++iter & 0x3FF) == 0 && Clock::now() > stop) break;
-    const double c = cost(n, w, d, f);
-    if (c < best_cost) {
-      best_cost = c;
-      best = f;
+    // poll the clock every 1024 permutations, not every one
+    if ((++tick & 0x3FF) == 0 && Clock::now() > deadline) break;
+    const double c = total_cost(n, w, d, f);
+    if (c < winner_cost) {
+      winner_cost = c;
+      winner = f;
     }
   }
-  for (int64_t i = 0; i < n; ++i) out_f[i] = best[i];
-  return best_cost;
+  std::copy(winner.begin(), winner.end(), out_f);
+  return winner_cost;
 }
 
-// Greedy pairwise-swap hill climb with incremental cost update
-// (reference qap::solve_catch, qap.hpp:87-180).
+// Greedy pairwise-swap hill climb (the reference's qap::solve_catch,
+// qap.hpp:87-180, restructured): each round tries every (i, j) swap of
+// the current assignment, scoring candidates incrementally by removing
+// the terms the swap touches and re-adding them post-swap; the round's
+// best strictly-improving swap is adopted until a fixpoint.
 double qap_solve_catch(int64_t n, const double *w, const double *d,
                        int64_t *out_f) {
-  std::vector<int64_t> bestF(n);
-  for (int64_t i = 0; i < n; ++i) bestF[i] = i;
-  double bestCost = cost(n, w, d, bestF);
+  Perm assign = identity(n);
+  double assign_cost = total_cost(n, w, d, assign);
 
-  bool improved;
-  do {
-    improved = false;
-    std::vector<int64_t> imprF = bestF;
-    double imprCost = bestCost;
+  for (;;) {
+    Perm round_best = assign;
+    double round_cost = assign_cost;
     for (int64_t i = 0; i < n; ++i) {
       for (int64_t j = i + 1; j < n; ++j) {
-        std::vector<int64_t> f = bestF;
-        double c = bestCost;
-        for (int64_t k = 0; k < n; ++k) {
-          c -= cost_product(w[i * n + k], d[f[i] * n + f[k]]);
-          c -= cost_product(w[j * n + k], d[f[j] * n + f[k]]);
-          if (k != i && k != j) {
-            c -= cost_product(w[k * n + i], d[f[k] * n + f[i]]);
-            c -= cost_product(w[k * n + j], d[f[k] * n + f[j]]);
-          }
-        }
-        std::swap(f[i], f[j]);
-        for (int64_t k = 0; k < n; ++k) {
-          c += cost_product(w[i * n + k], d[f[i] * n + f[k]]);
-          c += cost_product(w[j * n + k], d[f[j] * n + f[k]]);
-          if (k != i && k != j) {
-            c += cost_product(w[k * n + i], d[f[k] * n + f[i]]);
-            c += cost_product(w[k * n + j], d[f[k] * n + f[j]]);
-          }
-        }
-        // the incremental update is invalid when inf terms are involved
-        // (inf - inf = NaN); fall back to a full recompute
-        if (!std::isfinite(c)) c = cost(n, w, d, f);
-        if (c < imprCost) {
-          imprF = f;
-          imprCost = c;
-          improved = true;
+        Perm trial = assign;
+        double c = assign_cost - pair_terms(n, w, d, trial, i, j);
+        std::swap(trial[i], trial[j]);
+        c += pair_terms(n, w, d, trial, i, j);
+        // inf - inf = NaN: the incremental update is invalid when
+        // unreachable-link terms are involved; recompute from scratch
+        if (!std::isfinite(c)) c = total_cost(n, w, d, trial);
+        if (c < round_cost) {
+          round_best = std::move(trial);
+          round_cost = c;
         }
       }
     }
-    if (improved) {
-      bestF = imprF;
-      bestCost = imprCost;
-    }
-  } while (improved);
+    if (round_cost >= assign_cost) break;  // fixpoint
+    assign = std::move(round_best);
+    assign_cost = round_cost;
+  }
 
-  for (int64_t i = 0; i < n; ++i) out_f[i] = bestF[i];
-  return bestCost;
+  std::copy(assign.begin(), assign.end(), out_f);
+  return assign_cost;
 }
 
 double qap_cost(int64_t n, const double *w, const double *d,
                 const int64_t *f) {
-  std::vector<int64_t> fv(f, f + n);
-  return cost(n, w, d, fv);
+  return total_cost(n, w, d, Perm(f, f + n));
 }
 
 }  // extern "C"

@@ -232,9 +232,13 @@ class Astaroth:
             pass
         else:
             from ..ops.pallas_stencil import on_tpu
+            # auto only takes the halo megakernel on TPU AND f32 (the
+            # kernel is f32-tuned; _build_step applies the same gate),
+            # so don't warp the mesh for configs that will run XLA
             if (len(self.dd._devices) > 1 and not overlap
                     and (kernel == "halo"
-                         or (kernel == "auto" and on_tpu()))):
+                         or (kernel == "auto" and on_tpu()
+                             and np.dtype(dtype) == np.float32))):
                 # prefer an x-unsharded decomposition so the fused halo
                 # megakernel path is available (ops/pallas_halo.py)
                 from ..partition import partition_dims_even_xfree
@@ -361,15 +365,29 @@ class Astaroth:
         kernel = self._kernel
         if kernel == "auto":
             from ..ops.pallas_stencil import on_tpu
+            from ..utils.logging import LOG_INFO
             if on_tpu() and self._dtype == np.float32:
                 kernel = ("wrap" if wrap_ok
                           else "halo" if halo_ok else "xla")
             else:
                 kernel = "xla"
+            why = ""
+            if kernel == "xla" and on_tpu():
+                blockers = []
+                if self._dtype != np.float32:
+                    blockers.append(f"dtype {np.dtype(self._dtype).name}")
+                if counts.x != 1:
+                    blockers.append("x-axis sharded")
+                if not aligned:
+                    blockers.append("uneven grid / z,y % 8 != 0 / "
+                                    "overlap requested")
+                why = f" (fast paths unavailable: {', '.join(blockers)})"
+            LOG_INFO(f"astaroth kernel path: {kernel}{why}")
         if kernel == "wrap":
             if not wrap_ok:
                 raise ValueError("kernel='wrap' needs a (1,1,1) mesh, even "
                                  "grid, z/y multiples of 8, overlap off")
+            self.kernel_path = "wrap"
             self._build_wrap_step()
             return
         if kernel == "halo":
@@ -377,8 +395,10 @@ class Astaroth:
                 raise ValueError("kernel='halo' needs an x-unsharded mesh, "
                                  "even grid, local z/y multiples of 8, "
                                  "overlap off")
+            self.kernel_path = "halo"
             self._build_halo_step()
             return
+        self.kernel_path = "xla-overlap" if self._overlap else "xla"
         substep = substep_overlap if self._overlap else substep_fused
 
         def shard_iter(fields, w):

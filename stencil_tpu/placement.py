@@ -37,18 +37,17 @@ class PlacementStrategy(enum.Enum):
     IntraNodeRandom = "random"
 
 
-def comm_bytes_matrix(part: RankPartition, radius: Radius,
-                      elem_sizes: Sequence[int]) -> np.ndarray:
-    """Subdomain-pair halo-communication bytes (periodic-aware), the
-    "w" matrix of the QAP (reference: partition.hpp:722-752).
-
-    entry [i, j] = bytes subdomain i sends subdomain j per exchange,
-    summed over all quantities and all directions that map i -> j.
-    """
-    dim = part.dim()
-    n = dim.flatten()
-    topo = Topology(dim)
-    w = np.zeros((n, n), dtype=np.float64)
+def iter_messages(part: RankPartition, radius: Radius,
+                  elem_sizes: Sequence[int],
+                  topo: Optional[Topology] = None):
+    """Yield every planned cross-subdomain halo message as
+    ``(i, j, direction, bytes)`` — the single source of truth for the
+    comm matrix and the plan file's per-message lines (reference:
+    src/stencil.cu:523-637 plans one message per direction).
+    ``topo`` carries the boundary condition; defaults to periodic."""
+    if topo is None:
+        topo = Topology(part.dim())
+    n = part.dim().flatten()
     for i in range(n):
         idx = part.dimensionize(i)
         for d in all_directions():
@@ -63,8 +62,24 @@ def comm_bytes_matrix(part: RankPartition, radius: Radius,
             if i == j:
                 continue  # same-device wrap is local
             dst_size = part.subdomain_size(nbr.index)
-            for es in elem_sizes:
-                w[i, j] += halo_bytes(-d, dst_size, radius, es)
+            nbytes = sum(halo_bytes(-d, dst_size, radius, es)
+                         for es in elem_sizes)
+            yield i, j, d, nbytes
+
+
+def comm_bytes_matrix(part: RankPartition, radius: Radius,
+                      elem_sizes: Sequence[int],
+                      topo: Optional[Topology] = None) -> np.ndarray:
+    """Subdomain-pair halo-communication bytes, the "w" matrix of the
+    QAP (reference: partition.hpp:722-752).
+
+    entry [i, j] = bytes subdomain i sends subdomain j per exchange,
+    summed over all quantities and all directions that map i -> j.
+    """
+    n = part.dim().flatten()
+    w = np.zeros((n, n), dtype=np.float64)
+    for i, j, _, nbytes in iter_messages(part, radius, elem_sizes, topo):
+        w[i, j] += nbytes
     return w
 
 

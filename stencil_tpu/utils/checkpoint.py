@@ -188,6 +188,10 @@ def restore_domain(dd, directory: str, step: Optional[int] = None
     if meta.get("quantities") and meta["quantities"] != list(dd._names):
         raise ValueError(f"checkpoint quantities {meta['quantities']} != "
                          f"{list(dd._names)}")
+    for q, dt in (meta.get("dtypes") or {}).items():
+        if q in dd._dtypes and str(dd._dtypes[q]) != dt:
+            raise ValueError(f"checkpoint dtype {dt} for {q!r} != "
+                             f"domain dtype {dd._dtypes[q]}")
     from ..geometry import Dim3
     if dd.rem == Dim3(0, 0, 0):
         _, insert = _interior_fns(dd)

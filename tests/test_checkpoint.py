@@ -58,6 +58,20 @@ def test_checkpoint_rejects_mismatched_domain(tmp_path):
         restore_domain(b.dd, str(tmp_path / "ckpt"))
 
 
+def test_checkpoint_rejects_mismatched_dtype(tmp_path):
+    """Restoring a float32 checkpoint into a float64 domain must fail
+    with a clear error, not silently reinterpret the data."""
+    from stencil_tpu.models.jacobi import Jacobi3D
+
+    a = Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float32)
+    a.init()
+    save_domain(a.dd, str(tmp_path / "ckpt"), step=0)
+
+    b = Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float64)
+    with pytest.raises(Exception, match="dtype"):
+        restore_domain(b.dd, str(tmp_path / "ckpt"))
+
+
 def test_astaroth_checkpoint_with_accumulators(tmp_path):
     from stencil_tpu.models.astaroth import Astaroth, MhdParams
 

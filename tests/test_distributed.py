@@ -128,6 +128,14 @@ def test_plan_files(tmp_path):
     assert mat.shape == (8, 8)
     # radius-1 f32, 4^3 local: each face message is 4*4*1*4 bytes = 64
     assert mat[0, 1] > 0
+    # per-message detail (reference: src/stencil.cu:523-637): one line
+    # per planned cross-shard message, consistent with the matrix
+    msgs = [l for l in plan.splitlines() if l.startswith("message ")]
+    assert any(l.startswith("message 0 -> 1 ") and l.endswith("B")
+               for l in msgs), msgs[:3]
+    m01 = sum(int(l.split(":")[1].split()[0]) for l in msgs
+              if l.startswith("message 0 -> 1 "))
+    assert m01 == mat[0, 1], (m01, mat[0, 1])
     assert np.all(mat.diagonal() == 0)
 
 
