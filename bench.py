@@ -12,18 +12,70 @@ result across all prior rounds' BENCH_r*.json when present, else 1.0.
 
 Timing note: on the axon TPU tunnel, block_until_ready does not drain
 execution; we fence with a device->host fetch (stencil_tpu.utils.timers).
+
+Robustness: the measurement runs in a SUBPROCESS with a timeout; if the
+default (temporally-blocked wrap2) compute path hangs or fails on the
+current backend, the run retries once with STENCIL_DISABLE_WRAP2=1 (the
+hardware-proven single-step kernel), and a total failure still emits a
+parseable suspect record instead of hanging the driver.
 """
 
 import glob
 import json
 import os
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# generous first attempt (a fresh 512^3 Mosaic compile can take
+# minutes); the fallback path is known to compile in under a minute
+_TIMEOUTS_S = (1500, 600)
+
 
 def main() -> None:
+    if "--measure" in sys.argv:
+        measure()
+        return
+    env = dict(os.environ)
+    last_err = ""
+    for attempt, note in ((0, None), (1, "wrap2 disabled")):
+        if attempt:
+            env["STENCIL_DISABLE_WRAP2"] = "1"
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--measure"],
+                capture_output=True, text=True,
+                timeout=_TIMEOUTS_S[attempt], env=env)
+        except subprocess.TimeoutExpired:
+            last_err = f"attempt {attempt}: timeout"
+            continue
+        if out.returncode != 0:
+            last_err = (f"attempt {attempt}: rc={out.returncode}: "
+                        + out.stderr[-400:])
+        for line in reversed(out.stdout.splitlines()):
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if note:
+                rec.setdefault("extra", {})["fallback"] = note
+            print(json.dumps(rec))
+            return
+    print(json.dumps({
+        "metric": "jacobi3d_512c_iters_per_sec", "value": 0.0,
+        "unit": "iters/s", "vs_baseline": 0.0, "suspect": True,
+        "extra": {"suspect_reason":
+                  "measurement subprocess hung or died on both the "
+                  "wrap2 and single-step paths; last error: "
+                  + (last_err or "none captured")},
+    }))
+
+
+def measure() -> None:
     import jax
     import numpy as np
 

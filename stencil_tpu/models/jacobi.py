@@ -275,12 +275,19 @@ class Jacobi3D:
         from ..ops.pallas_stencil import (jacobi7_wrap2_pallas,
                                           jacobi7_wrap_pallas)
 
+        import os
+
         dd = self.dd
         lo = dd.radius.pad_lo()
         local = dd.local_size
         gsize = dd.size
         hot, cold, sph_r = sphere_geometry(gsize)
-        pair_ok = (local.z % 2 == 0 and local.y % 8 == 0)
+        # STENCIL_DISABLE_WRAP2=1 is the kill-switch harnesses use to
+        # fall back to the hardware-proven single-step kernel ("0" and
+        # unset both leave the pair kernel on)
+        disable = os.environ.get("STENCIL_DISABLE_WRAP2", "").lower()
+        pair_ok = (local.z % 2 == 0 and local.y % 8 == 0
+                   and disable not in ("1", "true", "yes"))
 
         def steps(p, n):
             inner = lax.slice(p, (lo.z, lo.y, lo.x),
