@@ -23,8 +23,9 @@ def add_device_flags(p: argparse.ArgumentParser) -> None:
 
 def apply_device_flags(args) -> None:
     """Must run before any jax device use (backend init is lazy)."""
-    from stencil_tpu.utils.config import apply_fake_cpu
+    from stencil_tpu.utils.config import apply_fake_cpu, enable_compile_cache
     apply_fake_cpu(getattr(args, "fake_cpu", 0))
+    enable_compile_cache()
 
 
 def add_method_flags(p: argparse.ArgumentParser) -> None:

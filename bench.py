@@ -79,6 +79,8 @@ def measure() -> None:
     import jax
     import numpy as np
 
+    from stencil_tpu.utils.config import enable_compile_cache
+    enable_compile_cache()
     on_tpu = any("tpu" in str(d).lower() for d in jax.devices())
     if on_tpu:
         size, iters, warmup = 512, 200, 10

@@ -92,13 +92,20 @@ def _patch_jacobi_blocks(j, kernel, blocks):
             pallas_stencil.jacobi7_wrap_pallas = orig1
             pallas_stencil.jacobi7_wrap2_pallas = orig2
     else:
+        # the halo path runs pairs (jacobi7_halo2_pallas, blocks from
+        # fit_pair_halo_blocks) with a single-step tail — patch both
         orig = pallas_halo.jacobi7_halo_pallas
+        orig_fit = pallas_halo.fit_pair_halo_blocks
         pallas_halo.jacobi7_halo_pallas = functools.partial(
             orig, block_z=bz, block_y=by)
+        pallas_halo.fit_pair_halo_blocks = lambda Z, Y, X, item: (
+            pallas_halo._shrink_block(Z, bz),
+            pallas_halo._shrink_block(Y, by, pallas_halo.ESUB))
         try:
             j._build_halo_step()
         finally:
             pallas_halo.jacobi7_halo_pallas = orig
+            pallas_halo.fit_pair_halo_blocks = orig_fit
 
 
 def bench_mhd(size, iters, kernels, blocks):
@@ -150,8 +157,9 @@ def main():
     ap.add_argument("--fake-cpu", type=int, default=0, metavar="N",
                     help="run on N virtual CPU devices (smoke mode)")
     args = ap.parse_args()
-    from stencil_tpu.utils.config import apply_fake_cpu
+    from stencil_tpu.utils.config import apply_fake_cpu, enable_compile_cache
     apply_fake_cpu(args.fake_cpu)
+    enable_compile_cache()
     kernels = args.kernels.split(",")
     blocks = (tuple(int(v) for v in args.blocks.split(","))
               if args.blocks else None)

@@ -274,20 +274,15 @@ class Jacobi3D:
         grids the pair kernel can't tile fall back to single steps."""
         from ..ops.pallas_stencil import (jacobi7_wrap2_pallas,
                                           jacobi7_wrap_pallas)
-
-        import os
+        from ..utils.config import wrap2_disabled
 
         dd = self.dd
         lo = dd.radius.pad_lo()
         local = dd.local_size
         gsize = dd.size
         hot, cold, sph_r = sphere_geometry(gsize)
-        # STENCIL_DISABLE_WRAP2=1 is the kill-switch harnesses use to
-        # fall back to the hardware-proven single-step kernel ("0" and
-        # unset both leave the pair kernel on)
-        disable = os.environ.get("STENCIL_DISABLE_WRAP2", "").lower()
         pair_ok = (local.z % 2 == 0 and local.y % 8 == 0
-                   and disable not in ("1", "true", "yes"))
+                   and not wrap2_disabled())
 
         def steps(p, n):
             inner = lax.slice(p, (lo.z, lo.y, lo.x),
@@ -321,7 +316,11 @@ class Jacobi3D:
         fori_loop the per-iteration body from ``make_body(org)``, write
         the interior back (halos go stale; nothing reads them before
         the next exchange, and temperature() reads the interior only),
-        all inside one shard_map/jit with buffer donation."""
+        all inside one shard_map/jit with buffer donation.
+
+        ``make_body(org)`` returns either a single-iteration body, or a
+        ``(body, pair_body)`` tuple — then ``n`` iterations run as
+        ``n // 2`` temporally-blocked pairs plus a single-step tail."""
         from ..parallel.exchange import shard_origin
 
         dd = self.dd
@@ -335,8 +334,15 @@ class Jacobi3D:
             inner = lax.slice(p, (lo.z, lo.y, lo.x),
                               (lo.z + local.z, lo.y + local.y,
                                lo.x + local.x))
-            body = make_body(org)
-            inner = lax.fori_loop(0, n, lambda _, q: body(q), inner)
+            made = make_body(org)
+            if isinstance(made, tuple):
+                body, pair_body = made
+                inner = lax.fori_loop(0, n // 2,
+                                      lambda _, q: pair_body(q), inner)
+                inner = lax.cond(n % 2 == 1, body, lambda q: q, inner)
+            else:
+                body = made
+                inner = lax.fori_loop(0, n, lambda _, q: body(q), inner)
             return lax.dynamic_update_slice(p, inner, (lo.z, lo.y, lo.x))
 
         spec = P("z", "y", "x")
@@ -351,17 +357,35 @@ class Jacobi3D:
         ppermutes, one fused Pallas kernel per step — so an N-chip mesh
         keeps single-chip per-chip throughput (the analog of the
         reference's fused solve kernel running at every scale,
-        astaroth/astaroth.cu:552-646; see ops/pallas_halo.py)."""
-        from ..ops.pallas_halo import jacobi7_halo_pallas
+        astaroth/astaroth.cu:552-646; see ops/pallas_halo.py).
+
+        Even grids run iterations in PAIRS through the temporally-
+        blocked two-step kernel (``jacobi7_halo2_pallas``): one radius-2
+        exchange feeds two fused steps, nearly halving both per-
+        iteration HBM traffic and exchange count (the slab-layout
+        counterpart of the wrap-path pair kernel), with a single-step
+        tail for odd iteration counts. Uneven (+-1) grids and grids the
+        pair kernel can't tile keep the single-step kernel."""
+        from ..ops.pallas_halo import (ESUB, fit_pair_halo_blocks,
+                                       jacobi7_halo2_pallas,
+                                       jacobi7_halo_pallas)
         from ..parallel.exchange import (exchange_interior_slabs,
                                          shard_interior_len)
+        from ..utils.config import wrap2_disabled
 
         dd = self.dd
         local = dd.local_size
         counts = mesh_dim(dd.mesh)
         rem = dd.rem
+        gsize = (dd.size.z, dd.size.y, dd.size.x)
         hot, cold, sph_r = sphere_geometry(dd.size)
         esub = 8 if local.y % 8 == 0 else 1
+        pair_ok = (rem == Dim3(0, 0, 0) and local.z % 2 == 0
+                   and local.y % ESUB == 0 and not wrap2_disabled())
+        if pair_ok:
+            pbz, pby = fit_pair_halo_blocks(
+                local.z, local.y, local.x, jnp.dtype(self._dtype).itemsize)
+            pair_ok = pbz >= 2 and pbz % 2 == 0
 
         def make_body(org):
             lens = jnp.stack([
@@ -374,7 +398,19 @@ class Jacobi3D:
                                                 rem=rem)
                 return jacobi7_halo_pallas(q, slabs, org, hot, cold,
                                            sph_r, interior_len_zy=lens)
-            return body
+
+            if not pair_ok:
+                return body
+
+            def pair_body(q):
+                slabs = exchange_interior_slabs(
+                    q, counts, rz=pbz, ry=ESUB, radius_rows=2,
+                    y_z_extended=True)
+                return jacobi7_halo2_pallas(q, slabs, org, gsize, hot,
+                                            cold, sph_r, block_z=pbz,
+                                            block_y=pby)
+
+            return body, pair_body
 
         self._build_interior_resident_steps(make_body)
 

@@ -36,8 +36,22 @@ RADIUS = 3
 
 
 def _shift(padded: jnp.ndarray, off_xyz: Tuple[int, int, int],
-           pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+           pad_lo: Dim3, interior: Dim3, x_wrap: bool = False) -> jnp.ndarray:
     ox, oy, oz = off_xyz
+    if x_wrap:
+        # x carries NO padding: the array spans the full (periodic) x
+        # extent and a +ox shift is an in-register lane rotation
+        # (pltpu.roll — Pallas kernels only). Keeps every buffer
+        # lane-aligned at X instead of materializing an X+2r window.
+        assert pad_lo.x == 0 and interior.x == padded.shape[2]
+        w = lax.slice(
+            padded, (pad_lo.z + oz, pad_lo.y + oy, 0),
+            (pad_lo.z + oz + interior.z, pad_lo.y + oy + interior.y,
+             interior.x))
+        if ox:
+            from jax.experimental.pallas import tpu as pltpu
+            w = pltpu.roll(w, (interior.x - ox) % interior.x, 2)
+        return w
     return lax.slice(
         padded,
         (pad_lo.z + oz, pad_lo.y + oy, pad_lo.x + ox),
@@ -52,35 +66,37 @@ def _axis_off(axis: int, i: int) -> Tuple[int, int, int]:
 
 
 def der1(padded: jnp.ndarray, axis: int, inv_ds: float,
-         pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+         pad_lo: Dim3, interior: Dim3, x_wrap: bool = False) -> jnp.ndarray:
     """6th-order first derivative along ``axis``
     (reference: user_kernels.h:36-48 first_derivative + derx/dery/derz)."""
     dt = padded.dtype
     acc = None
     for i, c in enumerate(_D1, start=1):
-        hi = _shift(padded, _axis_off(axis, i), pad_lo, interior)
-        lo = _shift(padded, _axis_off(axis, -i), pad_lo, interior)
+        hi = _shift(padded, _axis_off(axis, i), pad_lo, interior, x_wrap)
+        lo = _shift(padded, _axis_off(axis, -i), pad_lo, interior, x_wrap)
         term = jnp.asarray(c, dt) * (hi - lo)
         acc = term if acc is None else acc + term
     return acc * jnp.asarray(inv_ds, dt)
 
 
 def der2(padded: jnp.ndarray, axis: int, inv_ds: float,
-         pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+         pad_lo: Dim3, interior: Dim3, x_wrap: bool = False) -> jnp.ndarray:
     """6th-order second derivative along ``axis``
     (reference: user_kernels.h:49-62 second_derivative)."""
     dt = padded.dtype
-    acc = jnp.asarray(_D2_C, dt) * _shift(padded, (0, 0, 0), pad_lo, interior)
+    acc = jnp.asarray(_D2_C, dt) * _shift(padded, (0, 0, 0), pad_lo,
+                                          interior, x_wrap)
     for i, c in enumerate(_D2, start=1):
-        hi = _shift(padded, _axis_off(axis, i), pad_lo, interior)
-        lo = _shift(padded, _axis_off(axis, -i), pad_lo, interior)
+        hi = _shift(padded, _axis_off(axis, i), pad_lo, interior, x_wrap)
+        lo = _shift(padded, _axis_off(axis, -i), pad_lo, interior, x_wrap)
         acc = acc + jnp.asarray(c, dt) * (hi + lo)
     return acc * jnp.asarray(inv_ds * inv_ds, dt)
 
 
 def der_cross(padded: jnp.ndarray, axis_a: int, axis_b: int,
               inv_ds_a: float, inv_ds_b: float,
-              pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+              pad_lo: Dim3, interior: Dim3,
+              x_wrap: bool = False) -> jnp.ndarray:
     """6th-order mixed derivative d2/(da db), a != b
     (reference: user_kernels.h:63-76 cross_derivative + derxy/...):
     pencil_a runs along the (+a,+b) diagonal, pencil_b along (+a,-b).
@@ -92,16 +108,17 @@ def der_cross(padded: jnp.ndarray, axis_a: int, axis_b: int,
             off = [0, 0, 0]
             off[axis_a] = sa
             off[axis_b] = sb
-            return _shift(padded, tuple(off), pad_lo, interior)
+            return _shift(padded, tuple(off), pad_lo, interior, x_wrap)
         term = jnp.asarray(c, dt) * (at(i, i) + at(-i, -i)
                                      - at(i, -i) - at(-i, i))
         acc = term if acc is None else acc + term
     return acc * jnp.asarray(inv_ds_a * inv_ds_b, dt)
 
 
-def value(padded: jnp.ndarray, pad_lo: Dim3, interior: Dim3) -> jnp.ndarray:
+def value(padded: jnp.ndarray, pad_lo: Dim3, interior: Dim3,
+          x_wrap: bool = False) -> jnp.ndarray:
     """Center value (interior view)."""
-    return _shift(padded, (0, 0, 0), pad_lo, interior)
+    return _shift(padded, (0, 0, 0), pad_lo, interior, x_wrap)
 
 
 class FieldData:
@@ -110,20 +127,22 @@ class FieldData:
     read_data). ``inv_ds`` is (1/dsx, 1/dsy, 1/dsz)."""
 
     def __init__(self, padded: jnp.ndarray, inv_ds: Tuple[float, float, float],
-                 pad_lo: Dim3, interior: Dim3) -> None:
+                 pad_lo: Dim3, interior: Dim3, x_wrap: bool = False) -> None:
         self._p = padded
         self._inv = inv_ds
         self._lo = pad_lo
         self._n = interior
+        self._xw = x_wrap
         self._cache = {}
 
     @property
     def value(self) -> jnp.ndarray:
-        return self._get(("v",), lambda: value(self._p, self._lo, self._n))
+        return self._get(("v",), lambda: value(self._p, self._lo, self._n,
+                                               self._xw))
 
     def grad(self, axis: int) -> jnp.ndarray:
         return self._get(("g", axis), lambda: der1(
-            self._p, axis, self._inv[axis], self._lo, self._n))
+            self._p, axis, self._inv[axis], self._lo, self._n, self._xw))
 
     @property
     def gradient(self):
@@ -134,9 +153,10 @@ class FieldData:
             a, b = b, a
         if a == b:
             return self._get(("h", a, a), lambda: der2(
-                self._p, a, self._inv[a], self._lo, self._n))
+                self._p, a, self._inv[a], self._lo, self._n, self._xw))
         return self._get(("h", a, b), lambda: der_cross(
-            self._p, a, b, self._inv[a], self._inv[b], self._lo, self._n))
+            self._p, a, b, self._inv[a], self._inv[b], self._lo, self._n,
+            self._xw))
 
     @property
     def laplace(self) -> jnp.ndarray:

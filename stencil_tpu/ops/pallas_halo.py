@@ -252,6 +252,257 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
       slabs["ylo"], slabs["yhi"])
 
 
+def _pair_block_bytes(bz: int, by: int, X: int, itemsize: int) -> int:
+    """Scoped-VMEM estimate for one jacobi7_halo2_pallas grid step:
+    main + out (bz,by,X) and the thin ring segments, double-buffered by
+    the pipeline, plus the assembled (bz+4, by+4, X) window and the
+    step-1 intermediate (bz+2, by+2, X) held during compute."""
+    streamed = 2 * (2 * bz * by * X + 8 * by * X + 8 * bz * ESUB * X)
+    held = (bz + 4) * (by + 4) * X + (bz + 2) * (by + 2) * X
+    return itemsize * (streamed + held)
+
+
+def fit_pair_halo_blocks(Z: int, Y: int, X: int,
+                         itemsize: int) -> Tuple[int, int]:
+    """(bz, by) for the two-step halo kernel, shrunk bz-first until the
+    VMEM estimate fits (same policy as fit_jacobi_halo_blocks)."""
+    bz = _shrink_block(Z, 16)
+    by = _shrink_block(Y, 128, ESUB)
+    while _pair_block_bytes(bz, by, X, itemsize) > _VMEM_BUDGET:
+        if bz > 2:
+            bz = _shrink_block(Z, max(bz // 2, 2))
+        elif by > ESUB:
+            by = _shrink_block(Y, max(by // 2, ESUB), ESUB)
+        else:
+            break
+    return bz, by
+
+
+def jacobi7_halo2_pallas(interior: jnp.ndarray,
+                         slabs: Dict[str, jnp.ndarray],
+                         origin_zyx: jnp.ndarray,
+                         gsize_zyx: Tuple[int, int, int],
+                         hot_c: Tuple[int, int, int],
+                         cold_c: Tuple[int, int, int], sph_r: int,
+                         block_z: Optional[int] = None,
+                         block_y: Optional[int] = None,
+                         interpret: Optional[bool] = None) -> jnp.ndarray:
+    """TWO fused Jacobi iterations (+ sphere sources after each) per
+    slab exchange on one interior-resident (Z, Y, X) shard — temporal
+    blocking for the multi-device halo path, the slab-layout counterpart
+    of ``jacobi7_wrap2_pallas``. One radius-2 exchange feeds two
+    7-point steps: each (bz, by, X) output block reads a (bz+4, by+4,
+    X) window (x wraps in-core — x is never mesh-sharded), computes the
+    step-1 values on the (bz+2, by+2) ring-extended region with
+    Dirichlet sources re-imposed at their wrapped GLOBAL positions, and
+    steps again. Bit-identical to two ``jacobi7_halo_pallas`` calls.
+    Reference semantics: bin/jacobi3d.cu:40-85 applied twice per
+    exchange (the reference exchanges every iteration; fewer, fatter
+    exchanges are the TPU-side trade — same bytes, half the latencies).
+
+    ``slabs`` from ``exchange_interior_slabs(p, counts, rz=bz, ry=ESUB,
+    radius_rows=2, y_z_extended=True)``: zlo/zhi (bz, Y, X) with the
+    adjacent two rows at zlo[-2:] / zhi[:2]; ylo/yhi (Z + 2*bz, ESUB,
+    X) z-extended by one z block so yz corner data rides along (the
+    sequential-sweep corner rule). ``gsize_zyx`` is the GLOBAL (Gz,
+    Gy, Gx) — the step-1 ring extends into neighbor shards, so its
+    source test wraps global coordinates modulo the global grid. Even
+    grids only (no uneven overlay — the caller gates on rem == 0).
+    """
+    if interpret is None:
+        interpret = default_interpret()
+    Z, Y, X = interior.shape
+    assert Y % ESUB == 0, Y
+    dt = jnp.dtype(interior.dtype)
+    if block_z is None and block_y is None:
+        bz, by = fit_pair_halo_blocks(Z, Y, X, dt.itemsize)
+    else:
+        bz = _shrink_block(Z, block_z if block_z is not None else 16)
+        by = _shrink_block(Y, block_y if block_y is not None else 128,
+                           ESUB)
+    if bz < 2 or bz % 2:
+        raise ValueError(f"pair kernel needs even bz >= 2, got bz={bz} "
+                         f"for Z={Z}")
+    rzb = slabs["zlo"].shape[0]
+    assert rzb == bz and slabs["zlo"].shape == (bz, Y, X), \
+        ("pair kernel wants (bz, Y, X) z slabs", slabs["zlo"].shape, bz)
+    assert slabs["ylo"].shape == (Z + 2 * bz, ESUB, X), \
+        ("pair kernel wants z-extended y slabs", slabs["ylo"].shape)
+    Gz, Gy, Gx = gsize_zyx
+    hx, hy, hz = hot_c
+    cx, cy, cz = cold_c
+    r2 = sph_r * sph_r
+    nzg = Z // bz
+    nyg = Y // by
+    nyb = Y // ESUB
+    byb = by // ESUB
+
+    def sources(vals, org, z0, y0, nz, ny):
+        """Re-impose the Dirichlet spheres on an (nz, ny, X) region at
+        global origin (org_z + z0, org_y + y0, org_x), coords wrapped
+        modulo the GLOBAL grid (ring cells outside the shard belong to
+        periodic neighbors)."""
+        gy = (org[1] + y0
+              + jax.lax.broadcasted_iota(jnp.int32, (ny, X), 0)) % Gy
+        gx = (org[2]
+              + jax.lax.broadcasted_iota(jnp.int32, (ny, X), 1)) % Gx
+        gz = (org[0] + z0
+              + jax.lax.broadcasted_iota(jnp.int32, (nz, 1, 1), 0)) % Gz
+        d2h = (gx - hx) ** 2 + (gy - hy) ** 2 + (gz - hz) ** 2
+        d2c = (gx - cx) ** 2 + (gy - cy) ** 2 + (gz - cz) ** 2
+        vals = jnp.where(d2h <= r2, dt.type(1.0), vals)
+        return jnp.where(d2c <= r2, dt.type(0.0), vals)
+
+    def jstep(w):
+        """One 7-point step on the interior of an (nz, ny, X) window:
+        returns (nz-2, ny-2, X); x is periodic in-core."""
+        zsum = w[:-2, 1:-1] + w[2:, 1:-1]
+        ysum = w[1:-1, :-2] + w[1:-1, 2:]
+        xsum = (pltpu.roll(w, 1, 2) + pltpu.roll(w, X - 1, 2))[1:-1, 1:-1]
+        return (zsum + ysum + xsum) * dt.type(1.0 / 6.0)
+
+    # ref order (34 inputs): org | main | z-in singles (-2,-1,+0,+1 rel edges)
+    # | z-slab singles | y-in slabs | y-slab mains | corner in-shard
+    # singles | corner z-slab ESUB blocks | corner y-slab singles
+    ZOFFS = (-2, -1, bz, bz + 1)
+
+    def kern(org, main, zi_m2, zi_m1, zi_p0, zi_p1, zs_m2, zs_m1,
+             zs_p0, zs_p1, yi_m, yi_p, ys_m, ys_p,
+             ci_m2m, ci_m2p, ci_m1m, ci_m1p, ci_p0m, ci_p0p, ci_p1m,
+             ci_p1p, cz_lom, cz_lop, cz_him, cz_hip,
+             cy_m2m, cy_m2p, cy_m1m, cy_m1p, cy_p0m, cy_p0p, cy_p1m,
+             cy_p1p, out):
+        kz = pl.program_id(0)
+        ky = pl.program_id(1)
+        at_zlo = kz == 0
+        at_zhi = kz == nzg - 1
+        at_ylo = ky == 0
+        at_yhi = ky == nyg - 1
+        z0 = kz * bz
+        y0 = ky * by
+
+        def ring_row(zi, zs, cim, cip, cym, cyp, czm, czp, at_zedge):
+            """One (1, by+4, X) window row outside the block in z:
+            mid from in-shard vs z-slab, corner cols from y-slab (any
+            z — it is z-extended) vs z-slab (full-Y) vs in-shard."""
+            mid = jnp.where(at_zedge, zs[...], zi[...])
+            left = jnp.where(at_ylo, cym[...],
+                             jnp.where(at_zedge, czm[...], cim[...]))
+            right = jnp.where(at_yhi, cyp[...],
+                              jnp.where(at_zedge, czp[...], cip[...]))
+            return jnp.concatenate(
+                [left[:, ESUB - 2:], mid, right[:, :2]], axis=1)
+
+        # z-slab corner blocks are (2, ESUB, X) holding exactly the two
+        # adjacent slab rows; pick the one matching this ring row
+        rows = [
+            ring_row(zi_m2, zs_m2, ci_m2m, ci_m2p, cy_m2m, cy_m2p,
+                     cz_lom[0:1], cz_lop[0:1], at_zlo),
+            ring_row(zi_m1, zs_m1, ci_m1m, ci_m1p, cy_m1m, cy_m1p,
+                     cz_lom[1:2], cz_lop[1:2], at_zlo),
+        ]
+        c = main[...]
+        ym_slab = jnp.where(at_ylo, ys_m[...], yi_m[...])
+        yp_slab = jnp.where(at_yhi, ys_p[...], yi_p[...])
+        rows.append(jnp.concatenate(
+            [ym_slab[:, ESUB - 2:], c, yp_slab[:, :2]], axis=1))
+        rows.append(ring_row(zi_p0, zs_p0, ci_p0m, ci_p0p, cy_p0m,
+                             cy_p0p, cz_him[0:1], cz_hip[0:1], at_zhi))
+        rows.append(ring_row(zi_p1, zs_p1, ci_p1m, ci_p1p, cy_p1m,
+                             cy_p1p, cz_him[1:2], cz_hip[1:2], at_zhi))
+        w = jnp.concatenate(rows, axis=0)        # (bz+4, by+4, X)
+        s1 = jstep(w)                            # (bz+2, by+2, X)
+        s1 = sources(s1, org, z0 - 1, y0 - 1, bz + 2, by + 2)
+        s2 = jstep(s1)                           # (bz, by, X)
+        out[...] = sources(s2, org, z0, y0, bz, by)
+
+    def clampz1(off):
+        # single in-shard row at kz*bz + off, clamped into [0, Z)
+        return lambda kz, ky: (jnp.clip(kz * bz + off, 0, Z - 1), ky, 0)
+
+    def zslab_row(row, edge_k):
+        # z-slab single row, fetched only when the edge grid row needs
+        # it (pinned to y block 0 elsewhere: revisit-cache skip)
+        return lambda kz, ky: (row, jnp.where(kz == edge_k, ky, 0), 0)
+
+    def corner_in(off, yside):
+        yc = ((lambda ky: jnp.maximum(ky * byb - 1, 0)) if yside < 0
+              else (lambda ky: jnp.minimum(ky * byb + byb, nyb - 1)))
+        return lambda kz, ky: (jnp.clip(kz * bz + off, 0, Z - 1),
+                               yc(ky), 0)
+
+    def corner_yslab(off):
+        # y-slab singles: z-extended buffer, origin -bz, valid at every
+        # z the window can touch (including off-shard rows)
+        return lambda kz, ky: (bz + kz * bz + off, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),                  # origin
+        pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)),  # main
+        # z-in singles
+        pl.BlockSpec((1, by, X), clampz1(-2)),
+        pl.BlockSpec((1, by, X), clampz1(-1)),
+        pl.BlockSpec((1, by, X), clampz1(bz)),
+        pl.BlockSpec((1, by, X), clampz1(bz + 1)),
+        # z-slab singles: zlo last two rows, zhi first two
+        pl.BlockSpec((1, by, X), zslab_row(bz - 2, 0)),
+        pl.BlockSpec((1, by, X), zslab_row(bz - 1, 0)),
+        pl.BlockSpec((1, by, X), zslab_row(0, nzg - 1)),
+        pl.BlockSpec((1, by, X), zslab_row(1, nzg - 1)),
+        # y-in ESUB slabs (clamped; dead at y edges)
+        pl.BlockSpec((bz, ESUB, X),
+                     lambda kz, ky: (kz, jnp.maximum(ky * byb - 1, 0), 0)),
+        pl.BlockSpec((bz, ESUB, X),
+                     lambda kz, ky: (kz, jnp.minimum(ky * byb + byb,
+                                                     nyb - 1), 0)),
+        # y-slab main-z blocks (z-extended buffer: block kz+1)
+        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0)),
+        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0)),
+    ]
+    # corner in-shard singles: (zoff, yside) row-major over ZOFFS
+    for off in ZOFFS:
+        for yside in (-1, 1):
+            in_specs.append(pl.BlockSpec((1, ESUB, X),
+                                         corner_in(off, yside)))
+    # corner z-slab (2, ESUB, X) blocks (the two adjacent slab rows —
+    # 2-row z blocks need bz even, which the caller guarantees):
+    # zlo x {ym, yp}, zhi x {ym, yp}
+    for row, edge_k in ((bz // 2 - 1, 0), (0, nzg - 1)):
+        for yside in (-1, 1):
+            yc = ((lambda ky: jnp.maximum(ky * byb - 1, 0)) if yside < 0
+                  else (lambda ky: jnp.minimum(ky * byb + byb, nyb - 1)))
+            in_specs.append(pl.BlockSpec(
+                (2, ESUB, X),
+                lambda kz, ky, r=row, e=edge_k, f=yc:
+                (r, jnp.where(kz == e, f(ky), 0), 0)))
+    # corner y-slab singles
+    for off in ZOFFS:
+        for _yside in (-1, 1):
+            in_specs.append(pl.BlockSpec((1, ESUB, X), corner_yslab(off)))
+
+    zlo, zhi = slabs["zlo"], slabs["zhi"]
+    ylo, yhi = slabs["ylo"], slabs["yhi"]
+    inputs = [jnp.asarray(origin_zyx, jnp.int32),
+              interior,
+              interior, interior, interior, interior,
+              zlo, zlo, zhi, zhi,
+              interior, interior,
+              ylo, yhi]
+    inputs += [interior] * 8
+    inputs += [zlo, zlo, zhi, zhi]
+    inputs += [ylo, yhi] * 4
+    return pl.pallas_call(
+        kern,
+        grid=(nzg, nyg),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)),
+        out_shape=jax.ShapeDtypeStruct((Z, Y, X), interior.dtype),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024),
+        interpret=interpret,
+    )(*inputs)
+
+
 def mhd_halo_blocks(Z: int, Y: int, block_z: int = 8,
                     block_y: int = 32) -> Tuple[int, int]:
     """The (bz, by) blocking the MHD halo kernel will use for a

@@ -35,24 +35,33 @@ R = 3          # stencil radius (6th order)
 ESUB = 8       # edge-slab sublane tile (f32)
 
 
+# z window segments: R single rows below, the main bz-row block, R
+# single rows above. z is the majormost (untiled) dim, so single-row
+# blocks are legal and fetch EXACTLY the radius — unlike y, whose
+# sublane tiling forces ESUB-row slabs.
+_ZSEGS = (-3, -2, -1, 0, 1, 2, 3)
+_YSEGS = (-1, 0, 1)
+
+
 def _field_specs(Z: int, Y: int, X: int, bz: int, by: int):
-    """9 BlockSpecs covering one field's (bz+6, by+6, X) neighborhood:
-    3 z segments (preceding ESUB-block, main, following ESUB-block) x
-    3 y segments (preceding ESUB-slab, main, following ESUB-slab), all
-    periodic via wrapped index maps."""
-    nzb = Z // ESUB
+    """21 BlockSpecs covering one field's (bz+6, by+6, X) neighborhood:
+    7 z segments (3 wrapped single rows below, main, 3 above — exact-
+    radius fetches, since the major dim has no tile granularity) x 3 y
+    segments (preceding ESUB-slab, main, following ESUB-slab), all
+    periodic via wrapped index maps. Read amplification per block is
+    (1 + 2R/bz) * (1 + 2*ESUB/by) — the single-row z fetches are what
+    keep the first factor at 2R rather than 2*ESUB."""
     nyb = Y // ESUB
     byb = by // ESUB
-    bzb = bz // ESUB
 
     def zy(zseg: int, yseg: int):
-        # block index maps; zseg/yseg in {-1, 0, 1}
         if zseg == 0:
             zshape, zidx = bz, (lambda kz: kz)
-        elif zseg < 0:
-            zshape, zidx = ESUB, (lambda kz: (kz * bzb - 1) % nzb)
         else:
-            zshape, zidx = ESUB, (lambda kz: (kz * bzb + bzb) % nzb)
+            # single wrapped row at element offset kz*bz + zseg (below)
+            # or kz*bz + bz + zseg - 1 (above); block units == elements
+            off = zseg if zseg < 0 else bz + zseg - 1
+            zshape, zidx = 1, (lambda kz, o=off: (kz * bz + o) % Z)
         if yseg == 0:
             yshape, yidx = by, (lambda ky: ky)
         elif yseg < 0:
@@ -64,23 +73,22 @@ def _field_specs(Z: int, Y: int, X: int, bz: int, by: int):
             functools.partial(lambda kz, ky, zf, yf: (zf(kz), yf(ky), 0),
                               zf=zidx, yf=yidx))
 
-    return [zy(zs, ys) for zs in (-1, 0, 1) for ys in (-1, 0, 1)]
+    return [zy(zs, ys) for zs in _ZSEGS for ys in _YSEGS]
 
 
 def _assemble_window(refs) -> jnp.ndarray:
-    """(bz+6, by+6, X+6) periodic window from the 9 segment refs
-    (ordered as _field_specs: z in -1,0,1 outer, y in -1,0,1 inner)."""
-    zm_ym, zm_y0, zm_yp, z0_ym, z0_y0, z0_yp, zp_ym, zp_y0, zp_yp = refs
+    """(bz+6, by+6, X) periodic window from the 21 segment refs
+    (ordered as _field_specs: z in _ZSEGS outer, y in _YSEGS inner).
+    x is NOT extended: every buffer stays lane-aligned at X and the
+    periodic x shifts happen per-derivative via ``pltpu.roll`` (the
+    FieldData ``x_wrap`` mode) — an X+2R window would make every x
+    slice a lane-misaligned copy."""
     rows = []
-    rows.append(jnp.concatenate(
-        [zm_ym[ESUB - R:, ESUB - R:], zm_y0[ESUB - R:, :],
-         zm_yp[ESUB - R:, :R]], axis=1))
-    rows.append(jnp.concatenate(
-        [z0_ym[:, ESUB - R:], z0_y0[...], z0_yp[:, :R]], axis=1))
-    rows.append(jnp.concatenate(
-        [zp_ym[:R, ESUB - R:], zp_y0[:R, :], zp_yp[:R, :R]], axis=1))
-    w = jnp.concatenate(rows, axis=0)
-    return jnp.concatenate([w[..., -R:], w, w[..., :R]], axis=-1)
+    for zi in range(len(_ZSEGS)):
+        ym, y0, yp = refs[3 * zi:3 * zi + 3]
+        rows.append(jnp.concatenate(
+            [ym[:, ESUB - R:], y0[...], yp[:, :R]], axis=1))
+    return jnp.concatenate(rows, axis=0)
 
 
 def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
@@ -115,21 +123,23 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     alpha = float(RK3_ALPHA[s])
     beta = float(RK3_BETA[s])
     dt_ = float(dt_phys)
-    pad_lo = Dim3(R, R, R)
+    pad_lo = Dim3(0, R, R)     # x unpadded: wrap via pltpu.roll
     interior = Dim3(X, by, bz)
 
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
     nf = len(FIELDS)
 
     def kern(*refs):
-        field_refs = refs[:9 * nf]
-        w_refs = refs[9 * nf:10 * nf]
-        out_f = refs[10 * nf:11 * nf]
-        out_w = refs[11 * nf:12 * nf]
+        nseg = len(_ZSEGS) * len(_YSEGS)
+        field_refs = refs[:nseg * nf]
+        w_refs = refs[nseg * nf:nseg * nf + nf]
+        out_f = refs[nseg * nf + nf:nseg * nf + 2 * nf]
+        out_w = refs[nseg * nf + 2 * nf:nseg * nf + 3 * nf]
         data = {}
         for i, q in enumerate(FIELDS):
-            win = _assemble_window(field_refs[9 * i:9 * (i + 1)])
-            data[q] = FieldData(win, inv_ds, pad_lo, interior)
+            win = _assemble_window(field_refs[nseg * i:nseg * (i + 1)])
+            data[q] = FieldData(win, inv_ds, pad_lo, interior,
+                                x_wrap=True)
         rates = mhd_rates(data, prm, dtype)
         dta = jnp.dtype(dtype)
         for i, q in enumerate(FIELDS):
@@ -141,7 +151,7 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     inputs = []
     for q in FIELDS:
         in_specs.extend(_field_specs(Z, Y, X, bz, by))
-        inputs.extend([fields[q]] * 9)
+        inputs.extend([fields[q]] * (len(_ZSEGS) * len(_YSEGS)))
     for q in FIELDS:
         in_specs.append(main_spec)
         inputs.append(w[q])

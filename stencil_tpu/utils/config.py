@@ -20,6 +20,44 @@ def apply_fake_cpu(n: int) -> None:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", n)
 
+
+def enable_compile_cache(path: str = "") -> None:
+    """Turn on JAX's persistent compilation cache (TPU backends only —
+    CPU compiles are fast and the tests would just churn the disk).
+    The fused megakernels take minutes to compile over a tunnelled
+    chip; caching makes every bench / app rerun after the first warm.
+    Safe to call any time before the first compilation — the gate reads
+    the REQUESTED platform list (config/env), not the initialized
+    backend, so this never forces backend init (multihost wiring must
+    still run first, parallel/multihost.py:36). Skips only when cpu is
+    the PRIMARY requested platform ("cpu", "cpu,..."): accelerator
+    lists with a cpu fallback ("axon,cpu", "tpu,cpu") must still cache,
+    and an unset list means platform discovery may find a TPU."""
+    import os
+
+    import jax
+
+    primary = str(jax.config.jax_platforms or "").split(",")[0].strip()
+    if primary == "cpu":
+        return
+    cache = (path or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+             or os.path.expanduser("~/.cache/stencil_tpu_xla"))
+    os.makedirs(cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache)
+    # cache every program that takes noticeable compile time
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def wrap2_disabled() -> bool:
+    """STENCIL_DISABLE_WRAP2=1 is the kill-switch harnesses use to fall
+    back from the temporally-blocked pair kernels to the hardware-proven
+    single-step kernels ("0" and unset both leave pairs on). Shared by
+    the wrap and halo step builders (models/jacobi.py)."""
+    import os
+
+    return (os.environ.get("STENCIL_DISABLE_WRAP2", "").lower()
+            in ("1", "true", "yes"))
+
 import re
 from typing import Dict, Tuple
 

@@ -143,6 +143,51 @@ def test_jacobi_halo_uneven_small_blocks(gzyx, mesh_shape, blocks):
     np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
 
 
+@pytest.mark.parametrize("mesh_shape,blocks", [
+    ((1, 1, 1), (4, 8)),     # nzg=4, nyg=2 on one shard (wrapped slabs)
+    ((1, 2, 2), (4, 8)),     # sharded + interior blocks both axes
+    ((1, 2, 2), (2, 8)),     # bz=2: corner z-slab blocks == whole slab
+])
+def test_jacobi_halo_pair_multiblock(mesh_shape, blocks):
+    """The two-step pair kernel with MULTI-BLOCK grids (nzg > 1 and/or
+    nyg > 1): exercises the in-shard ring singles, clamped corner maps,
+    and revisit-cache slab pinning that the model-level tests (whose
+    small shards collapse to one block) never select."""
+    from stencil_tpu.ops.pallas_halo import jacobi7_halo2_pallas
+
+    gz, gy, gx = 16, 16, 30
+    rng = np.random.default_rng(11)
+    init = rng.uniform(0.0, 1.0, size=(gz, gy, gx)).astype(np.float32)
+    hot = (gx // 3, gy // 2, gz // 2)
+    cold = (gx * 2 // 3, gy // 2, gz // 2)
+    sph_r = gx // 10
+    bz, by = blocks
+
+    mesh = make_mesh(mesh_shape,
+                     jax.devices()[:Dim3.of(mesh_shape).flatten()])
+    counts = mesh_dim(mesh)
+    local = Dim3(gx, gy // counts.y, gz // counts.z)
+
+    def shard_pair(p):
+        ox, oy, oz = shard_origin(local, Dim3(0, 0, 0))
+        org = jnp.stack([oz, oy, ox]).astype(jnp.int32)
+        slabs = exchange_interior_slabs(p, counts, rz=bz, ry=8,
+                                        radius_rows=2, y_z_extended=True)
+        return jacobi7_halo2_pallas(p, slabs, org, (gz, gy, gx), hot,
+                                    cold, sph_r, block_z=bz, block_y=by)
+
+    spec = P("z", "y", "x")
+    sm = jax.shard_map(shard_pair, mesh=mesh, in_specs=spec,
+                       out_specs=spec, check_vma=False)
+    arr = jax.device_put(jnp.asarray(init), NamedSharding(mesh, spec))
+    got = np.asarray(jax.jit(sm)(arr))
+
+    want = init
+    for _ in range(2):
+        want = dense_reference_step(want, hot, cold, sph_r)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
 @pytest.mark.parametrize("mesh_shape", [(1, 2, 4), (1, 1, 1)])
 def test_jacobi3d_model_halo_kernel(mesh_shape):
     """Jacobi3D(kernel='halo') end-to-end through the orchestrator."""
