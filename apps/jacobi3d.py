@@ -29,6 +29,11 @@ def main() -> None:
     ap.add_argument("--period", type=int, default=0,
                     help="paraview dump every N samples")
     ap.add_argument("--f64", action="store_true")
+    ap.add_argument("--bf16", action="store_true",
+                    help="bfloat16 fields: half the HBM traffic on the "
+                         "bandwidth-bound fused kernels (the TPU-native "
+                         "analog of the reference's float/double "
+                         "templating, bin/jacobi3d.cu:40-85)")
     ap.add_argument("--kernel", default="auto",
                     choices=("auto", "wrap", "halo", "xla", "pallas"),
                     help="compute path: fused Pallas (wrap: single-chip "
@@ -65,8 +70,11 @@ def main() -> None:
     gx, gy, gz = (args.x * mesh_shape.x, args.y * mesh_shape.y,
                   args.z * mesh_shape.z)
     methods = methods_from_args(args)
+    import jax.numpy as jnp
+    dtype = (np.float64 if args.f64
+             else jnp.bfloat16 if args.bf16 else np.float32)
     j = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape,
-                 dtype=np.float64 if args.f64 else np.float32,
+                 dtype=dtype,
                  methods=methods,
                  placement=placement_from_args(args),
                  output_prefix=args.prefix, kernel=args.kernel,

@@ -57,13 +57,17 @@ def _bench_model(label, ctor, size, iters, kernels, blocks, patch_fn,
         del m
 
 
-def bench_jacobi(size, iters, kernels, blocks):
+def bench_jacobi(size, iters, kernels, blocks, dtype="f32"):
     import jax
+    import jax.numpy as jnp
     from stencil_tpu.models.jacobi import Jacobi3D
+
+    dt = jnp.bfloat16 if dtype == "bf16" else jnp.float32
 
     def ctor(kernel):
         return Jacobi3D(size, size, size, mesh_shape=(1, 1, 1),
-                        devices=jax.devices()[:1], kernel=kernel)
+                        devices=jax.devices()[:1], kernel=kernel,
+                        dtype=dt)
 
     _bench_model("jacobi", ctor, size, iters, kernels, blocks,
                  _patch_jacobi_blocks, warmup=5)
@@ -98,9 +102,10 @@ def _patch_jacobi_blocks(j, kernel, blocks):
         orig_fit = pallas_halo.fit_pair_halo_blocks
         pallas_halo.jacobi7_halo_pallas = functools.partial(
             orig, block_z=bz, block_y=by)
+        from stencil_tpu.ops.pallas_stencil import sublane_tile_bytes
         pallas_halo.fit_pair_halo_blocks = lambda Z, Y, X, item: (
             pallas_halo._shrink_block(Z, bz),
-            pallas_halo._shrink_block(Y, by, pallas_halo.ESUB))
+            pallas_halo._shrink_block(Y, by, sublane_tile_bytes(item)))
         try:
             j._build_halo_step()
         finally:
@@ -154,6 +159,8 @@ def main():
     ap.add_argument("--kernels", default="wrap,halo,xla")
     ap.add_argument("--blocks", default="",
                     help="bz,by override for pallas kernels")
+    ap.add_argument("--dtype", default="f32", choices=("f32", "bf16"),
+                    help="jacobi field dtype (bf16 halves HBM traffic)")
     ap.add_argument("--fake-cpu", type=int, default=0, metavar="N",
                     help="run on N virtual CPU devices (smoke mode)")
     args = ap.parse_args()
@@ -169,7 +176,7 @@ def main():
     if args.model in ("jacobi", "both"):
         size = args.size or (512 if on_tpu else 32)
         iters = args.iters or (200 if on_tpu else 4)
-        bench_jacobi(size, iters, kernels, blocks)
+        bench_jacobi(size, iters, kernels, blocks, args.dtype)
     if args.model in ("mhd", "both"):
         size = args.size or (256 if on_tpu else 16)
         iters = args.iters or (20 if on_tpu else 2)

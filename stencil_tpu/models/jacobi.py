@@ -273,7 +273,8 @@ class Jacobi3D:
         traffic per iteration) with a single-step tail for odd counts;
         grids the pair kernel can't tile fall back to single steps."""
         from ..ops.pallas_stencil import (jacobi7_wrap2_pallas,
-                                          jacobi7_wrap_pallas)
+                                          jacobi7_wrap_pallas,
+                                          sublane_tile)
         from ..utils.config import wrap2_disabled
 
         dd = self.dd
@@ -281,7 +282,8 @@ class Jacobi3D:
         local = dd.local_size
         gsize = dd.size
         hot, cold, sph_r = sphere_geometry(gsize)
-        pair_ok = (local.z % 2 == 0 and local.y % 8 == 0
+        pair_ok = (local.z % 2 == 0
+                   and local.y % sublane_tile(self._dtype) == 0
                    and not wrap2_disabled())
 
         def steps(p, n):
@@ -366,9 +368,10 @@ class Jacobi3D:
         counterpart of the wrap-path pair kernel), with a single-step
         tail for odd iteration counts. Uneven (+-1) grids and grids the
         pair kernel can't tile keep the single-step kernel."""
-        from ..ops.pallas_halo import (ESUB, fit_pair_halo_blocks,
+        from ..ops.pallas_halo import (fit_pair_halo_blocks,
                                        jacobi7_halo2_pallas,
                                        jacobi7_halo_pallas)
+        from ..ops.pallas_stencil import sublane_tile
         from ..parallel.exchange import (exchange_interior_slabs,
                                          shard_interior_len)
         from ..utils.config import wrap2_disabled
@@ -379,9 +382,10 @@ class Jacobi3D:
         rem = dd.rem
         gsize = (dd.size.z, dd.size.y, dd.size.x)
         hot, cold, sph_r = sphere_geometry(dd.size)
-        esub = 8 if local.y % 8 == 0 else 1
+        tile = sublane_tile(self._dtype)
+        esub = tile if local.y % tile == 0 else 1
         pair_ok = (rem == Dim3(0, 0, 0) and local.z % 2 == 0
-                   and local.y % ESUB == 0 and not wrap2_disabled())
+                   and esub == tile and not wrap2_disabled())
         if pair_ok:
             pbz, pby = fit_pair_halo_blocks(
                 local.z, local.y, local.x, jnp.dtype(self._dtype).itemsize)
@@ -404,7 +408,7 @@ class Jacobi3D:
 
             def pair_body(q):
                 slabs = exchange_interior_slabs(
-                    q, counts, rz=pbz, ry=ESUB, radius_rows=2,
+                    q, counts, rz=pbz, ry=tile, radius_rows=2,
                     y_z_extended=True)
                 return jacobi7_halo2_pallas(q, slabs, org, gsize, hot,
                                             cold, sph_r, block_z=pbz,

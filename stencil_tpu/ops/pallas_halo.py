@@ -43,7 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..geometry import Dim3
-from .pallas_stencil import default_interpret
+from .pallas_stencil import default_interpret, sublane_tile_bytes
 
 ESUB = 8  # f32 sublane tile; slab row granularity
 R = 3     # MHD stencil radius (6th order)
@@ -266,13 +266,14 @@ def fit_pair_halo_blocks(Z: int, Y: int, X: int,
                          itemsize: int) -> Tuple[int, int]:
     """(bz, by) for the two-step halo kernel, shrunk bz-first until the
     VMEM estimate fits (same policy as fit_jacobi_halo_blocks)."""
+    esub = sublane_tile_bytes(itemsize)
     bz = _shrink_block(Z, 16)
-    by = _shrink_block(Y, 128, ESUB)
+    by = _shrink_block(Y, 128, esub)
     while _pair_block_bytes(bz, by, X, itemsize) > _VMEM_BUDGET:
         if bz > 2:
             bz = _shrink_block(Z, max(bz // 2, 2))
-        elif by > ESUB:
-            by = _shrink_block(Y, max(by // 2, ESUB), ESUB)
+        elif by > esub:
+            by = _shrink_block(Y, max(by // 2, esub), esub)
         else:
             break
     return bz, by
@@ -312,21 +313,23 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
     if interpret is None:
         interpret = default_interpret()
     Z, Y, X = interior.shape
-    assert Y % ESUB == 0, Y
+    esub = slabs["ylo"].shape[1]   # dtype sublane tile (8 f32 / 16 bf16)
+    assert Y % esub == 0, (Y, esub)
     dt = jnp.dtype(interior.dtype)
+    assert esub == sublane_tile_bytes(dt.itemsize), (esub, dt)
     if block_z is None and block_y is None:
         bz, by = fit_pair_halo_blocks(Z, Y, X, dt.itemsize)
     else:
         bz = _shrink_block(Z, block_z if block_z is not None else 16)
         by = _shrink_block(Y, block_y if block_y is not None else 128,
-                           ESUB)
+                           esub)
     if bz < 2 or bz % 2:
         raise ValueError(f"pair kernel needs even bz >= 2, got bz={bz} "
                          f"for Z={Z}")
     rzb = slabs["zlo"].shape[0]
     assert rzb == bz and slabs["zlo"].shape == (bz, Y, X), \
         ("pair kernel wants (bz, Y, X) z slabs", slabs["zlo"].shape, bz)
-    assert slabs["ylo"].shape == (Z + 2 * bz, ESUB, X), \
+    assert slabs["ylo"].shape == (Z + 2 * bz, esub, X), \
         ("pair kernel wants z-extended y slabs", slabs["ylo"].shape)
     Gz, Gy, Gx = gsize_zyx
     hx, hy, hz = hot_c
@@ -334,8 +337,8 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
     r2 = sph_r * sph_r
     nzg = Z // bz
     nyg = Y // by
-    nyb = Y // ESUB
-    byb = by // ESUB
+    nyb = Y // esub
+    byb = by // esub
 
     def sources(vals, org, z0, y0, nz, ny):
         """Re-impose the Dirichlet spheres on an (nz, ny, X) region at
@@ -363,7 +366,7 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
 
     # ref order (34 inputs): org | main | z-in singles (-2,-1,+0,+1 rel edges)
     # | z-slab singles | y-in slabs | y-slab mains | corner in-shard
-    # singles | corner z-slab ESUB blocks | corner y-slab singles
+    # singles | corner z-slab esub blocks | corner y-slab singles
     ZOFFS = (-2, -1, bz, bz + 1)
 
     def kern(org, main, zi_m2, zi_m1, zi_p0, zi_p1, zs_m2, zs_m1,
@@ -391,9 +394,9 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
             right = jnp.where(at_yhi, cyp[...],
                               jnp.where(at_zedge, czp[...], cip[...]))
             return jnp.concatenate(
-                [left[:, ESUB - 2:], mid, right[:, :2]], axis=1)
+                [left[:, esub - 2:], mid, right[:, :2]], axis=1)
 
-        # z-slab corner blocks are (2, ESUB, X) holding exactly the two
+        # z-slab corner blocks are (2, esub, X) holding exactly the two
         # adjacent slab rows; pick the one matching this ring row
         rows = [
             ring_row(zi_m2, zs_m2, ci_m2m, ci_m2p, cy_m2m, cy_m2p,
@@ -405,7 +408,7 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
         ym_slab = jnp.where(at_ylo, ys_m[...], yi_m[...])
         yp_slab = jnp.where(at_yhi, ys_p[...], yi_p[...])
         rows.append(jnp.concatenate(
-            [ym_slab[:, ESUB - 2:], c, yp_slab[:, :2]], axis=1))
+            [ym_slab[:, esub - 2:], c, yp_slab[:, :2]], axis=1))
         rows.append(ring_row(zi_p0, zs_p0, ci_p0m, ci_p0p, cy_p0m,
                              cy_p0p, cz_him[0:1], cz_hip[0:1], at_zhi))
         rows.append(ring_row(zi_p1, zs_p1, ci_p1m, ci_p1p, cy_p1m,
@@ -449,22 +452,22 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
         pl.BlockSpec((1, by, X), zslab_row(bz - 1, 0)),
         pl.BlockSpec((1, by, X), zslab_row(0, nzg - 1)),
         pl.BlockSpec((1, by, X), zslab_row(1, nzg - 1)),
-        # y-in ESUB slabs (clamped; dead at y edges)
-        pl.BlockSpec((bz, ESUB, X),
+        # y-in esub slabs (clamped; dead at y edges)
+        pl.BlockSpec((bz, esub, X),
                      lambda kz, ky: (kz, jnp.maximum(ky * byb - 1, 0), 0)),
-        pl.BlockSpec((bz, ESUB, X),
+        pl.BlockSpec((bz, esub, X),
                      lambda kz, ky: (kz, jnp.minimum(ky * byb + byb,
                                                      nyb - 1), 0)),
         # y-slab main-z blocks (z-extended buffer: block kz+1)
-        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0)),
-        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0)),
+        pl.BlockSpec((bz, esub, X), lambda kz, ky: (kz + 1, 0, 0)),
+        pl.BlockSpec((bz, esub, X), lambda kz, ky: (kz + 1, 0, 0)),
     ]
     # corner in-shard singles: (zoff, yside) row-major over ZOFFS
     for off in ZOFFS:
         for yside in (-1, 1):
-            in_specs.append(pl.BlockSpec((1, ESUB, X),
+            in_specs.append(pl.BlockSpec((1, esub, X),
                                          corner_in(off, yside)))
-    # corner z-slab (2, ESUB, X) blocks (the two adjacent slab rows —
+    # corner z-slab (2, esub, X) blocks (the two adjacent slab rows —
     # 2-row z blocks need bz even, which the caller guarantees):
     # zlo x {ym, yp}, zhi x {ym, yp}
     for row, edge_k in ((bz // 2 - 1, 0), (0, nzg - 1)):
@@ -472,13 +475,13 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
             yc = ((lambda ky: jnp.maximum(ky * byb - 1, 0)) if yside < 0
                   else (lambda ky: jnp.minimum(ky * byb + byb, nyb - 1)))
             in_specs.append(pl.BlockSpec(
-                (2, ESUB, X),
+                (2, esub, X),
                 lambda kz, ky, r=row, e=edge_k, f=yc:
                 (r, jnp.where(kz == e, f(ky), 0), 0)))
     # corner y-slab singles
     for off in ZOFFS:
         for _yside in (-1, 1):
-            in_specs.append(pl.BlockSpec((1, ESUB, X), corner_yslab(off)))
+            in_specs.append(pl.BlockSpec((1, esub, X), corner_yslab(off)))
 
     zlo, zhi = slabs["zlo"], slabs["zhi"]
     ylo, yhi = slabs["ylo"], slabs["yhi"]
@@ -628,10 +631,10 @@ def _mhd_inputs_for_field(f, slabs):
 
 
 def _mhd_select_window(refs, nzg: int, nyg: int) -> jnp.ndarray:
-    """Assemble one field's (bz+2R, by+2R, X+2R) stencil window from
+    """Assemble one field's (bz+2R, by+2R, X) stencil window from
     the 21 segment refs (order: _mhd_segment_specs), selecting slab
-    sources at shard edges and wrapping x in-core (x unsharded =>
-    in-window wrap IS the global periodic wrap)."""
+    sources at shard edges; x wraps per-derivative via pltpu.roll
+    (x unsharded => in-core wrap IS the global periodic wrap)."""
     kz = pl.program_id(0)
     ky = pl.program_id(1)
     at_zlo = kz == 0
@@ -664,8 +667,10 @@ def _mhd_select_window(refs, nzg: int, nyg: int) -> jnp.ndarray:
         jnp.concatenate([zp_ym[:R, ESUB - R:], zp_y0[:R, :],
                          zp_yp[:R, :R]], axis=1),
     ]
-    w = jnp.concatenate(rows, axis=0)
-    return jnp.concatenate([w[..., -R:], w, w[..., :R]], axis=-1)
+    # x stays at full (unsharded, periodic) width: the per-derivative
+    # pltpu.roll wrap (FieldData x_wrap) replaces the lane-misaligned
+    # X+2R window, matching the wrap kernel (ops/pallas_mhd.py)
+    return jnp.concatenate(rows, axis=0)
 
 
 def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
@@ -705,7 +710,7 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     alpha = float(RK3_ALPHA[s])
     beta = float(RK3_BETA[s])
     dt_ = float(dt_phys)
-    pad_lo = Dim3(R, R, R)
+    pad_lo = Dim3(0, R, R)     # x unpadded: wrap via pltpu.roll
     interior = Dim3(X, by, bz)
     nzg = Z // bz
     nyg = Y // by
@@ -723,7 +728,8 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
         for i, q in enumerate(FIELDS):
             win = _mhd_select_window(field_refs[nseg * i:nseg * (i + 1)],
                                      nzg, nyg)
-            data[q] = FieldData(win, inv_ds, pad_lo, interior)
+            data[q] = FieldData(win, inv_ds, pad_lo, interior,
+                                x_wrap=True)
         rates = mhd_rates(data, prm, dtype)
         dta = jnp.dtype(dtype)
         for i, q in enumerate(FIELDS):

@@ -42,6 +42,19 @@ def default_interpret() -> bool:
     return not on_tpu()
 
 
+def sublane_tile_bytes(itemsize: int) -> int:
+    """Minimum sublane (second-minor) tile rows for an ``itemsize``-byte
+    dtype on TPU: 8 for 4-byte types, 16 for 2-byte (bf16), 32 for
+    1-byte — edge-slab block shapes must be multiples of this to stay
+    tile-aligned. The single source of the tile rule."""
+    return max(8, 32 // max(itemsize, 1))
+
+
+def sublane_tile(dtype) -> int:
+    """``sublane_tile_bytes`` by dtype."""
+    return sublane_tile_bytes(jnp.dtype(dtype).itemsize)
+
+
 def _plane_specs(n_planes: int, z_lo: int, yp: int, xp: int):
     """One BlockSpec per z-offset: the same padded input is passed
     ``n_planes`` times with shifted index maps, giving the kernel an
@@ -111,9 +124,11 @@ def jacobi7_wrap_pallas(interior: jnp.ndarray,
     if interpret is None:
         interpret = default_interpret()
     Z, Y, X = interior.shape
-    # y edge slabs are esub rows: 8 (min f32 sublane tile) when Y
-    # allows, else single rows (small/interpret grids)
-    esub = 8 if Y % 8 == 0 else 1
+    # y edge slabs are esub rows: the dtype's min sublane tile (8 f32 /
+    # 16 bf16) when Y allows, else single rows (small/interpret grids)
+    esub = sublane_tile(interior.dtype)
+    if Y % esub:
+        esub = 1
     while Z % block_z:
         block_z //= 2
     while Y % block_y or block_y % esub:
@@ -204,31 +219,33 @@ def jacobi7_wrap2_pallas(interior: jnp.ndarray,
 
     Each (bz, by, X) output block reads a wrapped (bz+4, by+4, X) input
     window assembled from 9 wrapped segments (x wraps in-core via
-    ``pltpu.roll``). Needs bz even, Z % bz == 0, Y % 8 == 0, by % 8 == 0.
+    ``pltpu.roll``). Needs bz even, Z % bz == 0, and Y and by multiples
+    of the dtype's sublane tile (8 f32 / 16 bf16).
     """
     if interpret is None:
         interpret = default_interpret()
     Z, Y, X = interior.shape
-    if Z % 2 or Y % 8:
+    esub = sublane_tile(interior.dtype)
+    if Z % 2 or Y % esub:
         raise ValueError(f"wrap2 kernel needs even Z with an even "
-                         f"divisor block and Y % 8 == 0, got {(Z, Y)}")
+                         f"divisor block and Y % {esub} == 0, got {(Z, Y)}")
     bz, by = block_z, block_y
     while bz > 2 and (Z % bz or bz % 2):
         bz //= 2
     if bz < 2 or Z % bz or bz % 2:
         bz = 2
-    while by > 8 and (Y % by or by % 8):
+    while by > esub and (Y % by or by % esub):
         by //= 2
-    if by < 8 or Y % by or by % 8:
-        by = 8
+    if by < esub or Y % by or by % esub:
+        by = esub
     dt = jnp.dtype(interior.dtype)
     hx, hy, hz = hot_c
     cx, cy, cz = cold_c
     r2 = sph_r * sph_r
     bzh = bz // 2          # z index maps use 2-row granularity
     nzh = Z // 2
-    byb = by // 8          # y index maps use 8-col granularity
-    nyb8 = Y // 8
+    byb = by // esub       # y index maps use esub-col granularity
+    nyb8 = Y // esub
 
     def sources(vals, z0, y0, nz, ny):
         """Re-impose Dirichlet spheres on a (nz, ny, X) region whose
@@ -259,10 +276,11 @@ def jacobi7_wrap2_pallas(interior: jnp.ndarray,
         ky = pl.program_id(1)
         z0 = kz * bz
         y0 = ky * by
+        e2 = esub - 2
         # (bz+4, by+4, X) wrapped window: rows z0-2 .. z0+bz+2
-        top = jnp.concatenate([mm[:, 6:], zm[...], mp[:, :2]], axis=1)
-        mid = jnp.concatenate([ym[:, 6:], main[...], yp[:, :2]], axis=1)
-        bot = jnp.concatenate([pm[:, 6:], zp[...], pp[:, :2]], axis=1)
+        top = jnp.concatenate([mm[:, e2:], zm[...], mp[:, :2]], axis=1)
+        mid = jnp.concatenate([ym[:, e2:], main[...], yp[:, :2]], axis=1)
+        bot = jnp.concatenate([pm[:, e2:], zp[...], pp[:, :2]], axis=1)
         w = jnp.concatenate([top, mid, bot], axis=0)
         s1 = jstep(w)                         # (bz+2, by+2, X)
         s1 = sources(s1, z0 - 1, y0 - 1, bz + 2, by + 2)
@@ -276,22 +294,22 @@ def jacobi7_wrap2_pallas(interior: jnp.ndarray,
                      lambda kz, ky: ((kz * bzh - 1) % nzh, ky, 0)),
         pl.BlockSpec((2, by, X),
                      lambda kz, ky: ((kz * bzh + bzh) % nzh, ky, 0)),
-        # 8-col y slabs just outside the block, periodic
-        pl.BlockSpec((bz, 8, X),
+        # esub-col y slabs just outside the block, periodic
+        pl.BlockSpec((bz, esub, X),
                      lambda kz, ky: (kz, (ky * byb - 1) % nyb8, 0)),
-        pl.BlockSpec((bz, 8, X),
+        pl.BlockSpec((bz, esub, X),
                      lambda kz, ky: (kz, (ky * byb + byb) % nyb8, 0)),
-        # (2, 8, X) corners
-        pl.BlockSpec((2, 8, X),
+        # (2, esub, X) corners
+        pl.BlockSpec((2, esub, X),
                      lambda kz, ky: ((kz * bzh - 1) % nzh,
                                      (ky * byb - 1) % nyb8, 0)),
-        pl.BlockSpec((2, 8, X),
+        pl.BlockSpec((2, esub, X),
                      lambda kz, ky: ((kz * bzh - 1) % nzh,
                                      (ky * byb + byb) % nyb8, 0)),
-        pl.BlockSpec((2, 8, X),
+        pl.BlockSpec((2, esub, X),
                      lambda kz, ky: ((kz * bzh + bzh) % nzh,
                                      (ky * byb - 1) % nyb8, 0)),
-        pl.BlockSpec((2, 8, X),
+        pl.BlockSpec((2, esub, X),
                      lambda kz, ky: ((kz * bzh + bzh) % nzh,
                                      (ky * byb + byb) % nyb8, 0)),
     ]

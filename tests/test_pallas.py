@@ -6,6 +6,7 @@ slicing implementation it accelerates, and the pallas-kernel Jacobi
 model is checked against the dense single-device oracle.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -168,3 +169,34 @@ def test_jacobi_model_pallas_kernel_matches_oracle():
         temp = dense_reference_step(temp, hot, cold, n // 10)
         j.step()
     np.testing.assert_allclose(j.temperature(), temp, atol=1e-5)
+
+
+@pytest.mark.parametrize("kernel,mesh_shape", [
+    ("wrap", (1, 1, 1)),     # pair kernel, 16-row bf16 edge slabs
+    ("halo", (1, 2, 2)),     # slab-layout pair kernel, bf16 tiles
+])
+def test_jacobi_model_bf16(kernel, mesh_shape):
+    """bfloat16 fields through the fused fast paths (the TPU-native
+    analog of the reference's float/double templating,
+    bin/jacobi3d.cu:40-85): the dtype's 16-row sublane tile changes
+    every edge-slab block shape, so run the full model vs a float64
+    dense oracle at bf16 tolerance."""
+    import jax.numpy as jnp
+
+    from stencil_tpu.models.jacobi import Jacobi3D, dense_reference_step
+
+    n = 32
+    ndev = mesh_shape[0] * mesh_shape[1] * mesh_shape[2]
+    j = Jacobi3D(n, n, n, mesh_shape=mesh_shape, dtype=jnp.bfloat16,
+                 kernel=kernel, devices=jax.devices()[:ndev])
+    assert j.kernel_path == kernel
+    j.init()
+    j.run(2)
+    hot = (n // 3, n // 2, n // 2)
+    cold = (2 * n // 3, n // 2, n // 2)
+    want = np.full((n, n, n), 0.5, dtype=np.float64)
+    for _ in range(2):
+        want = dense_reference_step(want, hot, cold, n // 10)
+    got = np.asarray(j.temperature(), dtype=np.float64)
+    # two bf16 steps: ~8 bits of mantissa -> absolute error ~1e-2
+    np.testing.assert_allclose(got, want, atol=2e-2)
