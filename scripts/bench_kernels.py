@@ -81,20 +81,20 @@ def _patch_jacobi_blocks(j, kernel, blocks):
 
     bz, by = blocks
     if kernel == "wrap":
-        # the wrap step runs pairs through the wrap2 kernel with a
-        # single-step tail — patch BOTH so the sweep measures what it
-        # reports
+        # the wrap step runs N-step groups through the wrapn kernel
+        # with a single-step tail — patch BOTH so the sweep measures
+        # what it reports
         orig1 = pallas_stencil.jacobi7_wrap_pallas
-        orig2 = pallas_stencil.jacobi7_wrap2_pallas
+        orign = pallas_stencil.jacobi7_wrapn_pallas
         pallas_stencil.jacobi7_wrap_pallas = functools.partial(
             orig1, block_z=bz, block_y=by)
-        pallas_stencil.jacobi7_wrap2_pallas = functools.partial(
-            orig2, block_z=bz, block_y=by)
+        pallas_stencil.jacobi7_wrapn_pallas = functools.partial(
+            orign, block_z=bz, block_y=by)
         try:
             j._build_wrap_step()
         finally:
             pallas_stencil.jacobi7_wrap_pallas = orig1
-            pallas_stencil.jacobi7_wrap2_pallas = orig2
+            pallas_stencil.jacobi7_wrapn_pallas = orign
     else:
         # the halo path runs pairs (jacobi7_halo2_pallas, blocks from
         # fit_pair_halo_blocks) with a single-step tail — patch both

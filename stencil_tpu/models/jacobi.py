@@ -268,11 +268,14 @@ class Jacobi3D:
 
     def _build_wrap_step(self) -> None:
         """Single-chip fused steps on the interior view: iterations run
-        in PAIRS through the temporally-blocked two-step kernel
-        (ops/pallas_stencil.jacobi7_wrap2_pallas — ~half the HBM
-        traffic per iteration) with a single-step tail for odd counts;
-        grids the pair kernel can't tile fall back to single steps."""
-        from ..ops.pallas_stencil import (jacobi7_wrap2_pallas,
+        in groups of N through the temporally-blocked multi-step kernel
+        (ops/pallas_stencil.jacobi7_wrapn_pallas — ~1/N the HBM traffic
+        per iteration; N=2 default, STENCIL_WRAP_STEPS to tune) with a
+        single-step tail; grids the blocked kernel can't tile fall back
+        to single steps."""
+        import os
+
+        from ..ops.pallas_stencil import (jacobi7_wrapn_pallas,
                                           jacobi7_wrap_pallas,
                                           sublane_tile)
         from ..utils.config import wrap2_disabled
@@ -282,8 +285,10 @@ class Jacobi3D:
         local = dd.local_size
         gsize = dd.size
         hot, cold, sph_r = sphere_geometry(gsize)
-        pair_ok = (local.z % 2 == 0
-                   and local.y % sublane_tile(self._dtype) == 0
+        tile = sublane_tile(self._dtype)
+        N = max(int(os.environ.get("STENCIL_WRAP_STEPS", "2") or 2), 1)
+        N = min(N, tile)
+        pair_ok = (local.y % tile == 0 and N > 1
                    and not wrap2_disabled())
 
         def steps(p, n):
@@ -292,13 +297,14 @@ class Jacobi3D:
                                lo.x + local.x))
             if pair_ok:
                 inner = lax.fori_loop(
-                    0, n // 2,
-                    lambda _, q: jacobi7_wrap2_pallas(q, hot, cold, sph_r),
+                    0, n // N,
+                    lambda _, q: jacobi7_wrapn_pallas(q, hot, cold,
+                                                      sph_r, steps=N),
                     inner)
-                inner = lax.cond(
-                    n % 2 == 1,
-                    lambda q: jacobi7_wrap_pallas(q, hot, cold, sph_r),
-                    lambda q: q, inner)
+                inner = lax.fori_loop(
+                    0, n % N,
+                    lambda _, q: jacobi7_wrap_pallas(q, hot, cold, sph_r),
+                    inner)
             else:
                 inner = lax.fori_loop(
                     0, n,

@@ -200,3 +200,49 @@ def test_jacobi_model_bf16(kernel, mesh_shape):
     got = np.asarray(j.temperature(), dtype=np.float64)
     # two bf16 steps: ~8 bits of mantissa -> absolute error ~1e-2
     np.testing.assert_allclose(got, want, atol=2e-2)
+
+
+@pytest.mark.parametrize("steps,bz,by", [(1, 4, 8), (3, 4, 8),
+                                         (3, 16, 128), (4, 2, 8),
+                                         (4, 8, 8),   # slabbed N-row segs
+                                         (5, 4, 16)])
+def test_jacobi7_wrapn_pallas_matches_n_steps(steps, bz, by):
+    """The generalized temporal-blocking kernel at depth N against N
+    dense reference steps — the ring recompute, per-step sources, and
+    wrapped single-row z fetches must hold at every depth (wrap2 is
+    the N=2 special case, tested above)."""
+    from stencil_tpu.models.jacobi import dense_reference_step
+    from stencil_tpu.ops.pallas_stencil import jacobi7_wrapn_pallas
+
+    n = 16
+    rng = np.random.default_rng(6)
+    t = rng.random((n, n, n)).astype(np.float32)
+    hot = (n // 3, n // 2, n // 2)
+    cold = (2 * n // 3, n // 2, n // 2)
+    want = t
+    for _ in range(steps):
+        want = dense_reference_step(want, hot, cold, n // 10)
+    got = np.asarray(jacobi7_wrapn_pallas(jnp.asarray(t), hot, cold,
+                                          n // 10, steps=steps,
+                                          block_z=bz, block_y=by,
+                                          interpret=True))
+    np.testing.assert_allclose(got, want, atol=3e-6)
+
+
+def test_jacobi_model_wrap_steps_env(monkeypatch):
+    """STENCIL_WRAP_STEPS=3 drives the wrap path in triples (+ tail)."""
+    from stencil_tpu.models.jacobi import Jacobi3D, dense_reference_step
+
+    monkeypatch.setenv("STENCIL_WRAP_STEPS", "3")
+    n = 16
+    j = Jacobi3D(n, n, n, mesh_shape=(1, 1, 1), dtype=np.float32,
+                 kernel="wrap", devices=jax.devices()[:1])
+    j.init()
+    j.run(4)   # one triple + one tail step
+    hot = (n // 3, n // 2, n // 2)
+    cold = (2 * n // 3, n // 2, n // 2)
+    want = np.full((n, n, n), 0.5, dtype=np.float32)
+    for _ in range(4):
+        want = dense_reference_step(want, hot, cold, n // 10)
+    np.testing.assert_allclose(j.temperature(), want, rtol=1e-5,
+                               atol=1e-6)
