@@ -9,6 +9,7 @@ bin/jacobi3d.cu:181-205); CSV result line
 """
 
 import argparse
+import os
 
 from _common import (add_dcn_flags, add_device_flags, apply_device_flags,
                      add_method_flags, add_placement_flags, csv_line,
@@ -34,6 +35,10 @@ def main() -> None:
                          "bandwidth-bound fused kernels (the TPU-native "
                          "analog of the reference's float/double "
                          "templating, bin/jacobi3d.cu:40-85)")
+    ap.add_argument("--wrap-steps", type=int, default=0, metavar="N",
+                    help="temporal-blocking depth for the single-chip "
+                         "wrap path (N fused iterations per HBM pass; "
+                         "default 2)")
     ap.add_argument("--kernel", default="auto",
                     choices=("auto", "wrap", "halo", "xla", "pallas"),
                     help="compute path: fused Pallas (wrap: single-chip "
@@ -71,6 +76,8 @@ def main() -> None:
                   args.z * mesh_shape.z)
     methods = methods_from_args(args)
     import jax.numpy as jnp
+    if args.wrap_steps:
+        os.environ["STENCIL_WRAP_STEPS"] = str(args.wrap_steps)
     dtype = (np.float64 if args.f64
              else jnp.bfloat16 if args.bf16 else np.float32)
     j = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape,

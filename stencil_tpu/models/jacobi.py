@@ -286,8 +286,13 @@ class Jacobi3D:
         gsize = dd.size
         hot, cold, sph_r = sphere_geometry(gsize)
         tile = sublane_tile(self._dtype)
-        N = max(int(os.environ.get("STENCIL_WRAP_STEPS", "2") or 2), 1)
-        N = min(N, tile)
+        try:
+            N = int(os.environ.get("STENCIL_WRAP_STEPS", "2") or 2)
+        except ValueError:
+            from ..utils.logging import LOG_WARN
+            LOG_WARN("STENCIL_WRAP_STEPS is not an integer; using 2")
+            N = 2
+        N = min(max(N, 1), tile)
         pair_ok = (local.y % tile == 0 and N > 1
                    and not wrap2_disabled())
 
