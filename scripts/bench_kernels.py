@@ -103,7 +103,7 @@ def _patch_jacobi_blocks(j, kernel, blocks):
         pallas_halo.jacobi7_halo_pallas = functools.partial(
             orig, block_z=bz, block_y=by)
         from stencil_tpu.ops.pallas_stencil import sublane_tile_bytes
-        pallas_halo.fit_pair_halo_blocks = lambda Z, Y, X, item: (
+        pallas_halo.fit_pair_halo_blocks = lambda Z, Y, X, item, steps=2: (
             pallas_halo._shrink_block(Z, bz),
             pallas_halo._shrink_block(Y, by, sublane_tile_bytes(item)))
         try:

@@ -109,6 +109,21 @@ def jacobi_shard_step_overlap(p, radius: Radius, counts: Dim3, local: Dim3,
     return write_interior(p_ex["temp"], out, radius)
 
 
+def _wrap_steps(tile: int) -> int:
+    """Temporal-blocking depth from STENCIL_WRAP_STEPS (default 2),
+    clamped to [1, sublane tile] — shared by the wrap and halo step
+    builders (one tunable, two kernel families)."""
+    import os
+
+    try:
+        n = int(os.environ.get("STENCIL_WRAP_STEPS", "2") or 2)
+    except ValueError:
+        from ..utils.logging import LOG_WARN
+        LOG_WARN("STENCIL_WRAP_STEPS is not an integer; using 2")
+        n = 2
+    return min(max(n, 1), tile)
+
+
 class Jacobi3D:
     """Distributed Jacobi-3D solver over a TPU mesh."""
 
@@ -286,13 +301,7 @@ class Jacobi3D:
         gsize = dd.size
         hot, cold, sph_r = sphere_geometry(gsize)
         tile = sublane_tile(self._dtype)
-        try:
-            N = int(os.environ.get("STENCIL_WRAP_STEPS", "2") or 2)
-        except ValueError:
-            from ..utils.logging import LOG_WARN
-            LOG_WARN("STENCIL_WRAP_STEPS is not an integer; using 2")
-            N = 2
-        N = min(max(N, 1), tile)
+        N = _wrap_steps(tile)
         pair_ok = (local.y % tile == 0 and N > 1
                    and not wrap2_disabled())
 
@@ -332,8 +341,9 @@ class Jacobi3D:
         all inside one shard_map/jit with buffer donation.
 
         ``make_body(org)`` returns either a single-iteration body, or a
-        ``(body, pair_body)`` tuple — then ``n`` iterations run as
-        ``n // 2`` temporally-blocked pairs plus a single-step tail."""
+        ``(body, group_body, group_n)`` tuple — then ``n`` iterations
+        run as ``n // group_n`` temporally-blocked groups plus a
+        single-step tail."""
         from ..parallel.exchange import shard_origin
 
         dd = self.dd
@@ -349,10 +359,11 @@ class Jacobi3D:
                                lo.x + local.x))
             made = make_body(org)
             if isinstance(made, tuple):
-                body, pair_body = made
-                inner = lax.fori_loop(0, n // 2,
-                                      lambda _, q: pair_body(q), inner)
-                inner = lax.cond(n % 2 == 1, body, lambda q: q, inner)
+                body, group_body, gn = made
+                inner = lax.fori_loop(0, n // gn,
+                                      lambda _, q: group_body(q), inner)
+                inner = lax.fori_loop(0, n % gn,
+                                      lambda _, q: body(q), inner)
             else:
                 body = made
                 inner = lax.fori_loop(0, n, lambda _, q: body(q), inner)
@@ -372,15 +383,17 @@ class Jacobi3D:
         reference's fused solve kernel running at every scale,
         astaroth/astaroth.cu:552-646; see ops/pallas_halo.py).
 
-        Even grids run iterations in PAIRS through the temporally-
-        blocked two-step kernel (``jacobi7_halo2_pallas``): one radius-2
-        exchange feeds two fused steps, nearly halving both per-
-        iteration HBM traffic and exchange count (the slab-layout
-        counterpart of the wrap-path pair kernel), with a single-step
-        tail for odd iteration counts. Uneven (+-1) grids and grids the
-        pair kernel can't tile keep the single-step kernel."""
+        Even grids run iterations in groups of N through the
+        temporally-blocked kernel (``jacobi7_halon_pallas``, N=2
+        default / STENCIL_WRAP_STEPS): one radius-N exchange feeds N
+        fused steps, dividing per-iteration HBM traffic AND exchange
+        count by ~N (the slab-layout counterpart of the wrap-path
+        kernel), with a single-step tail. Uneven (+-1) grids and grids
+        the blocked kernel can't tile keep the single-step kernel."""
+        import os
+
         from ..ops.pallas_halo import (fit_pair_halo_blocks,
-                                       jacobi7_halo2_pallas,
+                                       jacobi7_halon_pallas,
                                        jacobi7_halo_pallas)
         from ..ops.pallas_stencil import sublane_tile
         from ..parallel.exchange import (exchange_interior_slabs,
@@ -395,12 +408,23 @@ class Jacobi3D:
         hot, cold, sph_r = sphere_geometry(dd.size)
         tile = sublane_tile(self._dtype)
         esub = tile if local.y % tile == 0 else 1
-        pair_ok = (rem == Dim3(0, 0, 0) and local.z % 2 == 0
-                   and esub == tile and not wrap2_disabled())
+        N = _wrap_steps(tile)
+        pair_ok = (rem == Dim3(0, 0, 0) and N > 1 and esub == tile
+                   and not wrap2_disabled())
         if pair_ok:
             pbz, pby = fit_pair_halo_blocks(
-                local.z, local.y, local.x, jnp.dtype(self._dtype).itemsize)
-            pair_ok = pbz >= 2 and pbz % 2 == 0
+                local.z, local.y, local.x,
+                jnp.dtype(self._dtype).itemsize, N)
+            if pbz < N:
+                from ..utils.logging import LOG_WARN
+                LOG_WARN(f"halo temporal depth clamped to bz={pbz} "
+                         f"(requested {N})")
+            N = min(N, pbz)
+            pair_ok = N > 1
+        if pair_ok:
+            from ..utils.logging import LOG_INFO
+            LOG_INFO(f"jacobi halo path: {N}-step temporal blocking, "
+                     f"blocks ({pbz}, {pby})")
 
         def make_body(org):
             lens = jnp.stack([
@@ -419,13 +443,13 @@ class Jacobi3D:
 
             def pair_body(q):
                 slabs = exchange_interior_slabs(
-                    q, counts, rz=pbz, ry=tile, radius_rows=2,
+                    q, counts, rz=pbz, ry=tile, radius_rows=N,
                     y_z_extended=True)
-                return jacobi7_halo2_pallas(q, slabs, org, gsize, hot,
-                                            cold, sph_r, block_z=pbz,
-                                            block_y=pby)
+                return jacobi7_halon_pallas(q, slabs, org, gsize, hot,
+                                            cold, sph_r, steps=N,
+                                            block_z=pbz, block_y=pby)
 
-            return body, pair_body
+            return body, pair_body, N
 
         self._build_interior_resident_steps(make_body)
 

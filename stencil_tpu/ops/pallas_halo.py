@@ -252,26 +252,31 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
       slabs["ylo"], slabs["yhi"])
 
 
-def _pair_block_bytes(bz: int, by: int, X: int, itemsize: int) -> int:
-    """Scoped-VMEM estimate for one jacobi7_halo2_pallas grid step:
+def _pair_block_bytes(bz: int, by: int, X: int, itemsize: int,
+                      steps: int = 2) -> int:
+    """Scoped-VMEM estimate for one jacobi7_halon_pallas grid step:
     main + out (bz,by,X) and the thin ring segments, double-buffered by
-    the pipeline, plus the assembled (bz+4, by+4, X) window and the
-    step-1 intermediate (bz+2, by+2, X) held during compute."""
-    streamed = 2 * (2 * bz * by * X + 8 * by * X + 8 * bz * ESUB * X)
-    held = (bz + 4) * (by + 4) * X + (bz + 2) * (by + 2) * X
+    the pipeline, plus the assembled (bz+2N, by+2N, X) window and the
+    first intermediate held during compute."""
+    N = steps
+    streamed = 2 * (2 * bz * by * X + 4 * N * by * X
+                    + 8 * bz * ESUB * X)
+    held = ((bz + 2 * N) * (by + 2 * N) * X
+            + (bz + 2 * N - 2) * (by + 2 * N - 2) * X)
     return itemsize * (streamed + held)
 
 
-def fit_pair_halo_blocks(Z: int, Y: int, X: int,
-                         itemsize: int) -> Tuple[int, int]:
-    """(bz, by) for the two-step halo kernel, shrunk bz-first until the
-    VMEM estimate fits (same policy as fit_jacobi_halo_blocks)."""
+def fit_pair_halo_blocks(Z: int, Y: int, X: int, itemsize: int,
+                         steps: int = 2) -> Tuple[int, int]:
+    """(bz, by) for the N-step halo kernel, shrunk bz-first until the
+    VMEM estimate fits (same policy as fit_jacobi_halo_blocks). bz is
+    kept >= steps (the in-shard ring reads rows kz*bz - N)."""
     esub = sublane_tile_bytes(itemsize)
     bz = _shrink_block(Z, 16)
     by = _shrink_block(Y, 128, esub)
-    while _pair_block_bytes(bz, by, X, itemsize) > _VMEM_BUDGET:
-        if bz > 2:
-            bz = _shrink_block(Z, max(bz // 2, 2))
+    while _pair_block_bytes(bz, by, X, itemsize, steps) > _VMEM_BUDGET:
+        if bz > max(2, steps):
+            bz = _shrink_block(Z, max(bz // 2, 2, steps))
         elif by > esub:
             by = _shrink_block(Y, max(by // 2, esub), esub)
         else:
@@ -279,53 +284,60 @@ def fit_pair_halo_blocks(Z: int, Y: int, X: int,
     return bz, by
 
 
-def jacobi7_halo2_pallas(interior: jnp.ndarray,
+def jacobi7_halon_pallas(interior: jnp.ndarray,
                          slabs: Dict[str, jnp.ndarray],
                          origin_zyx: jnp.ndarray,
                          gsize_zyx: Tuple[int, int, int],
                          hot_c: Tuple[int, int, int],
                          cold_c: Tuple[int, int, int], sph_r: int,
+                         steps: int = 2,
                          block_z: Optional[int] = None,
                          block_y: Optional[int] = None,
                          interpret: Optional[bool] = None) -> jnp.ndarray:
-    """TWO fused Jacobi iterations (+ sphere sources after each) per
-    slab exchange on one interior-resident (Z, Y, X) shard — temporal
-    blocking for the multi-device halo path, the slab-layout counterpart
-    of ``jacobi7_wrap2_pallas``. One radius-2 exchange feeds two
-    7-point steps: each (bz, by, X) output block reads a (bz+4, by+4,
-    X) window (x wraps in-core — x is never mesh-sharded), computes the
-    step-1 values on the (bz+2, by+2) ring-extended region with
-    Dirichlet sources re-imposed at their wrapped GLOBAL positions, and
-    steps again. Bit-identical to two ``jacobi7_halo_pallas`` calls.
-    Reference semantics: bin/jacobi3d.cu:40-85 applied twice per
-    exchange (the reference exchanges every iteration; fewer, fatter
-    exchanges are the TPU-side trade — same bytes, half the latencies).
+    """``steps`` fused Jacobi iterations (+ sphere sources after each)
+    per slab exchange on one interior-resident (Z, Y, X) shard —
+    temporal blocking for the multi-device halo path, the slab-layout
+    counterpart of ``jacobi7_wrapn_pallas``. One radius-N exchange
+    feeds N 7-point steps: each (bz, by, X) output block reads a
+    (bz+2N, by+2N, X) window (x wraps in-core — x is never
+    mesh-sharded), computes ring-extended intermediate steps with
+    Dirichlet sources re-imposed at their wrapped GLOBAL positions,
+    and finishes on the block. Bit-identical to N
+    ``jacobi7_halo_pallas`` calls. Reference semantics:
+    bin/jacobi3d.cu:40-85 applied N times per exchange (the reference
+    exchanges every iteration; fewer, fatter exchanges are the
+    TPU-side trade — same bytes, 1/N the latencies).
 
-    ``slabs`` from ``exchange_interior_slabs(p, counts, rz=bz, ry=ESUB,
-    radius_rows=2, y_z_extended=True)``: zlo/zhi (bz, Y, X) with the
-    adjacent two rows at zlo[-2:] / zhi[:2]; ylo/yhi (Z + 2*bz, ESUB,
-    X) z-extended by one z block so yz corner data rides along (the
-    sequential-sweep corner rule). ``gsize_zyx`` is the GLOBAL (Gz,
-    Gy, Gx) — the step-1 ring extends into neighbor shards, so its
-    source test wraps global coordinates modulo the global grid. Even
-    grids only (no uneven overlay — the caller gates on rem == 0).
+    ``slabs`` from ``exchange_interior_slabs(p, counts, rz=bz,
+    ry=<sublane tile>, radius_rows=N, y_z_extended=True)``: zlo/zhi
+    (bz, Y, X) with the adjacent N rows at zlo[-N:] / zhi[:N]; ylo/yhi
+    (Z + 2*bz, esub, X) z-extended by one z block so yz corner data
+    rides along (the sequential-sweep corner rule). ``gsize_zyx`` is
+    the GLOBAL (Gz, Gy, Gx) — intermediate rings extend into neighbor
+    shards, so their source test wraps global coordinates modulo the
+    global grid. Even grids only (no uneven overlay — the caller gates
+    on rem == 0). Needs steps <= bz and steps <= the sublane tile.
     """
     if interpret is None:
         interpret = default_interpret()
+    N = int(steps)
     Z, Y, X = interior.shape
     esub = slabs["ylo"].shape[1]   # dtype sublane tile (8 f32 / 16 bf16)
     assert Y % esub == 0, (Y, esub)
     dt = jnp.dtype(interior.dtype)
     assert esub == sublane_tile_bytes(dt.itemsize), (esub, dt)
+    if N < 1 or N > esub:
+        raise ValueError(f"halo pair kernel needs 1 <= steps <= {esub},"
+                         f" got steps={N}")
     if block_z is None and block_y is None:
-        bz, by = fit_pair_halo_blocks(Z, Y, X, dt.itemsize)
+        bz, by = fit_pair_halo_blocks(Z, Y, X, dt.itemsize, N)
     else:
         bz = _shrink_block(Z, block_z if block_z is not None else 16)
         by = _shrink_block(Y, block_y if block_y is not None else 128,
                            esub)
-    if bz < 2 or bz % 2:
-        raise ValueError(f"pair kernel needs even bz >= 2, got bz={bz} "
-                         f"for Z={Z}")
+    if bz < N:
+        raise ValueError(f"halo pair kernel needs bz >= steps, got "
+                         f"bz={bz}, steps={N} for Z={Z}")
     rzb = slabs["zlo"].shape[0]
     assert rzb == bz and slabs["zlo"].shape == (bz, Y, X), \
         ("pair kernel wants (bz, Y, X) z slabs", slabs["zlo"].shape, bz)
@@ -364,17 +376,24 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
         xsum = (pltpu.roll(w, 1, 2) + pltpu.roll(w, X - 1, 2))[1:-1, 1:-1]
         return (zsum + ysum + xsum) * dt.type(1.0 / 6.0)
 
-    # ref order (34 inputs): org | main | z-in singles (-2,-1,+0,+1 rel edges)
-    # | z-slab singles | y-in slabs | y-slab mains | corner in-shard
-    # singles | corner z-slab esub blocks | corner y-slab singles
-    ZOFFS = (-2, -1, bz, bz + 1)
+    # ring-row z offsets, ascending: -N..-1 (below), bz..bz+N-1 (above)
+    ZOFFS = tuple(range(-N, 0)) + tuple(range(bz, bz + N))
+    # ref order: org | main | z-in singles | z-slab singles | y-in
+    # slabs | y-slab mains | corner in-shard singles | corner z-slab
+    # singles | corner y-slab singles (each corner group (zoff, yside)
+    # row-major over ZOFFS)
+    n2 = 2 * N
 
-    def kern(org, main, zi_m2, zi_m1, zi_p0, zi_p1, zs_m2, zs_m1,
-             zs_p0, zs_p1, yi_m, yi_p, ys_m, ys_p,
-             ci_m2m, ci_m2p, ci_m1m, ci_m1p, ci_p0m, ci_p0p, ci_p1m,
-             ci_p1p, cz_lom, cz_lop, cz_him, cz_hip,
-             cy_m2m, cy_m2p, cy_m1m, cy_m1p, cy_p0m, cy_p0p, cy_p1m,
-             cy_p1p, out):
+    def kern(*refs):
+        org = refs[0]
+        main = refs[1]
+        zin = refs[2:2 + n2]
+        zsl = refs[2 + n2:2 + 2 * n2]
+        yi_m, yi_p, ys_m, ys_p = refs[2 + 2 * n2:6 + 2 * n2]
+        cin = refs[6 + 2 * n2:6 + 4 * n2]
+        czs = refs[6 + 4 * n2:6 + 6 * n2]
+        cys = refs[6 + 6 * n2:6 + 8 * n2]
+        out = refs[-1]
         kz = pl.program_id(0)
         ky = pl.program_id(1)
         at_zlo = kz == 0
@@ -384,74 +403,75 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
         z0 = kz * bz
         y0 = ky * by
 
-        def ring_row(zi, zs, cim, cip, cym, cyp, czm, czp, at_zedge):
-            """One (1, by+4, X) window row outside the block in z:
+        def ring_row(i):
+            """One (1, by+2N, X) window row outside the block in z:
             mid from in-shard vs z-slab, corner cols from y-slab (any
             z — it is z-extended) vs z-slab (full-Y) vs in-shard."""
-            mid = jnp.where(at_zedge, zs[...], zi[...])
-            left = jnp.where(at_ylo, cym[...],
-                             jnp.where(at_zedge, czm[...], cim[...]))
-            right = jnp.where(at_yhi, cyp[...],
-                              jnp.where(at_zedge, czp[...], cip[...]))
+            at_zedge = at_zlo if ZOFFS[i] < 0 else at_zhi
+            mid = jnp.where(at_zedge, zsl[i][...], zin[i][...])
+            left = jnp.where(at_ylo, cys[2 * i][...],
+                             jnp.where(at_zedge, czs[2 * i][...],
+                                       cin[2 * i][...]))
+            right = jnp.where(at_yhi, cys[2 * i + 1][...],
+                              jnp.where(at_zedge, czs[2 * i + 1][...],
+                                        cin[2 * i + 1][...]))
             return jnp.concatenate(
-                [left[:, esub - 2:], mid, right[:, :2]], axis=1)
+                [left[:, esub - N:], mid, right[:, :N]], axis=1)
 
-        # z-slab corner blocks are (2, esub, X) holding exactly the two
-        # adjacent slab rows; pick the one matching this ring row
-        rows = [
-            ring_row(zi_m2, zs_m2, ci_m2m, ci_m2p, cy_m2m, cy_m2p,
-                     cz_lom[0:1], cz_lop[0:1], at_zlo),
-            ring_row(zi_m1, zs_m1, ci_m1m, ci_m1p, cy_m1m, cy_m1p,
-                     cz_lom[1:2], cz_lop[1:2], at_zlo),
-        ]
-        c = main[...]
+        rows = [ring_row(i) for i in range(N)]
         ym_slab = jnp.where(at_ylo, ys_m[...], yi_m[...])
         yp_slab = jnp.where(at_yhi, ys_p[...], yi_p[...])
         rows.append(jnp.concatenate(
-            [ym_slab[:, esub - 2:], c, yp_slab[:, :2]], axis=1))
-        rows.append(ring_row(zi_p0, zs_p0, ci_p0m, ci_p0p, cy_p0m,
-                             cy_p0p, cz_him[0:1], cz_hip[0:1], at_zhi))
-        rows.append(ring_row(zi_p1, zs_p1, ci_p1m, ci_p1p, cy_p1m,
-                             cy_p1p, cz_him[1:2], cz_hip[1:2], at_zhi))
-        w = jnp.concatenate(rows, axis=0)        # (bz+4, by+4, X)
-        s1 = jstep(w)                            # (bz+2, by+2, X)
-        s1 = sources(s1, org, z0 - 1, y0 - 1, bz + 2, by + 2)
-        s2 = jstep(s1)                           # (bz, by, X)
-        out[...] = sources(s2, org, z0, y0, bz, by)
+            [ym_slab[:, esub - N:], main[...], yp_slab[:, :N]], axis=1))
+        rows.extend(ring_row(N + i) for i in range(N))
+        w = jnp.concatenate(rows, axis=0)        # (bz+2N, by+2N, X)
+        for k in range(N):
+            w = jstep(w)                         # ring shrinks by 1
+            ring = N - 1 - k
+            w = sources(w, org, z0 - ring, y0 - ring, bz + 2 * ring,
+                        by + 2 * ring)
+        out[...] = w
 
     def clampz1(off):
         # single in-shard row at kz*bz + off, clamped into [0, Z)
-        return lambda kz, ky: (jnp.clip(kz * bz + off, 0, Z - 1), ky, 0)
+        return lambda kz, ky, o=off: (jnp.clip(kz * bz + o, 0, Z - 1),
+                                      ky, 0)
 
-    def zslab_row(row, edge_k):
-        # z-slab single row, fetched only when the edge grid row needs
-        # it (pinned to y block 0 elsewhere: revisit-cache skip)
-        return lambda kz, ky: (row, jnp.where(kz == edge_k, ky, 0), 0)
+    def zslab_row(off):
+        # z-slab single row (zlo right-aligned: row bz + off for
+        # off < 0; zhi left-aligned: row off - bz), fetched only when
+        # the edge grid row needs it (pinned elsewhere: revisit skip)
+        row = bz + off if off < 0 else off - bz
+        edge_k = 0 if off < 0 else nzg - 1
+        return lambda kz, ky, r=row, e=edge_k: (
+            r, jnp.where(kz == e, ky, 0), 0)
+
+    def ymap(yside):
+        return ((lambda ky: jnp.maximum(ky * byb - 1, 0)) if yside < 0
+                else (lambda ky: jnp.minimum(ky * byb + byb, nyb - 1)))
 
     def corner_in(off, yside):
-        yc = ((lambda ky: jnp.maximum(ky * byb - 1, 0)) if yside < 0
-              else (lambda ky: jnp.minimum(ky * byb + byb, nyb - 1)))
-        return lambda kz, ky: (jnp.clip(kz * bz + off, 0, Z - 1),
-                               yc(ky), 0)
+        return lambda kz, ky, o=off, f=ymap(yside): (
+            jnp.clip(kz * bz + o, 0, Z - 1), f(ky), 0)
+
+    def corner_zslab(off, yside):
+        row = bz + off if off < 0 else off - bz
+        edge_k = 0 if off < 0 else nzg - 1
+        return lambda kz, ky, r=row, e=edge_k, f=ymap(yside): (
+            r, jnp.where(kz == e, f(ky), 0), 0)
 
     def corner_yslab(off):
         # y-slab singles: z-extended buffer, origin -bz, valid at every
         # z the window can touch (including off-shard rows)
-        return lambda kz, ky: (bz + kz * bz + off, 0, 0)
+        return lambda kz, ky, o=off: (bz + kz * bz + o, 0, 0)
 
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),                  # origin
         pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)),  # main
-        # z-in singles
-        pl.BlockSpec((1, by, X), clampz1(-2)),
-        pl.BlockSpec((1, by, X), clampz1(-1)),
-        pl.BlockSpec((1, by, X), clampz1(bz)),
-        pl.BlockSpec((1, by, X), clampz1(bz + 1)),
-        # z-slab singles: zlo last two rows, zhi first two
-        pl.BlockSpec((1, by, X), zslab_row(bz - 2, 0)),
-        pl.BlockSpec((1, by, X), zslab_row(bz - 1, 0)),
-        pl.BlockSpec((1, by, X), zslab_row(0, nzg - 1)),
-        pl.BlockSpec((1, by, X), zslab_row(1, nzg - 1)),
+    ]
+    in_specs += [pl.BlockSpec((1, by, X), clampz1(o)) for o in ZOFFS]
+    in_specs += [pl.BlockSpec((1, by, X), zslab_row(o)) for o in ZOFFS]
+    in_specs += [
         # y-in esub slabs (clamped; dead at y edges)
         pl.BlockSpec((bz, esub, X),
                      lambda kz, ky: (kz, jnp.maximum(ky * byb - 1, 0), 0)),
@@ -462,38 +482,31 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
         pl.BlockSpec((bz, esub, X), lambda kz, ky: (kz + 1, 0, 0)),
         pl.BlockSpec((bz, esub, X), lambda kz, ky: (kz + 1, 0, 0)),
     ]
-    # corner in-shard singles: (zoff, yside) row-major over ZOFFS
     for off in ZOFFS:
         for yside in (-1, 1):
             in_specs.append(pl.BlockSpec((1, esub, X),
                                          corner_in(off, yside)))
-    # corner z-slab (2, esub, X) blocks (the two adjacent slab rows —
-    # 2-row z blocks need bz even, which the caller guarantees):
-    # zlo x {ym, yp}, zhi x {ym, yp}
-    for row, edge_k in ((bz // 2 - 1, 0), (0, nzg - 1)):
+    for off in ZOFFS:
         for yside in (-1, 1):
-            yc = ((lambda ky: jnp.maximum(ky * byb - 1, 0)) if yside < 0
-                  else (lambda ky: jnp.minimum(ky * byb + byb, nyb - 1)))
-            in_specs.append(pl.BlockSpec(
-                (2, esub, X),
-                lambda kz, ky, r=row, e=edge_k, f=yc:
-                (r, jnp.where(kz == e, f(ky), 0), 0)))
-    # corner y-slab singles
+            in_specs.append(pl.BlockSpec((1, esub, X),
+                                         corner_zslab(off, yside)))
     for off in ZOFFS:
         for _yside in (-1, 1):
             in_specs.append(pl.BlockSpec((1, esub, X), corner_yslab(off)))
 
     zlo, zhi = slabs["zlo"], slabs["zhi"]
     ylo, yhi = slabs["ylo"], slabs["yhi"]
-    inputs = [jnp.asarray(origin_zyx, jnp.int32),
-              interior,
-              interior, interior, interior, interior,
-              zlo, zlo, zhi, zhi,
-              interior, interior,
-              ylo, yhi]
-    inputs += [interior] * 8
-    inputs += [zlo, zlo, zhi, zhi]
-    inputs += [ylo, yhi] * 4
+
+    def zsrc(off):
+        return zlo if off < 0 else zhi
+
+    inputs = [jnp.asarray(origin_zyx, jnp.int32), interior]
+    inputs += [interior] * n2                      # z-in singles
+    inputs += [zsrc(o) for o in ZOFFS]             # z-slab singles
+    inputs += [interior, interior, ylo, yhi]
+    inputs += [interior] * (2 * n2)                # corner in-shard
+    inputs += [z for o in ZOFFS for z in (zsrc(o), zsrc(o))]
+    inputs += [ylo, yhi] * n2
     return pl.pallas_call(
         kern,
         grid=(nzg, nyg),
@@ -504,6 +517,24 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
             vmem_limit_bytes=64 * 1024 * 1024),
         interpret=interpret,
     )(*inputs)
+
+
+def jacobi7_halo2_pallas(interior: jnp.ndarray,
+                         slabs: Dict[str, jnp.ndarray],
+                         origin_zyx: jnp.ndarray,
+                         gsize_zyx: Tuple[int, int, int],
+                         hot_c: Tuple[int, int, int],
+                         cold_c: Tuple[int, int, int], sph_r: int,
+                         block_z: Optional[int] = None,
+                         block_y: Optional[int] = None,
+                         interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Two fused iterations per exchange — ``jacobi7_halon_pallas``
+    with steps=2. Stable named entry for kernel-level tests; the model
+    builder calls ``jacobi7_halon_pallas`` directly."""
+    return jacobi7_halon_pallas(interior, slabs, origin_zyx, gsize_zyx,
+                                hot_c, cold_c, sph_r, steps=2,
+                                block_z=block_z, block_y=block_y,
+                                interpret=interpret)
 
 
 def mhd_halo_blocks(Z: int, Y: int, block_z: int = 8,

@@ -143,17 +143,19 @@ def test_jacobi_halo_uneven_small_blocks(gzyx, mesh_shape, blocks):
     np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
 
 
-@pytest.mark.parametrize("mesh_shape,blocks", [
-    ((1, 1, 1), (4, 8)),     # nzg=4, nyg=2 on one shard (wrapped slabs)
-    ((1, 2, 2), (4, 8)),     # sharded + interior blocks both axes
-    ((1, 2, 2), (2, 8)),     # bz=2: corner z-slab blocks == whole slab
+@pytest.mark.parametrize("mesh_shape,blocks,steps", [
+    ((1, 1, 1), (4, 8), 2),   # nzg=4, nyg=2 on one shard (wrapped slabs)
+    ((1, 2, 2), (4, 8), 2),   # sharded + interior blocks both axes
+    ((1, 2, 2), (2, 8), 2),   # bz=2 == steps: thinnest legal z block
+    ((1, 2, 2), (4, 8), 3),   # depth 3: radius-3 exchange, deeper rings
+    ((1, 1, 2), (8, 8), 4),   # depth 4 on a z-split mesh
 ])
-def test_jacobi_halo_pair_multiblock(mesh_shape, blocks):
-    """The two-step pair kernel with MULTI-BLOCK grids (nzg > 1 and/or
+def test_jacobi_halo_pair_multiblock(mesh_shape, blocks, steps):
+    """The N-step halo kernel with MULTI-BLOCK grids (nzg > 1 and/or
     nyg > 1): exercises the in-shard ring singles, clamped corner maps,
     and revisit-cache slab pinning that the model-level tests (whose
     small shards collapse to one block) never select."""
-    from stencil_tpu.ops.pallas_halo import jacobi7_halo2_pallas
+    from stencil_tpu.ops.pallas_halo import jacobi7_halon_pallas
 
     gz, gy, gx = 16, 16, 30
     rng = np.random.default_rng(11)
@@ -172,9 +174,11 @@ def test_jacobi_halo_pair_multiblock(mesh_shape, blocks):
         ox, oy, oz = shard_origin(local, Dim3(0, 0, 0))
         org = jnp.stack([oz, oy, ox]).astype(jnp.int32)
         slabs = exchange_interior_slabs(p, counts, rz=bz, ry=8,
-                                        radius_rows=2, y_z_extended=True)
-        return jacobi7_halo2_pallas(p, slabs, org, (gz, gy, gx), hot,
-                                    cold, sph_r, block_z=bz, block_y=by)
+                                        radius_rows=steps,
+                                        y_z_extended=True)
+        return jacobi7_halon_pallas(p, slabs, org, (gz, gy, gx), hot,
+                                    cold, sph_r, steps=steps,
+                                    block_z=bz, block_y=by)
 
     spec = P("z", "y", "x")
     sm = jax.shard_map(shard_pair, mesh=mesh, in_specs=spec,
@@ -183,7 +187,7 @@ def test_jacobi_halo_pair_multiblock(mesh_shape, blocks):
     got = np.asarray(jax.jit(sm)(arr))
 
     want = init
-    for _ in range(2):
+    for _ in range(steps):
         want = dense_reference_step(want, hot, cold, sph_r)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
