@@ -149,6 +149,58 @@ def _one_line(e: Exception) -> str:
     return msg.replace(",", ";")
 
 
+def _watchdog_sweep(args, kernels) -> int:
+    """Run each (model, kernel) combo as a SUBPROCESS of this script
+    with a wall-clock cap: a wedged accelerator tunnel can hang a
+    Mosaic compile inside the C runtime for tens of minutes, which no
+    in-process try/except can interrupt — a hung combo must cost one
+    timeout and a FAIL line, not the whole sweep. Child stderr is
+    forwarded so campaign .err logs stay useful; returns nonzero if
+    any combo timed out or died without a result line."""
+    import subprocess
+
+    models = (("jacobi", "mhd") if args.model == "both"
+              else (args.model,))
+    env = dict(os.environ, STENCIL_BENCH_SUBPROC="1")
+    failures = 0
+    for model in models:
+        for kernel in kernels:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--model", model, "--kernels", kernel,
+                   "--dtype", args.dtype]
+            for flag, val in (("--size", args.size),
+                              ("--iters", args.iters),
+                              ("--fake-cpu", args.fake_cpu)):
+                if val:
+                    cmd += [flag, str(val)]
+            if args.blocks:
+                cmd += ["--blocks", args.blocks]
+            try:
+                out = subprocess.run(cmd, capture_output=True, text=True,
+                                     timeout=args.per_kernel_timeout,
+                                     env=env)
+            except subprocess.TimeoutExpired:
+                print(f"{model},{kernel},{args.size or '?'},TIMEOUT,"
+                      f"wall-clock cap {args.per_kernel_timeout}s "
+                      f"(compile hang?)")
+                failures += 1
+                continue
+            if out.stderr:
+                sys.stderr.write(out.stderr)
+            got_line = False
+            for line in out.stdout.splitlines():
+                if line.startswith(f"{model},"):
+                    print(line)
+                    got_line = True
+            if not got_line:
+                tail = (out.stderr or out.stdout).strip().splitlines()
+                msg = (tail[-1][:160] if tail else "no output")
+                print(f"{model},{kernel},{args.size or '?'},FAIL,"
+                      f"{msg.replace(',', ';')}")
+                failures += 1
+    return failures
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model", default="both",
@@ -163,11 +215,20 @@ def main():
                     help="jacobi field dtype (bf16 halves HBM traffic)")
     ap.add_argument("--fake-cpu", type=int, default=0, metavar="N",
                     help="run on N virtual CPU devices (smoke mode)")
+    ap.add_argument("--per-kernel-timeout", type=int, default=0,
+                    metavar="S",
+                    help="run each model/kernel combo in a subprocess "
+                         "with this wall-clock cap (0 = in-process, no "
+                         "cap); a hang then costs one TIMEOUT line, "
+                         "not the sweep")
     args = ap.parse_args()
+    kernels = args.kernels.split(",")
+    if (args.per_kernel_timeout
+            and not os.environ.get("STENCIL_BENCH_SUBPROC")):
+        sys.exit(1 if _watchdog_sweep(args, kernels) else 0)
     from stencil_tpu.utils.config import apply_fake_cpu, enable_compile_cache
     apply_fake_cpu(args.fake_cpu)
     enable_compile_cache()
-    kernels = args.kernels.split(",")
     blocks = (tuple(int(v) for v in args.blocks.split(","))
               if args.blocks else None)
 

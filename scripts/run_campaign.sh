@@ -16,8 +16,12 @@ mkdir -p "$OUT"
 echo "campaign output -> $OUT/ (smoke=$SMOKE)"
 
 FAKE=()
+# per-kernel watchdog (tunnel-hang insurance) only matters on real
+# hardware; smoke mode keeps the cheap in-process sweep
+WD=(--per-kernel-timeout 2400)
 if [ "$SMOKE" = "1" ]; then
     FAKE=(--fake-cpu 8)
+    WD=()
     JN=16; JI=4; MN=16; MI=2; EX=8; EI=2
 else
     JN=256; JI=50; MN=128; MI=10; EX=256; EI=30
@@ -40,18 +44,23 @@ if [ "$SMOKE" != "1" ]; then
 fi
 
 # 2. single-chip kernel A/B: wrap vs halo vs xla, both models
+# (per-kernel watchdog: a wedged tunnel compile costs one TIMEOUT
+# line, not the sweep)
 run kernels_default.csv python scripts/bench_kernels.py \
-    --model both --kernels wrap,halo,xla "${FAKE[@]}"
+    --model both --kernels wrap,halo,xla ${WD[@]+"${WD[@]}"} \
+    "${FAKE[@]}"
 
 # 3. block-shape sweeps at the benchmark sizes
 for b in "8,128" "16,128" "8,256" "16,64"; do
     run "kernels_jacobi_b${b/,/x}.csv" python scripts/bench_kernels.py \
         --model jacobi --kernels wrap,halo --blocks "$b" \
+        ${WD[@]+"${WD[@]}"} \
         --iters "$([ "$SMOKE" = 1 ] && echo 4 || echo 100)" "${FAKE[@]}"
 done
 for b in "8,32" "8,64" "16,32"; do
     run "kernels_mhd_b${b/,/x}.csv" python scripts/bench_kernels.py \
         --model mhd --kernels wrap,halo --blocks "$b" \
+        ${WD[@]+"${WD[@]}"} \
         --iters "$([ "$SMOKE" = 1 ] && echo 2 || echo 10)" "${FAKE[@]}"
 done
 
