@@ -179,7 +179,14 @@ def _watchdog_sweep(args, kernels) -> int:
                 out = subprocess.run(cmd, capture_output=True, text=True,
                                      timeout=args.per_kernel_timeout,
                                      env=env)
-            except subprocess.TimeoutExpired:
+            except subprocess.TimeoutExpired as e:
+                # forward whatever the child said before the kill —
+                # that partial log is the only record of the hang
+                for chunk in (e.stdout, e.stderr):
+                    if chunk:
+                        sys.stderr.write(
+                            chunk if isinstance(chunk, str)
+                            else chunk.decode(errors="replace"))
                 print(f"{model},{kernel},{args.size or '?'},TIMEOUT,"
                       f"wall-clock cap {args.per_kernel_timeout}s "
                       f"(compile hang?)")

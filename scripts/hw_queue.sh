@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Hardware measurement queue: the ordered single-chip runs that
+# validate this round's kernels, sized so each item lands a number
+# (or a watchdog TIMEOUT line) even over a slow tunnel. Run when a
+# chip is reachable; results append to hw_queue_<ts>.log in CSV form.
+# The persistent compile cache (utils/config.enable_compile_cache)
+# makes reruns cheap once an item has compiled.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="hw_queue_$(date +%Y%m%d_%H%M%S).log"
+echo "hw queue -> $OUT"
+WD=(--per-kernel-timeout 2400)
+run() { echo "== $*" | tee -a "$OUT"; "$@" 2>>"$OUT.err" | tee -a "$OUT"; }
+
+# 1. headline + wrap depth ladder (validates jacobi7_wrapn on hardware)
+run python scripts/bench_kernels.py --model jacobi --kernels wrap \
+    "${WD[@]}"
+for n in 3 4; do
+  STENCIL_WRAP_STEPS=$n run python scripts/bench_kernels.py \
+      --model jacobi --kernels wrap "${WD[@]}"
+done
+
+# 2. halo path: single-step vs pair vs depth-3 (multi-chip compute path)
+STENCIL_DISABLE_WRAP2=1 run python scripts/bench_kernels.py \
+    --model jacobi --kernels halo "${WD[@]}"
+run python scripts/bench_kernels.py --model jacobi --kernels halo \
+    "${WD[@]}"
+STENCIL_WRAP_STEPS=3 run python scripts/bench_kernels.py \
+    --model jacobi --kernels halo "${WD[@]}"
+
+# 3. bf16 wrap + halo (half-traffic ladder)
+run python scripts/bench_kernels.py --model jacobi --kernels wrap,halo \
+    --dtype bf16 "${WD[@]}"
+
+# 4. MHD wrap (thin-z + x-roll scheme) at candidate blockings
+for b in "8,64" "8,32" "16,64"; do
+  run python scripts/bench_kernels.py --model mhd --kernels wrap \
+      --blocks "$b" "${WD[@]}"
+done
+
+# 5. MHD halo (x-roll window)
+run python scripts/bench_kernels.py --model mhd --kernels halo \
+    "${WD[@]}"
+
+# 6. headline JSON
+run python bench.py
+echo "hw queue complete -> $OUT"
