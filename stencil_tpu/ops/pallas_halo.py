@@ -554,7 +554,7 @@ def mhd_halo_blocks(Z: int, Y: int, block_z: int = 8,
 
 
 def _mhd_segment_specs(Z: int, Y: int, X: int, bz: int, by: int):
-    """The 21 BlockSpecs covering one field's (bz+2R, by+2R, X)
+    """The 29 BlockSpecs covering one field's (bz+2R, by+2R, X)
     neighborhood on the slab layout. Segment grid: z in {-,0,+} x
     y in {-,0,+}; edge/corner segments carry one spec per possible
     source (in-shard / z slab / y slab) and the kernel selects by
@@ -562,10 +562,18 @@ def _mhd_segment_specs(Z: int, Y: int, X: int, bz: int, by: int):
     shard edge, and slab maps pin to a constant block when their grid
     row cannot need them (Pallas's revisit cache then skips the fetch).
 
-    Spec order (per field): main; zm_y0(in, zs); zp_y0(in, zs);
-    z0_ym(in, ys); z0_yp(in, ys); zm_ym(in, zs, ys); zm_yp(in, zs, ys);
-    zp_ym(in, zs, ys); zp_yp(in, zs, ys). Input order matches
-    ``_mhd_inputs_for_field``.
+    The full-width z-neighbor segments are SINGLE ROWS at exactly the
+    radius (z is the majormost, untiled dim), not ESUB tiles — the same
+    exact-radius trick as the wrap kernel (ops/pallas_mhd._field_specs):
+    at (8, 64) blocks this cuts the per-block read amplification from
+    ~4.5x to ~2.2x. Corner segments stay at ESUB granularity (they are
+    a small fraction of the traffic).
+
+    Spec order (per field): main; zm_y0 in-shard singles (z offsets
+    -R..-1) then slab singles; zp_y0 in-shard singles (bz..bz+R-1)
+    then slab singles; z0_ym(in, ys); z0_yp(in, ys); zm_ym(in, zs, ys);
+    zm_yp(in, zs, ys); zp_ym(in, zs, ys); zp_yp(in, zs, ys). Input
+    order matches ``_mhd_inputs_for_field``.
 
     Index-map geometry: the interior array A is (Z, Y, X); z slabs
     (bz, Y, X) with the adjacent planes at zlo[-1] / zhi[0]; y slabs
@@ -592,18 +600,27 @@ def _mhd_segment_specs(Z: int, Y: int, X: int, bz: int, by: int):
         return jnp.minimum(k * byb + byb, nyb8 - 1)
 
     main = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
-    specs = [
-        main,
-        # zm_y0: rows z in [kz*bz-8, kz*bz)
-        pl.BlockSpec((ESUB, by, X), lambda kz, ky: (clampz(kz), ky, 0)),
-        pl.BlockSpec((ESUB, by, X),
-                     lambda kz, ky: (bzb - 1,
-                                     jnp.where(kz == 0, ky, 0), 0)),
-        # zp_y0: rows z in [kz*bz+bz, +8)
-        pl.BlockSpec((ESUB, by, X), lambda kz, ky: (clampZ(kz), ky, 0)),
-        pl.BlockSpec((ESUB, by, X),
-                     lambda kz, ky: (0, jnp.where(kz == nzg - 1, ky, 0),
-                                     0)),
+    specs = [main]
+    # zm_y0: exact-radius single rows z = kz*bz + o, o in -R..-1
+    for o in range(-R, 0):
+        specs.append(pl.BlockSpec(
+            (1, by, X),
+            lambda kz, ky, o=o: (jnp.clip(kz * bz + o, 0, Z - 1), ky, 0)))
+    for o in range(-R, 0):   # zlo slab rows bz+o, fetched at kz == 0
+        specs.append(pl.BlockSpec(
+            (1, by, X),
+            lambda kz, ky, o=o: (bz + o, jnp.where(kz == 0, ky, 0), 0)))
+    # zp_y0: single rows z = kz*bz + bz + j, j in 0..R-1
+    for j in range(R):
+        specs.append(pl.BlockSpec(
+            (1, by, X),
+            lambda kz, ky, j=j: (jnp.clip(kz * bz + bz + j, 0, Z - 1),
+                                 ky, 0)))
+    for j in range(R):       # zhi slab rows j, fetched at kz == nzg-1
+        specs.append(pl.BlockSpec(
+            (1, by, X),
+            lambda kz, ky, j=j: (j, jnp.where(kz == nzg - 1, ky, 0), 0)))
+    specs += [
         # z0_ym: rows y in [ky*by-8, ky*by)
         pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz, clampy(ky), 0)),
         pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0)),
@@ -650,20 +667,20 @@ def _mhd_inputs_for_field(f, slabs):
     """Input arrays matching ``_mhd_segment_specs`` order."""
     zlo, zhi = slabs["zlo"], slabs["zhi"]
     ylo, yhi = slabs["ylo"], slabs["yhi"]
-    return [f,
-            f, zlo,          # zm_y0
-            f, zhi,          # zp_y0
-            f, ylo,          # z0_ym
-            f, yhi,          # z0_yp
-            f, zlo, ylo,     # zm_ym
-            f, zlo, yhi,     # zm_yp
-            f, zhi, ylo,     # zp_ym
-            f, zhi, yhi]     # zp_yp
+    return ([f]
+            + [f] * R + [zlo] * R      # zm_y0 singles: in-shard, slab
+            + [f] * R + [zhi] * R      # zp_y0 singles
+            + [f, ylo,                 # z0_ym
+               f, yhi,                 # z0_yp
+               f, zlo, ylo,            # zm_ym
+               f, zlo, yhi,            # zm_yp
+               f, zhi, ylo,            # zp_ym
+               f, zhi, yhi])           # zp_yp
 
 
 def _mhd_select_window(refs, nzg: int, nyg: int) -> jnp.ndarray:
     """Assemble one field's (bz+2R, by+2R, X) stencil window from
-    the 21 segment refs (order: _mhd_segment_specs), selecting slab
+    the 29 segment refs (order: _mhd_segment_specs), selecting slab
     sources at shard edges; x wraps per-derivative via pltpu.roll
     (x unsharded => in-core wrap IS the global periodic wrap)."""
     kz = pl.program_id(0)
@@ -672,11 +689,18 @@ def _mhd_select_window(refs, nzg: int, nyg: int) -> jnp.ndarray:
     at_zhi = kz == nzg - 1
     at_ylo = ky == 0
     at_yhi = ky == nyg - 1
-    (main, zm0_in, zm0_zs, zp0_in, zp0_zs, ym0_in, ym0_ys, yp0_in,
-     yp0_ys, mm_in, mm_zs, mm_ys, mp_in, mp_zs, mp_ys, pm_in, pm_zs,
-     pm_ys, pp_in, pp_zs, pp_ys) = refs
-    zm_y0 = jnp.where(at_zlo, zm0_zs[...], zm0_in[...])
-    zp_y0 = jnp.where(at_zhi, zp0_zs[...], zp0_in[...])
+    main = refs[0]
+    zm_in = refs[1:1 + R]
+    zm_zs = refs[1 + R:1 + 2 * R]
+    zp_in = refs[1 + 2 * R:1 + 3 * R]
+    zp_zs = refs[1 + 3 * R:1 + 4 * R]
+    (ym0_in, ym0_ys, yp0_in, yp0_ys, mm_in, mm_zs, mm_ys, mp_in,
+     mp_zs, mp_ys, pm_in, pm_zs, pm_ys, pp_in, pp_zs, pp_ys) = \
+        refs[1 + 4 * R:]
+    zm_rows = [jnp.where(at_zlo, zm_zs[i][...], zm_in[i][...])
+               for i in range(R)]
+    zp_rows = [jnp.where(at_zhi, zp_zs[i][...], zp_in[i][...])
+               for i in range(R)]
     z0_ym = jnp.where(at_ylo, ym0_ys[...], ym0_in[...])
     z0_yp = jnp.where(at_yhi, yp0_ys[...], yp0_in[...])
     # corners: the y slab is z-extended, so a y-edge corner always
@@ -691,13 +715,22 @@ def _mhd_select_window(refs, nzg: int, nyg: int) -> jnp.ndarray:
     zp_yp = jnp.where(at_yhi, pp_ys[...],
                       jnp.where(at_zhi, pp_zs[...], pp_in[...]))
     c = main[...]
+    # corner blocks are ESUB rows; the zm rows sit at block rows
+    # ESUB-R+i, the zp rows at block rows i (matching the old tiled
+    # layout the corners still use)
     rows = [
-        jnp.concatenate([zm_ym[ESUB - R:, ESUB - R:], zm_y0[ESUB - R:, :],
-                         zm_yp[ESUB - R:, :R]], axis=1),
-        jnp.concatenate([z0_ym[:, ESUB - R:], c, z0_yp[:, :R]], axis=1),
-        jnp.concatenate([zp_ym[:R, ESUB - R:], zp_y0[:R, :],
-                         zp_yp[:R, :R]], axis=1),
+        jnp.concatenate([zm_ym[ESUB - R + i:ESUB - R + i + 1, ESUB - R:],
+                         zm_rows[i],
+                         zm_yp[ESUB - R + i:ESUB - R + i + 1, :R]],
+                        axis=1)
+        for i in range(R)
     ]
+    rows.append(
+        jnp.concatenate([z0_ym[:, ESUB - R:], c, z0_yp[:, :R]], axis=1))
+    rows.extend(
+        jnp.concatenate([zp_ym[i:i + 1, ESUB - R:], zp_rows[i],
+                         zp_yp[i:i + 1, :R]], axis=1)
+        for i in range(R))
     # x stays at full (unsharded, periodic) width: the per-derivative
     # pltpu.roll wrap (FieldData x_wrap) replaces the lane-misaligned
     # X+2R window, matching the wrap kernel (ops/pallas_mhd.py)
@@ -745,7 +778,8 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     interior = Dim3(X, by, bz)
     nzg = Z // bz
     nyg = Y // by
-    nseg = 21
+    field_specs = _mhd_segment_specs(Z, Y, X, bz, by)
+    nseg = len(field_specs)    # 17 + 4*R; kern slicing derives from it
     nf = len(FIELDS)
 
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
@@ -771,7 +805,7 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     in_specs = []
     inputs = []
     for q in FIELDS:
-        in_specs.extend(_mhd_segment_specs(Z, Y, X, bz, by))
+        in_specs.extend(field_specs)
         inputs.extend(_mhd_inputs_for_field(fields[q], slabs[q]))
     for q in FIELDS:
         in_specs.append(main_spec)
