@@ -28,6 +28,31 @@ def apply_device_flags(args) -> None:
     enable_compile_cache()
 
 
+def add_dtype_flags(p: argparse.ArgumentParser) -> None:
+    """--f64 / --bf16 (the reference's float/double templating analog;
+    bf16 is the TPU-native half-traffic option)."""
+    p.add_argument("--f64", action="store_true")
+    p.add_argument("--bf16", action="store_true",
+                   help="bfloat16 fields: half the HBM traffic on the "
+                        "bandwidth-bound fused kernels")
+
+
+def dtype_from_args(args):
+    """Resolve the field dtype; must run after apply_device_flags
+    (x64 needs the config update before first use)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if getattr(args, "f64", False):
+        jax.config.update("jax_enable_x64", True)
+        return np.float64
+    return jnp.bfloat16 if getattr(args, "bf16", False) else np.float32
+
+
+KERNEL_CHOICES = ("auto", "wrap", "halo", "xla", "pallas")
+
+
 def add_method_flags(p: argparse.ArgumentParser) -> None:
     """The analog of the reference's per-method CLI flags
     (reference: bin/jacobi3d.cu:107-122 --staged/--colo/--peer/--kernel)."""

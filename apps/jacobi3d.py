@@ -11,10 +11,12 @@ bin/jacobi3d.cu:181-205); CSV result line
 import argparse
 import os
 
-from _common import (add_dcn_flags, add_device_flags, apply_device_flags,
-                     add_method_flags, add_placement_flags, csv_line,
-                     dcn_from_args, dcn_mesh_shape, methods_from_args,
-                     placement_from_args, timed_samples)
+from _common import (KERNEL_CHOICES, add_dcn_flags, add_device_flags,
+                     add_dtype_flags, add_method_flags,
+                     add_placement_flags, apply_device_flags, csv_line,
+                     dcn_from_args, dcn_mesh_shape, dtype_from_args,
+                     methods_from_args, placement_from_args,
+                     timed_samples)
 
 
 def main() -> None:
@@ -29,18 +31,12 @@ def main() -> None:
     ap.add_argument("--paraview", action="store_true")
     ap.add_argument("--period", type=int, default=0,
                     help="paraview dump every N samples")
-    ap.add_argument("--f64", action="store_true")
-    ap.add_argument("--bf16", action="store_true",
-                    help="bfloat16 fields: half the HBM traffic on the "
-                         "bandwidth-bound fused kernels (the TPU-native "
-                         "analog of the reference's float/double "
-                         "templating, bin/jacobi3d.cu:40-85)")
+    add_dtype_flags(ap)
     ap.add_argument("--wrap-steps", type=int, default=0, metavar="N",
-                    help="temporal-blocking depth for the single-chip "
-                         "wrap path (N fused iterations per HBM pass; "
-                         "default 2)")
-    ap.add_argument("--kernel", default="auto",
-                    choices=("auto", "wrap", "halo", "xla", "pallas"),
+                    help="temporal-blocking depth for the fused wrap "
+                         "and halo paths (N fused iterations per HBM "
+                         "pass / exchange; default 2)")
+    ap.add_argument("--kernel", default="auto", choices=KERNEL_CHOICES,
                     help="compute path: fused Pallas (wrap: single-chip "
                          "periodic; halo: multi-chip slab layout), XLA "
                          "slicing (xla), padded-layout Pallas (pallas), "
@@ -51,12 +47,9 @@ def main() -> None:
     add_device_flags(ap)
     args = ap.parse_args()
     apply_device_flags(args)
-    if getattr(args, 'f64', False):
-        import jax
-        jax.config.update('jax_enable_x64', True)
+    dtype = dtype_from_args(args)
 
     import jax
-    import numpy as np
 
     from stencil_tpu.models.jacobi import Jacobi3D
     from stencil_tpu.ops.pallas_stencil import on_tpu
@@ -75,11 +68,8 @@ def main() -> None:
     gx, gy, gz = (args.x * mesh_shape.x, args.y * mesh_shape.y,
                   args.z * mesh_shape.z)
     methods = methods_from_args(args)
-    import jax.numpy as jnp
     if args.wrap_steps:
         os.environ["STENCIL_WRAP_STEPS"] = str(args.wrap_steps)
-    dtype = (np.float64 if args.f64
-             else jnp.bfloat16 if args.bf16 else np.float32)
     j = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape,
                  dtype=dtype,
                  methods=methods,

@@ -4,8 +4,9 @@
 
 import argparse
 
-from _common import (add_device_flags, apply_device_flags,
-                     add_method_flags, add_placement_flags, csv_line,
+from _common import (KERNEL_CHOICES, add_device_flags, add_dtype_flags,
+                     apply_device_flags, add_method_flags,
+                     add_placement_flags, csv_line, dtype_from_args,
                      methods_from_args, placement_from_args, timed_samples)
 
 
@@ -16,26 +17,24 @@ def main() -> None:
     ap.add_argument("--z", type=int, default=512)
     ap.add_argument("--iters", "-n", type=int, default=30)
     ap.add_argument("--batch", type=int, default=10)
-    ap.add_argument("--f64", action="store_true")
+    add_dtype_flags(ap)
+    ap.add_argument("--kernel", default="auto", choices=KERNEL_CHOICES)
     add_method_flags(ap)
     add_placement_flags(ap)
     add_device_flags(ap)
     args = ap.parse_args()
     apply_device_flags(args)
-    if getattr(args, 'f64', False):
-        import jax
-        jax.config.update('jax_enable_x64', True)
+    dtype = dtype_from_args(args)
 
     import jax
-    import numpy as np
 
     from stencil_tpu.models.jacobi import Jacobi3D
 
     ndev = len(jax.devices())
     methods = methods_from_args(args)
     j = Jacobi3D(args.x, args.y, args.z,
-                 dtype=np.float64 if args.f64 else np.float32,
-                 methods=methods,
+                 dtype=dtype,
+                 methods=methods, kernel=args.kernel,
                  placement=placement_from_args(args))
     j.init()
     samples = max(args.iters // args.batch, 1)
