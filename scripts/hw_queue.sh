@@ -32,11 +32,14 @@ STENCIL_WRAP_STEPS=3 run python scripts/bench_kernels.py \
 run python scripts/bench_kernels.py --model jacobi --kernels wrap,halo \
     --dtype bf16 "${WD[@]}"
 
-# 4. MHD wrap (thin-z + x-roll scheme) at candidate blockings
+# 4. MHD wrap (thin-z + x-roll scheme) at candidate blockings,
+#    plus the round-3 tiled-z layout as the A/B control
 for b in "8,64" "8,32" "16,64"; do
   run python scripts/bench_kernels.py --model mhd --kernels wrap \
       --blocks "$b" "${WD[@]}"
 done
+STENCIL_MHD_THINZ=0 run python scripts/bench_kernels.py --model mhd \
+    --kernels wrap --blocks "8,32" "${WD[@]}"
 
 # 5. MHD halo (x-roll window)
 run python scripts/bench_kernels.py --model mhd --kernels halo \

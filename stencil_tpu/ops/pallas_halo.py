@@ -564,7 +564,7 @@ def _mhd_segment_specs(Z: int, Y: int, X: int, bz: int, by: int):
 
     The full-width z-neighbor segments are SINGLE ROWS at exactly the
     radius (z is the majormost, untiled dim), not ESUB tiles — the same
-    exact-radius trick as the wrap kernel (ops/pallas_mhd._field_specs):
+    exact-radius trick as the wrap kernel (ops/pallas_mhd._window_plan):
     at (8, 64) blocks this cuts the per-block read amplification from
     ~4.5x to ~2.2x. Corner segments stay at ESUB granularity (they are
     a small fraction of the traffic).

@@ -35,33 +35,59 @@ R = 3          # stencil radius (6th order)
 ESUB = 8       # edge-slab sublane tile (f32)
 
 
-# z window segments: R single rows below, the main bz-row block, R
-# single rows above. z is the majormost (untiled) dim, so single-row
-# blocks are legal and fetch EXACTLY the radius — unlike y, whose
-# sublane tiling forces ESUB-row slabs.
-_ZSEGS = (-3, -2, -1, 0, 1, 2, 3)
+def _thin_z() -> bool:
+    """STENCIL_MHD_THINZ=0 restores the tiled (ESUB-row) z-neighbor
+    segments — the hardware-proven round-3 layout — for A/B runs; the
+    default is the exact-radius single-row scheme (see _window_plan)."""
+    import os
+
+    return os.environ.get("STENCIL_MHD_THINZ", "1").lower() not in (
+        "0", "false", "no")
+
+
 _YSEGS = (-1, 0, 1)
 
 
-def _field_specs(Z: int, Y: int, X: int, bz: int, by: int):
-    """21 BlockSpecs covering one field's (bz+6, by+6, X) neighborhood:
-    7 z segments (3 wrapped single rows below, main, 3 above — exact-
-    radius fetches, since the major dim has no tile granularity) x 3 y
-    segments (preceding ESUB-slab, main, following ESUB-slab), all
-    periodic via wrapped index maps. Read amplification per block is
-    (1 + 2R/bz) * (1 + 2*ESUB/by) — the single-row z fetches are what
-    keep the first factor at 2R rather than 2*ESUB."""
+def _window_plan(Z: int, Y: int, X: int, bz: int, by: int):
+    """(specs, assemble) for one field's (bz+6, by+6, X) neighborhood,
+    periodic via wrapped index maps; x is NOT extended (buffers stay
+    lane-aligned at X; periodic x shifts happen per-derivative via
+    ``pltpu.roll`` — the FieldData ``x_wrap`` mode).
+
+    Default (thin-z) plan: 7 z segments (3 wrapped single rows below,
+    the main bz-row block, 3 above — exact-radius fetches, since the
+    majormost dim has no tile granularity) x 3 y segments (preceding
+    ESUB-slab, main, following ESUB-slab) = 21 specs; per-block read
+    amplification (1 + 2R/bz) * (1 + 2*ESUB/by).
+
+    STENCIL_MHD_THINZ=0 plan: 3 z segments (ESUB-row tile below, main,
+    ESUB-row tile above) x 3 y segments = 9 specs; amplification
+    (1 + 2*ESUB/bz) * (1 + 2*ESUB/by) — more traffic, but fewer/fatter
+    DMAs (the round-3 layout, kept for hardware A/B).
+    """
     nyb = Y // ESUB
     byb = by // ESUB
+    thin = _thin_z()
+    if thin:
+        zsegs = (-3, -2, -1, 0, 1, 2, 3)
+    else:
+        assert bz % ESUB == 0 and Z % ESUB == 0, (Z, bz)
+        zsegs = (-1, 0, 1)
+        bzb = bz // ESUB
+        nzb = Z // ESUB
 
     def zy(zseg: int, yseg: int):
         if zseg == 0:
             zshape, zidx = bz, (lambda kz: kz)
-        else:
+        elif thin:
             # single wrapped row at element offset kz*bz + zseg (below)
             # or kz*bz + bz + zseg - 1 (above); block units == elements
             off = zseg if zseg < 0 else bz + zseg - 1
             zshape, zidx = 1, (lambda kz, o=off: (kz * bz + o) % Z)
+        elif zseg < 0:
+            zshape, zidx = ESUB, (lambda kz: (kz * bzb - 1) % nzb)
+        else:
+            zshape, zidx = ESUB, (lambda kz: (kz * bzb + bzb) % nzb)
         if yseg == 0:
             yshape, yidx = by, (lambda ky: ky)
         elif yseg < 0:
@@ -73,22 +99,26 @@ def _field_specs(Z: int, Y: int, X: int, bz: int, by: int):
             functools.partial(lambda kz, ky, zf, yf: (zf(kz), yf(ky), 0),
                               zf=zidx, yf=yidx))
 
-    return [zy(zs, ys) for zs in _ZSEGS for ys in _YSEGS]
+    specs = [zy(zs, ys) for zs in zsegs for ys in _YSEGS]
 
+    def assemble(refs) -> jnp.ndarray:
+        """(bz+6, by+6, X) periodic window from the segment refs
+        (z segments outer, y in _YSEGS inner)."""
+        rows = []
+        for zi, zs in enumerate(zsegs):
+            ym, y0, yp = refs[3 * zi:3 * zi + 3]
+            if thin or zs == 0:
+                zslice = slice(None)
+            elif zs < 0:          # tiled: last R rows of the ESUB tile
+                zslice = slice(ESUB - R, None)
+            else:                 # tiled: first R rows
+                zslice = slice(None, R)
+            rows.append(jnp.concatenate(
+                [ym[zslice, ESUB - R:], y0[zslice], yp[zslice, :R]],
+                axis=1))
+        return jnp.concatenate(rows, axis=0)
 
-def _assemble_window(refs) -> jnp.ndarray:
-    """(bz+6, by+6, X) periodic window from the 21 segment refs
-    (ordered as _field_specs: z in _ZSEGS outer, y in _YSEGS inner).
-    x is NOT extended: every buffer stays lane-aligned at X and the
-    periodic x shifts happen per-derivative via ``pltpu.roll`` (the
-    FieldData ``x_wrap`` mode) — an X+2R window would make every x
-    slice a lane-misaligned copy."""
-    rows = []
-    for zi in range(len(_ZSEGS)):
-        ym, y0, yp = refs[3 * zi:3 * zi + 3]
-        rows.append(jnp.concatenate(
-            [ym[:, ESUB - R:], y0[...], yp[:, :R]], axis=1))
-    return jnp.concatenate(rows, axis=0)
+    return specs, assemble
 
 
 def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
@@ -128,16 +158,17 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
 
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
     nf = len(FIELDS)
+    field_specs, assemble = _window_plan(Z, Y, X, bz, by)
+    nseg = len(field_specs)
 
     def kern(*refs):
-        nseg = len(_ZSEGS) * len(_YSEGS)
         field_refs = refs[:nseg * nf]
         w_refs = refs[nseg * nf:nseg * nf + nf]
         out_f = refs[nseg * nf + nf:nseg * nf + 2 * nf]
         out_w = refs[nseg * nf + 2 * nf:nseg * nf + 3 * nf]
         data = {}
         for i, q in enumerate(FIELDS):
-            win = _assemble_window(field_refs[nseg * i:nseg * (i + 1)])
+            win = assemble(field_refs[nseg * i:nseg * (i + 1)])
             data[q] = FieldData(win, inv_ds, pad_lo, interior,
                                 x_wrap=True)
         rates = mhd_rates(data, prm, dtype)
@@ -150,8 +181,8 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     in_specs = []
     inputs = []
     for q in FIELDS:
-        in_specs.extend(_field_specs(Z, Y, X, bz, by))
-        inputs.extend([fields[q]] * (len(_ZSEGS) * len(_YSEGS)))
+        in_specs.extend(field_specs)
+        inputs.extend([fields[q]] * nseg)
     for q in FIELDS:
         in_specs.append(main_spec)
         inputs.append(w[q])

@@ -51,9 +51,13 @@ class TestDistributedParity:
 
 
 class TestStability:
-    def test_wrap_megakernel_matches_xla(self):
+    @pytest.mark.parametrize("thinz", ["1", "0"])
+    def test_wrap_megakernel_matches_xla(self, thinz, monkeypatch):
         """The fused Pallas substep megakernel (ops/pallas_mhd.py,
-        single-chip fast path) against the slicing formulation."""
+        single-chip fast path) against the slicing formulation — under
+        BOTH window plans (exact-radius thin-z default and the
+        STENCIL_MHD_THINZ=0 tiled-z A/B control)."""
+        monkeypatch.setenv("STENCIL_MHD_THINZ", thinz)
         size = (16, 16, 16)
         a = Astaroth(*size, mesh_shape=(1, 1, 1), dtype=np.float64,
                      devices=jax.devices()[:1], kernel="xla")
