@@ -41,9 +41,11 @@ done
 STENCIL_MHD_THINZ=0 run python scripts/bench_kernels.py --model mhd \
     --kernels wrap --blocks "8,32" "${WD[@]}"
 
-# 5. MHD halo (x-roll window)
+# 5. MHD halo (x-roll window), thin-z default + tiled-z control
 run python scripts/bench_kernels.py --model mhd --kernels halo \
     "${WD[@]}"
+STENCIL_MHD_THINZ=0 run python scripts/bench_kernels.py --model mhd \
+    --kernels halo "${WD[@]}"
 
 # 6. headline JSON
 run python bench.py

@@ -553,33 +553,38 @@ def mhd_halo_blocks(Z: int, Y: int, block_z: int = 8,
     return bz, by
 
 
-def _mhd_segment_specs(Z: int, Y: int, X: int, bz: int, by: int):
-    """The 29 BlockSpecs covering one field's (bz+2R, by+2R, X)
-    neighborhood on the slab layout. Segment grid: z in {-,0,+} x
-    y in {-,0,+}; edge/corner segments carry one spec per possible
-    source (in-shard / z slab / y slab) and the kernel selects by
-    ``program_id`` — clamped in-shard maps load an unused block at the
-    shard edge, and slab maps pin to a constant block when their grid
-    row cannot need them (Pallas's revisit cache then skips the fetch).
+def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int):
+    """One closed unit (specs, inputs_for_field, select_window) for the
+    MHD halo kernel's per-field stencil neighborhood on the slab
+    layout — the spec list, the matching input ordering, and the
+    in-kernel window assembly share one layout decision, so they
+    cannot desynchronize (the positional ref-slicing contract lives
+    only here). Mirrors ops/pallas_mhd._window_plan for the wrap
+    kernel.
 
-    The full-width z-neighbor segments are SINGLE ROWS at exactly the
-    radius (z is the majormost, untiled dim), not ESUB tiles — the same
-    exact-radius trick as the wrap kernel (ops/pallas_mhd._window_plan):
-    at (8, 64) blocks this cuts the per-block read amplification from
-    ~4.5x to ~2.2x. Corner segments stay at ESUB granularity (they are
-    a small fraction of the traffic).
+    Segment grid: z in {-,0,+} x y in {-,0,+}; edge/corner segments
+    carry one spec per possible source (in-shard / z slab / y slab)
+    and the kernel selects by ``program_id`` — clamped in-shard maps
+    load an unused block at the shard edge, and slab maps pin to a
+    constant block when their grid row cannot need them (Pallas's
+    revisit cache then skips the fetch).
 
-    Spec order (per field): main; zm_y0 in-shard singles (z offsets
-    -R..-1) then slab singles; zp_y0 in-shard singles (bz..bz+R-1)
-    then slab singles; z0_ym(in, ys); z0_yp(in, ys); zm_ym(in, zs, ys);
-    zm_yp(in, zs, ys); zp_ym(in, zs, ys); zp_yp(in, zs, ys). Input
-    order matches ``_mhd_inputs_for_field``.
+    Default (thin-z, 29 specs/field): the full-width z-neighbor
+    segments are SINGLE ROWS at exactly the radius (z is the majormost,
+    untiled dim) — at (8, 64) blocks this cuts per-block read
+    amplification from ~4.5x to ~2.2x. STENCIL_MHD_THINZ=0 (tiled, 21
+    specs/field) restores ESUB-row z tiles (the round-3
+    hardware-measured layout, kept for A/B). Corner segments always
+    stay at ESUB granularity (a small fraction of the traffic).
 
     Index-map geometry: the interior array A is (Z, Y, X); z slabs
     (bz, Y, X) with the adjacent planes at zlo[-1] / zhi[0]; y slabs
     (Z + 2*bz, ry=ESUB, X), z origin at -bz (z-extended so yz corner
     data rides along).
     """
+    from .pallas_mhd import _thin_z
+
+    thin = _thin_z()
     bzb = bz // ESUB
     byb = by // ESUB
     nzb8 = Z // ESUB
@@ -601,25 +606,42 @@ def _mhd_segment_specs(Z: int, Y: int, X: int, bz: int, by: int):
 
     main = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
     specs = [main]
-    # zm_y0: exact-radius single rows z = kz*bz + o, o in -R..-1
-    for o in range(-R, 0):
-        specs.append(pl.BlockSpec(
-            (1, by, X),
-            lambda kz, ky, o=o: (jnp.clip(kz * bz + o, 0, Z - 1), ky, 0)))
-    for o in range(-R, 0):   # zlo slab rows bz+o, fetched at kz == 0
-        specs.append(pl.BlockSpec(
-            (1, by, X),
-            lambda kz, ky, o=o: (bz + o, jnp.where(kz == 0, ky, 0), 0)))
-    # zp_y0: single rows z = kz*bz + bz + j, j in 0..R-1
-    for j in range(R):
-        specs.append(pl.BlockSpec(
-            (1, by, X),
-            lambda kz, ky, j=j: (jnp.clip(kz * bz + bz + j, 0, Z - 1),
-                                 ky, 0)))
-    for j in range(R):       # zhi slab rows j, fetched at kz == nzg-1
-        specs.append(pl.BlockSpec(
-            (1, by, X),
-            lambda kz, ky, j=j: (j, jnp.where(kz == nzg - 1, ky, 0), 0)))
+    if thin:
+        # zm_y0: exact-radius single rows z = kz*bz + o, o in -R..-1
+        for o in range(-R, 0):
+            specs.append(pl.BlockSpec(
+                (1, by, X),
+                lambda kz, ky, o=o: (jnp.clip(kz * bz + o, 0, Z - 1),
+                                     ky, 0)))
+        for o in range(-R, 0):  # zlo slab rows bz+o, fetched at kz == 0
+            specs.append(pl.BlockSpec(
+                (1, by, X),
+                lambda kz, ky, o=o: (bz + o, jnp.where(kz == 0, ky, 0),
+                                     0)))
+        # zp_y0: single rows z = kz*bz + bz + j, j in 0..R-1
+        for j in range(R):
+            specs.append(pl.BlockSpec(
+                (1, by, X),
+                lambda kz, ky, j=j: (jnp.clip(kz * bz + bz + j, 0, Z - 1),
+                                     ky, 0)))
+        for j in range(R):      # zhi slab rows j, fetched at kz == nzg-1
+            specs.append(pl.BlockSpec(
+                (1, by, X),
+                lambda kz, ky, j=j: (j, jnp.where(kz == nzg - 1, ky, 0),
+                                     0)))
+    else:
+        specs += [
+            pl.BlockSpec((ESUB, by, X),
+                         lambda kz, ky: (clampz(kz), ky, 0)),
+            pl.BlockSpec((ESUB, by, X),
+                         lambda kz, ky: (bzb - 1,
+                                         jnp.where(kz == 0, ky, 0), 0)),
+            pl.BlockSpec((ESUB, by, X),
+                         lambda kz, ky: (clampZ(kz), ky, 0)),
+            pl.BlockSpec((ESUB, by, X),
+                         lambda kz, ky: (0, jnp.where(kz == nzg - 1,
+                                                      ky, 0), 0)),
+        ]
     specs += [
         # z0_ym: rows y in [ky*by-8, ky*by)
         pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz, clampy(ky), 0)),
@@ -660,81 +682,93 @@ def _mhd_segment_specs(Z: int, Y: int, X: int, bz: int, by: int):
         pl.BlockSpec((ESUB, ESUB, X),
                      lambda kz, ky: ((kz + 2) * bzb, 0, 0)),
     ]
-    return specs
 
+    def inputs_for_field(f, slabs):
+        """Input arrays matching ``specs`` order."""
+        zlo, zhi = slabs["zlo"], slabs["zhi"]
+        ylo, yhi = slabs["ylo"], slabs["yhi"]
+        if thin:
+            zmid = [f] * R + [zlo] * R + [f] * R + [zhi] * R
+        else:
+            zmid = [f, zlo, f, zhi]    # tiled ESUB z segments
+        return ([f] + zmid
+                + [f, ylo,             # z0_ym
+                   f, yhi,             # z0_yp
+                   f, zlo, ylo,        # zm_ym
+                   f, zlo, yhi,        # zm_yp
+                   f, zhi, ylo,        # zp_ym
+                   f, zhi, yhi])       # zp_yp
 
-def _mhd_inputs_for_field(f, slabs):
-    """Input arrays matching ``_mhd_segment_specs`` order."""
-    zlo, zhi = slabs["zlo"], slabs["zhi"]
-    ylo, yhi = slabs["ylo"], slabs["yhi"]
-    return ([f]
-            + [f] * R + [zlo] * R      # zm_y0 singles: in-shard, slab
-            + [f] * R + [zhi] * R      # zp_y0 singles
-            + [f, ylo,                 # z0_ym
-               f, yhi,                 # z0_yp
-               f, zlo, ylo,            # zm_ym
-               f, zlo, yhi,            # zm_yp
-               f, zhi, ylo,            # zp_ym
-               f, zhi, yhi])           # zp_yp
+    def select_window(refs) -> jnp.ndarray:
+        """Assemble one field's (bz+2R, by+2R, X) stencil window from
+        the segment refs, selecting slab sources at shard edges;
+        x wraps per-derivative via pltpu.roll (x unsharded => in-core
+        wrap IS the global periodic wrap)."""
+        kz = pl.program_id(0)
+        ky = pl.program_id(1)
+        at_zlo = kz == 0
+        at_zhi = kz == nzg - 1
+        at_ylo = ky == 0
+        at_yhi = ky == nyg - 1
+        main = refs[0]
+        if thin:
+            zm_in = refs[1:1 + R]
+            zm_zs = refs[1 + R:1 + 2 * R]
+            zp_in = refs[1 + 2 * R:1 + 3 * R]
+            zp_zs = refs[1 + 3 * R:1 + 4 * R]
+            rest = refs[1 + 4 * R:]
+            zm_rows = [jnp.where(at_zlo, zm_zs[i][...], zm_in[i][...])
+                       for i in range(R)]
+            zp_rows = [jnp.where(at_zhi, zp_zs[i][...], zp_in[i][...])
+                       for i in range(R)]
+        else:
+            zm0_in, zm0_zs, zp0_in, zp0_zs = refs[1:5]
+            rest = refs[5:]
+            # tiled ESUB blocks: the adjacent R rows sit at the tile
+            # end (zm) / start (zp)
+            zm_y0 = jnp.where(at_zlo, zm0_zs[...], zm0_in[...])
+            zp_y0 = jnp.where(at_zhi, zp0_zs[...], zp0_in[...])
+            zm_rows = [zm_y0[ESUB - R + i:ESUB - R + i + 1]
+                       for i in range(R)]
+            zp_rows = [zp_y0[i:i + 1] for i in range(R)]
+        (ym0_in, ym0_ys, yp0_in, yp0_ys, mm_in, mm_zs, mm_ys, mp_in,
+         mp_zs, mp_ys, pm_in, pm_zs, pm_ys, pp_in, pp_zs, pp_ys) = rest
+        z0_ym = jnp.where(at_ylo, ym0_ys[...], ym0_in[...])
+        z0_yp = jnp.where(at_yhi, yp0_ys[...], yp0_in[...])
+        # corners: the y slab is z-extended, so a y-edge corner always
+        # comes from it (covering simultaneous z edges); otherwise the
+        # z slab covers z-edge corners at interior y
+        zm_ym = jnp.where(at_ylo, mm_ys[...],
+                          jnp.where(at_zlo, mm_zs[...], mm_in[...]))
+        zm_yp = jnp.where(at_yhi, mp_ys[...],
+                          jnp.where(at_zlo, mp_zs[...], mp_in[...]))
+        zp_ym = jnp.where(at_ylo, pm_ys[...],
+                          jnp.where(at_zhi, pm_zs[...], pm_in[...]))
+        zp_yp = jnp.where(at_yhi, pp_ys[...],
+                          jnp.where(at_zhi, pp_zs[...], pp_in[...]))
+        c = main[...]
+        # corner blocks are ESUB rows; the zm rows sit at block rows
+        # ESUB-R+i, the zp rows at block rows i
+        rows = [
+            jnp.concatenate(
+                [zm_ym[ESUB - R + i:ESUB - R + i + 1, ESUB - R:],
+                 zm_rows[i],
+                 zm_yp[ESUB - R + i:ESUB - R + i + 1, :R]], axis=1)
+            for i in range(R)
+        ]
+        rows.append(
+            jnp.concatenate([z0_ym[:, ESUB - R:], c, z0_yp[:, :R]],
+                            axis=1))
+        rows.extend(
+            jnp.concatenate([zp_ym[i:i + 1, ESUB - R:], zp_rows[i],
+                             zp_yp[i:i + 1, :R]], axis=1)
+            for i in range(R))
+        # x stays at full (unsharded, periodic) width: the per-
+        # derivative pltpu.roll wrap (FieldData x_wrap) replaces the
+        # lane-misaligned X+2R window, matching the wrap kernel
+        return jnp.concatenate(rows, axis=0)
 
-
-def _mhd_select_window(refs, nzg: int, nyg: int) -> jnp.ndarray:
-    """Assemble one field's (bz+2R, by+2R, X) stencil window from
-    the 29 segment refs (order: _mhd_segment_specs), selecting slab
-    sources at shard edges; x wraps per-derivative via pltpu.roll
-    (x unsharded => in-core wrap IS the global periodic wrap)."""
-    kz = pl.program_id(0)
-    ky = pl.program_id(1)
-    at_zlo = kz == 0
-    at_zhi = kz == nzg - 1
-    at_ylo = ky == 0
-    at_yhi = ky == nyg - 1
-    main = refs[0]
-    zm_in = refs[1:1 + R]
-    zm_zs = refs[1 + R:1 + 2 * R]
-    zp_in = refs[1 + 2 * R:1 + 3 * R]
-    zp_zs = refs[1 + 3 * R:1 + 4 * R]
-    (ym0_in, ym0_ys, yp0_in, yp0_ys, mm_in, mm_zs, mm_ys, mp_in,
-     mp_zs, mp_ys, pm_in, pm_zs, pm_ys, pp_in, pp_zs, pp_ys) = \
-        refs[1 + 4 * R:]
-    zm_rows = [jnp.where(at_zlo, zm_zs[i][...], zm_in[i][...])
-               for i in range(R)]
-    zp_rows = [jnp.where(at_zhi, zp_zs[i][...], zp_in[i][...])
-               for i in range(R)]
-    z0_ym = jnp.where(at_ylo, ym0_ys[...], ym0_in[...])
-    z0_yp = jnp.where(at_yhi, yp0_ys[...], yp0_in[...])
-    # corners: the y slab is z-extended, so a y-edge corner always
-    # comes from it (covering simultaneous z edges); otherwise the z
-    # slab covers z-edge corners at interior y
-    zm_ym = jnp.where(at_ylo, mm_ys[...],
-                      jnp.where(at_zlo, mm_zs[...], mm_in[...]))
-    zm_yp = jnp.where(at_yhi, mp_ys[...],
-                      jnp.where(at_zlo, mp_zs[...], mp_in[...]))
-    zp_ym = jnp.where(at_ylo, pm_ys[...],
-                      jnp.where(at_zhi, pm_zs[...], pm_in[...]))
-    zp_yp = jnp.where(at_yhi, pp_ys[...],
-                      jnp.where(at_zhi, pp_zs[...], pp_in[...]))
-    c = main[...]
-    # corner blocks are ESUB rows; the zm rows sit at block rows
-    # ESUB-R+i, the zp rows at block rows i (matching the old tiled
-    # layout the corners still use)
-    rows = [
-        jnp.concatenate([zm_ym[ESUB - R + i:ESUB - R + i + 1, ESUB - R:],
-                         zm_rows[i],
-                         zm_yp[ESUB - R + i:ESUB - R + i + 1, :R]],
-                        axis=1)
-        for i in range(R)
-    ]
-    rows.append(
-        jnp.concatenate([z0_ym[:, ESUB - R:], c, z0_yp[:, :R]], axis=1))
-    rows.extend(
-        jnp.concatenate([zp_ym[i:i + 1, ESUB - R:], zp_rows[i],
-                         zp_yp[i:i + 1, :R]], axis=1)
-        for i in range(R))
-    # x stays at full (unsharded, periodic) width: the per-derivative
-    # pltpu.roll wrap (FieldData x_wrap) replaces the lane-misaligned
-    # X+2R window, matching the wrap kernel (ops/pallas_mhd.py)
-    return jnp.concatenate(rows, axis=0)
+    return specs, inputs_for_field, select_window
 
 
 def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
@@ -778,8 +812,9 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     interior = Dim3(X, by, bz)
     nzg = Z // bz
     nyg = Y // by
-    field_specs = _mhd_segment_specs(Z, Y, X, bz, by)
-    nseg = len(field_specs)    # 17 + 4*R; kern slicing derives from it
+    field_specs, inputs_for_field, select_window = _mhd_window_plan(
+        Z, Y, X, bz, by)
+    nseg = len(field_specs)    # layout-dependent; kern slicing derives from it
     nf = len(FIELDS)
 
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
@@ -791,8 +826,7 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
         out_w = refs[nseg * nf + 2 * nf:]
         data = {}
         for i, q in enumerate(FIELDS):
-            win = _mhd_select_window(field_refs[nseg * i:nseg * (i + 1)],
-                                     nzg, nyg)
+            win = select_window(field_refs[nseg * i:nseg * (i + 1)])
             data[q] = FieldData(win, inv_ds, pad_lo, interior,
                                 x_wrap=True)
         rates = mhd_rates(data, prm, dtype)
@@ -806,7 +840,7 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     inputs = []
     for q in FIELDS:
         in_specs.extend(field_specs)
-        inputs.extend(_mhd_inputs_for_field(fields[q], slabs[q]))
+        inputs.extend(inputs_for_field(fields[q], slabs[q]))
     for q in FIELDS:
         in_specs.append(main_spec)
         inputs.append(w[q])

@@ -216,10 +216,15 @@ class TestAstarothHalo:
     """MHD halo megakernel (mhd_substep_halo_pallas) parity and the
     interior-resident state protocol."""
 
-    @pytest.mark.parametrize("mesh_shape", [(1, 2, 4), (1, 1, 1)])
-    def test_halo_matches_xla(self, mesh_shape):
+    @pytest.mark.parametrize("mesh_shape,thinz", [
+        ((1, 2, 4), "1"), ((1, 1, 1), "1"),
+        # tiled-z control: the (1,1,1) case has nzg=4, exercising the
+        # tiled IN-SHARD z segments that edge-only shards never select
+        ((1, 2, 4), "0"), ((1, 1, 1), "0")])
+    def test_halo_matches_xla(self, mesh_shape, thinz, monkeypatch):
         from stencil_tpu.models.astaroth import FIELDS, Astaroth
 
+        monkeypatch.setenv("STENCIL_MHD_THINZ", thinz)
         size = (16, 16, 32)   # (nx, ny, nz): local z/y stay multiples of 8
         ndev = mesh_shape[0] * mesh_shape[1] * mesh_shape[2]
         a = Astaroth(*size, mesh_shape=(1, 1, 1), dtype=np.float64,
