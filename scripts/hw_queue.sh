@@ -16,16 +16,16 @@ run() { echo "== $*" | tee -a "$OUT"; "$@" 2>>"$OUT.err" | tee -a "$OUT"; }
 run python scripts/bench_kernels.py --model jacobi --kernels wrap \
     "${WD[@]}"
 for n in 3 4; do
-  STENCIL_WRAP_STEPS=$n run python scripts/bench_kernels.py \
+  run env STENCIL_WRAP_STEPS=$n python scripts/bench_kernels.py \
       --model jacobi --kernels wrap "${WD[@]}"
 done
 
 # 2. halo path: single-step vs pair vs depth-3 (multi-chip compute path)
-STENCIL_DISABLE_WRAP2=1 run python scripts/bench_kernels.py \
+run env STENCIL_DISABLE_WRAP2=1 python scripts/bench_kernels.py \
     --model jacobi --kernels halo "${WD[@]}"
 run python scripts/bench_kernels.py --model jacobi --kernels halo \
     "${WD[@]}"
-STENCIL_WRAP_STEPS=3 run python scripts/bench_kernels.py \
+run env STENCIL_WRAP_STEPS=3 python scripts/bench_kernels.py \
     --model jacobi --kernels halo "${WD[@]}"
 
 # 3. bf16 wrap + halo (half-traffic ladder)
@@ -38,13 +38,13 @@ for b in "8,64" "8,32" "16,64"; do
   run python scripts/bench_kernels.py --model mhd --kernels wrap \
       --blocks "$b" "${WD[@]}"
 done
-STENCIL_MHD_THINZ=0 run python scripts/bench_kernels.py --model mhd \
+run env STENCIL_MHD_THINZ=0 python scripts/bench_kernels.py --model mhd \
     --kernels wrap --blocks "8,32" "${WD[@]}"
 
 # 5. MHD halo (x-roll window), thin-z default + tiled-z control
 run python scripts/bench_kernels.py --model mhd --kernels halo \
     "${WD[@]}"
-STENCIL_MHD_THINZ=0 run python scripts/bench_kernels.py --model mhd \
+run env STENCIL_MHD_THINZ=0 python scripts/bench_kernels.py --model mhd \
     --kernels halo "${WD[@]}"
 
 # 6. headline JSON

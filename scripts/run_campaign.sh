@@ -87,4 +87,6 @@ done
 )
 
 echo "campaign complete: $OUT/"
-grep -H "" "$OUT"/*.csv "$OUT"/*.json 2>/dev/null | tail -40
+# bench.json is absent in smoke mode; the summary glob must not turn a
+# fully-green run into a nonzero exit
+grep -H "" "$OUT"/*.csv "$OUT"/*.json 2>/dev/null | tail -40 || true
