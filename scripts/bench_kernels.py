@@ -131,13 +131,19 @@ def _patch_mhd_blocks(m, kernel, blocks):
 
     bz, by = blocks
     if kernel == "wrap":
+        # patch the fused substep-0+1 kernel too (STENCIL_MHD_PAIR=1
+        # runs it for two of the three substeps)
         orig = pallas_mhd.mhd_substep_wrap_pallas
+        orig01 = pallas_mhd.mhd_substep01_wrap_pallas
         pallas_mhd.mhd_substep_wrap_pallas = functools.partial(
             orig, block_z=bz, block_y=by)
+        pallas_mhd.mhd_substep01_wrap_pallas = functools.partial(
+            orig01, block_z=bz, block_y=by)
         try:
             m._build_wrap_step()
         finally:
             pallas_mhd.mhd_substep_wrap_pallas = orig
+            pallas_mhd.mhd_substep01_wrap_pallas = orig01
     else:
         m._halo_blocks = (bz, by)
         m._build_halo_step()

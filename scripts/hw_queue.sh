@@ -40,6 +40,8 @@ for b in "8,64" "8,32" "16,64"; do
 done
 run env STENCIL_MHD_THINZ=0 python scripts/bench_kernels.py --model mhd \
     --kernels wrap --blocks "8,32" "${WD[@]}"
+run env STENCIL_MHD_PAIR=1 python scripts/bench_kernels.py --model mhd \
+    --kernels wrap --blocks "8,32" "${WD[@]}"
 
 # 5. MHD halo (x-roll window), thin-z default + tiled-z control
 run python scripts/bench_kernels.py --model mhd --kernels halo \

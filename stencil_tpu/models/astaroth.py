@@ -445,12 +445,28 @@ class Astaroth:
                 (lo.z + local.z, lo.y + local.y, lo.x + local.x))
                 for q, p in fields.items()}
 
+        # STENCIL_MHD_PAIR=1 opts into the fused substep-0+1 kernel
+        # (one HBM pass for two of the three RK substeps; alpha_0 == 0
+        # makes the pair independent of the incoming w) — experimental
+        # until hardware-measured, so default off
+        import os
+        pair_on = os.environ.get("STENCIL_MHD_PAIR", "").lower() in (
+            "1", "true", "yes")
+        if pair_on:
+            from ..ops.pallas_mhd import mhd_substep01_wrap_pallas
+            from ..utils.logging import LOG_INFO
+            LOG_INFO("astaroth wrap path: fused substep-0+1 kernel")
+
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def loop(inner, w, n):
             def body(_, fw):
                 f, wk = fw
-                for s in range(3):
-                    f, wk = mhd_substep_wrap_pallas(f, wk, s, prm, dt)
+                if pair_on:
+                    f, wk = mhd_substep01_wrap_pallas(f, prm, dt)
+                    f, wk = mhd_substep_wrap_pallas(f, wk, 2, prm, dt)
+                else:
+                    for s in range(3):
+                        f, wk = mhd_substep_wrap_pallas(f, wk, s, prm, dt)
                 return f, wk
             return lax.fori_loop(0, n, body, (inner, w))
 

@@ -48,28 +48,32 @@ def _thin_z() -> bool:
 _YSEGS = (-1, 0, 1)
 
 
-def _window_plan(Z: int, Y: int, X: int, bz: int, by: int):
-    """(specs, assemble) for one field's (bz+6, by+6, X) neighborhood,
-    periodic via wrapped index maps; x is NOT extended (buffers stay
-    lane-aligned at X; periodic x shifts happen per-derivative via
-    ``pltpu.roll`` — the FieldData ``x_wrap`` mode).
+def _window_plan(Z: int, Y: int, X: int, bz: int, by: int,
+                 rr: int = R):
+    """(specs, assemble) for one field's (bz+2rr, by+2rr, X)
+    neighborhood (rr defaults to the stencil radius R; the fused
+    substep-pair kernel passes 2R), periodic via wrapped index maps;
+    x is NOT extended (buffers stay lane-aligned at X; periodic x
+    shifts happen per-derivative via ``pltpu.roll`` — the FieldData
+    ``x_wrap`` mode).
 
-    Default (thin-z) plan: 7 z segments (3 wrapped single rows below,
-    the main bz-row block, 3 above — exact-radius fetches, since the
-    majormost dim has no tile granularity) x 3 y segments (preceding
-    ESUB-slab, main, following ESUB-slab) = 21 specs; per-block read
-    amplification (1 + 2R/bz) * (1 + 2*ESUB/by).
+    Default (thin-z) plan: 2rr+1 z segments (rr wrapped single rows
+    below, the main bz-row block, rr above — exact-radius fetches,
+    since the majormost dim has no tile granularity) x 3 y segments
+    (preceding ESUB-slab, main, following ESUB-slab); per-block read
+    amplification (1 + 2rr/bz) * (1 + 2*ESUB/by).
 
     STENCIL_MHD_THINZ=0 plan: 3 z segments (ESUB-row tile below, main,
     ESUB-row tile above) x 3 y segments = 9 specs; amplification
     (1 + 2*ESUB/bz) * (1 + 2*ESUB/by) — more traffic, but fewer/fatter
     DMAs (the round-3 layout, kept for hardware A/B).
     """
+    assert rr <= ESUB, (rr, ESUB)   # y slabs are one ESUB tile wide
     nyb = Y // ESUB
     byb = by // ESUB
     thin = _thin_z()
     if thin:
-        zsegs = (-3, -2, -1, 0, 1, 2, 3)
+        zsegs = tuple(range(-rr, 0)) + (0,) + tuple(range(1, rr + 1))
     else:
         assert bz % ESUB == 0 and Z % ESUB == 0, (Z, bz)
         zsegs = (-1, 0, 1)
@@ -102,23 +106,38 @@ def _window_plan(Z: int, Y: int, X: int, bz: int, by: int):
     specs = [zy(zs, ys) for zs in zsegs for ys in _YSEGS]
 
     def assemble(refs) -> jnp.ndarray:
-        """(bz+6, by+6, X) periodic window from the segment refs
+        """(bz+2rr, by+2rr, X) periodic window from the segment refs
         (z segments outer, y in _YSEGS inner)."""
         rows = []
         for zi, zs in enumerate(zsegs):
             ym, y0, yp = refs[3 * zi:3 * zi + 3]
             if thin or zs == 0:
                 zslice = slice(None)
-            elif zs < 0:          # tiled: last R rows of the ESUB tile
-                zslice = slice(ESUB - R, None)
-            else:                 # tiled: first R rows
-                zslice = slice(None, R)
+            elif zs < 0:          # tiled: last rr rows of the ESUB tile
+                zslice = slice(ESUB - rr, None)
+            else:                 # tiled: first rr rows
+                zslice = slice(None, rr)
             rows.append(jnp.concatenate(
-                [ym[zslice, ESUB - R:], y0[zslice], yp[zslice, :R]],
+                [ym[zslice, ESUB - rr:], y0[zslice], yp[zslice, :rr]],
                 axis=1))
         return jnp.concatenate(rows, axis=0)
 
     return specs, assemble
+
+
+def _fit_blocks(Z: int, Y: int, block_z: int,
+                block_y: int) -> Tuple[int, int]:
+    """Shrink (block_z, block_y) to divide (Z, Y) while staying
+    multiples of the ESUB tile — the one block-shrink rule both wrap
+    substep kernels share."""
+    assert Z % ESUB == 0 and Y % ESUB == 0, (Z, Y)
+    bz, by = block_z, block_y
+    while bz > ESUB and Z % bz:
+        bz -= ESUB
+    while by > ESUB and Y % by:
+        by -= ESUB
+    assert bz % ESUB == 0 and by % ESUB == 0 and Z % bz == 0 and Y % by == 0
+    return bz, by
 
 
 def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
@@ -140,14 +159,7 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     if interpret is None:
         interpret = default_interpret()
     Z, Y, X = fields[FIELDS[0]].shape
-    assert Z % ESUB == 0 and Y % ESUB == 0, (Z, Y)
-    # shrink blocks to fit small grids; both must stay multiples of 8
-    bz, by = block_z, block_y
-    while bz > ESUB and Z % bz:
-        bz -= ESUB
-    while by > ESUB and Y % by:
-        by -= ESUB
-    assert bz % ESUB == 0 and by % ESUB == 0 and Z % bz == 0 and Y % by == 0
+    bz, by = _fit_blocks(Z, Y, block_z, block_y)
     dtype = fields[FIELDS[0]].dtype
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
     alpha = float(RK3_ALPHA[s])
@@ -186,6 +198,105 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     for q in FIELDS:
         in_specs.append(main_spec)
         inputs.append(w[q])
+    out_shape = [jax.ShapeDtypeStruct((Z, Y, X), dtype)
+                 for _ in range(2 * nf)]
+    out_specs = [main_spec] * (2 * nf)
+
+    outs = pl.pallas_call(
+        kern,
+        grid=(Z // bz, Y // by),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*inputs)
+    new_f = {q: outs[i] for i, q in enumerate(FIELDS)}
+    new_w = {q: outs[nf + i] for i, q in enumerate(FIELDS)}
+    return new_f, new_w
+
+
+def mhd_substep01_wrap_pallas(fields: Dict[str, jnp.ndarray],
+                              prm, dt_phys: float,
+                              block_z: int = 8, block_y: int = 32,
+                              interpret: Optional[bool] = None
+                              ) -> Tuple[Dict[str, jnp.ndarray],
+                                         Dict[str, jnp.ndarray]]:
+    """RK3 substeps 0 AND 1 fused into one HBM pass — temporal blocking
+    across Runge-Kutta substeps. Williamson's alpha_0 is 0, so substep
+    0 ignores the incoming w entirely (w_1 = dt * rates_0): the fused
+    pair reads ONLY the 8 fields through a radius-2R window, evaluates
+    rates_0 on the ring-extended (bz+2R, by+2R) region, forms the
+    intermediate (f_1, w_1) in VMEM, evaluates rates_1 on the block,
+    and writes (f_2, w_2) — replacing two full read+write sweeps (plus
+    a w read) with one fatter read and the same writes. Per-point op
+    order matches two ``mhd_substep_wrap_pallas`` calls exactly (the
+    ring is recomputed, not approximated), so results are
+    bit-compatible. Opt-in path (STENCIL_MHD_PAIR=1 in the model): the
+    compute/VMEM pressure doubles per grid step, and the trade is
+    unmeasured on hardware. Reference semantics:
+    astaroth/kernels.cu:63-90 applied for substeps 0 and 1.
+
+    Same layout contract as ``mhd_substep_wrap_pallas``; requires
+    2R <= the ESUB tile (6 <= 8). Returns (new_fields, new_w).
+    """
+    from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
+    from .fd6 import FieldData
+
+    if interpret is None:
+        interpret = default_interpret()
+    assert float(RK3_ALPHA[0]) == 0.0, "pair fusion needs alpha_0 == 0"
+    Z, Y, X = fields[FIELDS[0]].shape
+    bz, by = _fit_blocks(Z, Y, block_z, block_y)
+    dtype = fields[FIELDS[0]].dtype
+    inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
+    beta0 = float(RK3_BETA[0])
+    alpha1 = float(RK3_ALPHA[1])
+    beta1 = float(RK3_BETA[1])
+    dt_ = float(dt_phys)
+    R2 = 2 * R
+    # rates_0 is evaluated on the ring-extended region, rates_1 on the
+    # block; both FieldData views sit on lane-aligned (.., X) buffers
+    pad0 = Dim3(0, R, R)
+    int0 = Dim3(X, by + R2, bz + R2)   # region carrying rates_0
+    pad1 = Dim3(0, R, R)
+    int1 = Dim3(X, by, bz)
+
+    main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
+    nf = len(FIELDS)
+    field_specs, assemble = _window_plan(Z, Y, X, bz, by, rr=R2)
+    nseg = len(field_specs)
+
+    def kern(*refs):
+        field_refs = refs[:nseg * nf]
+        out_f = refs[nseg * nf:nseg * nf + nf]
+        out_w = refs[nseg * nf + nf:]
+        dta = jnp.dtype(dtype)
+        data0 = {}
+        for i, q in enumerate(FIELDS):
+            win = assemble(field_refs[nseg * i:nseg * (i + 1)])
+            data0[q] = FieldData(win, inv_ds, pad0, int0, x_wrap=True)
+        rates0 = mhd_rates(data0, prm, dtype)
+        data1 = {}
+        w1 = {}
+        for q in FIELDS:
+            w1[q] = dta.type(dt_) * rates0[q]          # alpha_0 == 0
+            f1 = data0[q].value + dta.type(beta0) * w1[q]
+            data1[q] = FieldData(f1, inv_ds, pad1, int1, x_wrap=True)
+        rates1 = mhd_rates(data1, prm, dtype)
+        for i, q in enumerate(FIELDS):
+            # w_1 sliced to the block for the substep-1 update
+            w1c = w1[q][R:R + bz, R:R + by]
+            wq = dta.type(alpha1) * w1c + dta.type(dt_) * rates1[q]
+            out_w[i][...] = wq
+            out_f[i][...] = data1[q].value + dta.type(beta1) * wq
+
+    in_specs = []
+    inputs = []
+    for q in FIELDS:
+        in_specs.extend(field_specs)
+        inputs.extend([fields[q]] * nseg)
     out_shape = [jax.ShapeDtypeStruct((Z, Y, X), dtype)
                  for _ in range(2 * nf)]
     out_specs = [main_spec] * (2 * nf)

@@ -51,13 +51,20 @@ class TestDistributedParity:
 
 
 class TestStability:
-    @pytest.mark.parametrize("thinz", ["1", "0"])
-    def test_wrap_megakernel_matches_xla(self, thinz, monkeypatch):
+    @pytest.mark.parametrize("thinz,pair", [
+        ("1", "0"), ("0", "0"),
+        # fused substep-0+1 kernel (STENCIL_MHD_PAIR=1 opt-in), under
+        # both window plans (tiled-z at rr=6 slices the ESUB tile
+        # differently than the rr=3 single-substep path)
+        ("1", "1"), ("0", "1")])
+    def test_wrap_megakernel_matches_xla(self, thinz, pair, monkeypatch):
         """The fused Pallas substep megakernel (ops/pallas_mhd.py,
         single-chip fast path) against the slicing formulation — under
         BOTH window plans (exact-radius thin-z default and the
-        STENCIL_MHD_THINZ=0 tiled-z A/B control)."""
+        STENCIL_MHD_THINZ=0 tiled-z A/B control) and with the fused
+        substep-0+1 pair kernel opted in."""
         monkeypatch.setenv("STENCIL_MHD_THINZ", thinz)
+        monkeypatch.setenv("STENCIL_MHD_PAIR", pair)
         size = (16, 16, 16)
         a = Astaroth(*size, mesh_shape=(1, 1, 1), dtype=np.float64,
                      devices=jax.devices()[:1], kernel="xla")
