@@ -31,8 +31,9 @@ def apply_device_flags(args) -> None:
 def add_dtype_flags(p: argparse.ArgumentParser) -> None:
     """--f64 / --bf16 (the reference's float/double templating analog;
     bf16 is the TPU-native half-traffic option)."""
-    p.add_argument("--f64", action="store_true")
-    p.add_argument("--bf16", action="store_true",
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("--f64", action="store_true")
+    g.add_argument("--bf16", action="store_true",
                    help="bfloat16 fields: half the HBM traffic on the "
                         "bandwidth-bound fused kernels")
 

@@ -58,9 +58,11 @@ def main() -> None:
     env = dict(os.environ)
     last_err = ""
     if not _backend_alive():
+        # value/vs_baseline are null, not 0.0: nothing was measured, and
+        # a numeric zero invites downstream tooling to ingest it as data
         print(json.dumps({
-            "metric": "jacobi3d_512c_iters_per_sec", "value": 0.0,
-            "unit": "iters/s", "vs_baseline": 0.0, "suspect": True,
+            "metric": "jacobi3d_512c_iters_per_sec", "value": None,
+            "unit": "iters/s", "vs_baseline": None, "suspect": True,
             "extra": {"suspect_reason":
                       "XLA backend init hung >180s (accelerator tunnel "
                       "down); measurement skipped"},
@@ -92,8 +94,8 @@ def main() -> None:
             print(json.dumps(rec))
             return
     print(json.dumps({
-        "metric": "jacobi3d_512c_iters_per_sec", "value": 0.0,
-        "unit": "iters/s", "vs_baseline": 0.0, "suspect": True,
+        "metric": "jacobi3d_512c_iters_per_sec", "value": None,
+        "unit": "iters/s", "vs_baseline": None, "suspect": True,
         "extra": {"suspect_reason":
                   "measurement subprocess hung or died on both the "
                   "wrap2 and single-step paths; last error: "

@@ -96,16 +96,43 @@ def _patch_jacobi_blocks(j, kernel, blocks):
             pallas_stencil.jacobi7_wrap_pallas = orig1
             pallas_stencil.jacobi7_wrapn_pallas = orign
     else:
-        # the halo path runs pairs (jacobi7_halo2_pallas, blocks from
-        # fit_pair_halo_blocks) with a single-step tail — patch both
+        # the halo path runs N-step groups (jacobi7_halon_pallas, blocks
+        # from fit_pair_halo_blocks) with a single-step tail — ONE
+        # resolved (bz, by) decision drives both, so a measurement is
+        # never a hybrid of swept-group + default-tail shapes (or vice
+        # versa). Swept shapes are honored as-given (the sweep's whole
+        # point); only a shape whose byte model exceeds the kernel's
+        # actual 64 MiB scoped-VMEM compile ceiling — certain to fail —
+        # is replaced by the default fit, with a visible stderr note so
+        # the CSV row is not silently mislabeled.
         orig = pallas_halo.jacobi7_halo_pallas
         orig_fit = pallas_halo.fit_pair_halo_blocks
-        pallas_halo.jacobi7_halo_pallas = functools.partial(
-            orig, block_z=bz, block_y=by)
         from stencil_tpu.ops.pallas_stencil import sublane_tile_bytes
-        pallas_halo.fit_pair_halo_blocks = lambda Z, Y, X, item, steps=2: (
-            pallas_halo._shrink_block(Z, bz),
-            pallas_halo._shrink_block(Y, by, sublane_tile_bytes(item)))
+        hard = 64 * 2**20   # pallas_halo kernels' vmem_limit_bytes
+        resolved = {}
+
+        def _fit_swept(Z, Y, X, item, steps=2):
+            cand = (pallas_halo._shrink_block(Z, bz),
+                    pallas_halo._shrink_block(Y, by,
+                                              sublane_tile_bytes(item)))
+            if (pallas_halo._pair_block_bytes(cand[0], cand[1], X, item,
+                                              steps) > hard):
+                fb = orig_fit(Z, Y, X, item, steps)
+                print(f"swept blocks {cand} exceed the {hard >> 20} MiB "
+                      f"scoped-VMEM ceiling; measuring fallback {fb}",
+                      file=sys.stderr)
+                cand = fb
+            resolved["blocks"] = cand
+            return cand
+
+        def _tail(*a, **kw):
+            blk = resolved.get("blocks", (bz, by))
+            kw.setdefault("block_z", blk[0])
+            kw.setdefault("block_y", blk[1])
+            return orig(*a, **kw)
+
+        pallas_halo.jacobi7_halo_pallas = _tail
+        pallas_halo.fit_pair_halo_blocks = _fit_swept
         try:
             j._build_halo_step()
         finally:

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe the axon TPU in a watchdogged subprocess every
+# ~2.5 min; the moment a probe answers, run the staged hardware queue
+# (scripts/hw_queue.sh) exactly once and exit. Keeps the chip free
+# between probes (each probe is its own short-lived process).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+LOG="hw_watch.log"
+MAX_PROBES="${1:-200}"
+echo "$(date +%T) watcher start (max $MAX_PROBES probes)" | tee -a "$LOG"
+for ((i = 1; i <= MAX_PROBES; i++)); do
+  if timeout 90 python -c \
+      "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d" \
+      >/dev/null 2>&1; then
+    echo "$(date +%T) tunnel UP on probe $i — running hw queue" | tee -a "$LOG"
+    bash scripts/hw_queue.sh 2>&1 | tee -a "$LOG"
+    rc=$?
+    echo "$(date +%T) hw queue finished rc=$rc" | tee -a "$LOG"
+    exit "$rc"
+  fi
+  echo "$(date +%T) probe $i: tunnel down" >>"$LOG"
+  sleep 150
+done
+echo "$(date +%T) watcher gave up after $MAX_PROBES probes" | tee -a "$LOG"
+exit 1

@@ -259,8 +259,9 @@ def _pair_block_bytes(bz: int, by: int, X: int, itemsize: int,
     the pipeline, plus the assembled (bz+2N, by+2N, X) window and the
     first intermediate held during compute."""
     N = steps
+    esub = sublane_tile_bytes(itemsize)
     streamed = 2 * (2 * bz * by * X + 4 * N * by * X
-                    + 8 * bz * ESUB * X)
+                    + 8 * bz * esub * X)
     held = ((bz + 2 * N) * (by + 2 * N) * X
             + (bz + 2 * N - 2) * (by + 2 * N - 2) * X)
     return itemsize * (streamed + held)
