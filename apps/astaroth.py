@@ -109,20 +109,19 @@ def main() -> None:
         m.sync_domain()
         save_domain(m.dd, args.checkpoint_dir, it, extra=m._w)
 
-    # exchange-only timing (3 exchanges per iteration); warm the
-    # standalone exchange program first so compile time is excluded
-    m.sync_domain()
-    m.dd.exchange()
-    m.block()
-    m.dd.enable_timing(True)
-    for _ in range(3):
-        m.dd.exchange()
-    exch = sum(m.dd.exchange_seconds) / len(m.dd.exchange_seconds) * 3
+    # exchange-only estimate, path-aware: the fused halo path performs
+    # slab rounds inside its jitted loop (never dd.exchange()), so the
+    # standalone measurement times exactly that transfer; xla paths
+    # time the orchestrator exchange. Per-iteration seconds + wire
+    # bytes (reference CSV: astaroth.cu:668-676 iter/exch trimeans).
+    exch = m.measure_exchange_seconds()
+    xstats = m.exchange_stats()
 
     if args.paraview_final:
         m.dd.write_paraview(args.prefix + "final")
     print(csv_line(ndev, gx, gy, gz,
-                   f"{stats.trimean():.6e}", f"{exch:.6e}"))
+                   f"{stats.trimean():.6e}", f"{exch:.6e}",
+                   xstats["path"], int(xstats["bytes_per_iteration"])))
 
 
 if __name__ == "__main__":

@@ -17,14 +17,25 @@ def main() -> None:
     ap.add_argument("--radius", type=int, default=3)
     ap.add_argument("--fields", type=int, default=1)
     ap.add_argument("--iters", "-n", type=int, default=30)
+    ap.add_argument("--interior-slabs", action="store_true",
+                    help="measure the fused fast paths' interior-"
+                         "resident slab exchange instead of the padded "
+                         "orchestrator exchange (x-unsharded mesh)")
     add_method_flags(ap)
     add_device_flags(ap)
     args = ap.parse_args()
     apply_device_flags(args)
 
-    run_exchange_bench("exchange_strong", args.x, args.y, args.z, None,
-                       args.radius, args.fields, args.iters,
-                       methods_from_args(args))
+    mesh_shape = None
+    if args.interior_slabs:
+        import jax
+
+        from stencil_tpu.parallel.mesh import default_mesh_shape_xfree
+        mesh_shape = default_mesh_shape_xfree(len(jax.devices()))
+    run_exchange_bench("exchange_strong", args.x, args.y, args.z,
+                       mesh_shape, args.radius, args.fields, args.iters,
+                       methods_from_args(args),
+                       interior_slabs=args.interior_slabs)
 
 
 if __name__ == "__main__":

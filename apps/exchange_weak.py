@@ -10,12 +10,55 @@ from _common import (add_device_flags, apply_device_flags,
 
 
 def run_exchange_bench(name: str, gx: int, gy: int, gz: int, mesh_shape,
-                       radius: int, fields: int, iters: int, methods) -> None:
+                       radius: int, fields: int, iters: int, methods,
+                       interior_slabs: bool = False) -> None:
     import numpy as np
 
     from stencil_tpu.distributed import DistributedDomain
     from stencil_tpu.utils.timers import device_sync
 
+    if interior_slabs:
+        # the fused fast paths' transfer, standalone: interior-resident
+        # slab rounds (exchange_interior_slabs) with the SAME byte
+        # accounting the models report (interior_slab_bytes), so this
+        # bench and Jacobi3D/Astaroth.exchange_stats agree by
+        # construction. Needs an x-unsharded mesh (the fast-path
+        # contract). No DistributedDomain: the padded orchestrator
+        # arrays would only inflate peak memory at exactly the large
+        # weak-scaled sizes this bench targets — the timer allocates
+        # its own sharded interior-resident zeros.
+        from stencil_tpu.geometry import Dim3
+        from stencil_tpu.parallel.exchange import (
+            interior_slab_bytes, measure_slab_exchange_seconds)
+        from stencil_tpu.parallel.mesh import make_mesh, mesh_dim
+
+        mesh = make_mesh(mesh_shape)
+        counts = mesh_dim(mesh)
+        ndev = counts.flatten()
+        if counts.x != 1:
+            raise SystemExit("--interior-slabs needs an x-unsharded "
+                             "mesh (the fused halo-path contract)")
+        if gx % counts.x or gy % counts.y or gz % counts.z:
+            raise SystemExit("--interior-slabs needs an evenly "
+                             "divisible grid")
+        local = Dim3(gx // counts.x, gy // counts.y, gz // counts.z)
+        # slab buffers are block-aligned (8-row tiles); radii beyond
+        # one tile scale both buffer dims
+        buf = max(8, -(-radius // 8) * 8)
+        if radius > min(local.z, local.y):
+            raise SystemExit(f"--radius {radius} exceeds the local "
+                             f"shard {local}")
+        sec = measure_slab_exchange_seconds(
+            mesh, local, np.float32, rz=buf, ry=buf,
+            radius_rows=radius, y_z_extended=True, nfields=fields,
+            reps=iters)
+        total = interior_slab_bytes(
+            (local.z, local.y, local.x), counts, radius, 4,
+            y_z_extended=True) * ndev * fields
+        print(csv_line(name + "_slabs", "InteriorSlabs", ndev, gx, gy,
+                       gz, radius, fields, total, f"{sec:.6e}",
+                       f"{sec:.6e}", f"{(total / sec if sec else 0):.6e}"))
+        return
     dd = DistributedDomain(gx, gy, gz)
     if mesh_shape is not None:
         dd.set_mesh_shape(mesh_shape)
@@ -24,8 +67,8 @@ def run_exchange_bench(name: str, gx: int, gy: int, gz: int, mesh_shape,
     for i in range(fields):
         dd.add_data(f"q{i}", np.float32)
     dd.realize()
-    stats = timed_samples(dd.exchange, lambda: device_sync(dd.curr), iters)
     ndev = dd.placement.dim().flatten()
+    stats = timed_samples(dd.exchange, lambda: device_sync(dd.curr), iters)
     total = dd.exchange_bytes_total()
     tm = stats.trimean()
     print(csv_line(name, dd.methods, ndev, gx, gy, gz, radius, fields,
@@ -41,6 +84,10 @@ def main() -> None:
     ap.add_argument("--radius", type=int, default=3)
     ap.add_argument("--fields", type=int, default=1)
     ap.add_argument("--iters", "-n", type=int, default=30)
+    ap.add_argument("--interior-slabs", action="store_true",
+                    help="measure the fused fast paths' interior-"
+                         "resident slab exchange instead of the padded "
+                         "orchestrator exchange (x-unsharded mesh)")
     add_method_flags(ap)
     add_device_flags(ap)
     args = ap.parse_args()
@@ -48,13 +95,17 @@ def main() -> None:
 
     import jax
 
-    from stencil_tpu.parallel.mesh import default_mesh_shape
+    from stencil_tpu.parallel.mesh import (default_mesh_shape,
+                                           default_mesh_shape_xfree)
 
-    mesh_shape = default_mesh_shape(len(jax.devices()))
+    ndev = len(jax.devices())
+    mesh_shape = (default_mesh_shape_xfree(ndev) if args.interior_slabs
+                  else default_mesh_shape(ndev))
     run_exchange_bench("exchange_weak",
                        args.x * mesh_shape.x, args.y * mesh_shape.y,
                        args.z * mesh_shape.z, mesh_shape, args.radius,
-                       args.fields, args.iters, methods_from_args(args))
+                       args.fields, args.iters, methods_from_args(args),
+                       interior_slabs=args.interior_slabs)
 
 
 if __name__ == "__main__":

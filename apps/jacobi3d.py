@@ -92,10 +92,17 @@ def main() -> None:
 
     stats = timed_samples(one, j.block, samples)
     b = j.dd.exchange_bytes_per_axis()
+    # honest exchange-cost estimate for the built path (the fused fast
+    # paths never call dd.exchange(); see Jacobi3D.exchange_stats):
+    # exchange seconds and wire bytes per ITERATION
+    xstats = j.exchange_stats()
+    ex_s = j.measure_exchange_seconds()
     print(csv_line("jacobi3d", methods, ndev, gx, gy, gz,
                    b["x"], b["y"], b["z"],
                    f"{stats.min() / args.batch:.6e}",
-                   f"{stats.trimean() / args.batch:.6e}"))
+                   f"{stats.trimean() / args.batch:.6e}",
+                   xstats["path"], int(xstats["bytes_per_iteration"]),
+                   f"{ex_s:.6e}"))
     if args.paraview:
         j.dd.write_paraview(args.prefix + "jacobi3d_final")
 

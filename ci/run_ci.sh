@@ -1,16 +1,31 @@
 #!/usr/bin/env bash
-# The full CI pipeline, runnable locally or from the workflow config
-# (the .travis.yml:1-20 analog): native build, unit tests on the
-# 8-device virtual CPU mesh, app smoke runs, and the multi-chip
-# certification sweep. No TPU required.
+# The CI pipeline, runnable locally or from a trigger (the
+# .travis.yml:1-20 analog): native build, unit tests on the 8-device
+# virtual CPU mesh, app smoke runs, and the multi-chip certification
+# sweep. No TPU required.
+#
+# Tiers (CI_TIER env): "smoke" (default) skips the @pytest.mark.slow
+# interpret-mode parity tests and finishes in a few minutes — the
+# pre-push / per-commit tier; "full" runs the entire suite (~15 min) —
+# the nightly/merge tier.
+#
+# Triggers that invoke this script:
+#   * .github/workflows/ci.yml  — push/PR (smoke) + nightly cron (full)
+#   * scripts/install_hooks.sh  — local git pre-push hook (smoke)
+#   * manual: CI_TIER=full bash ci/run_ci.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
+TIER="${CI_TIER:-smoke}"
 
 echo "== 1/4 native build =="
 bash ci/build.sh
 
-echo "== 2/4 unit tests (8-device virtual CPU mesh) =="
-python -m pytest tests/ -q --maxfail=1
+echo "== 2/4 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+if [ "$TIER" = "full" ]; then
+  python -m pytest tests/ -q --maxfail=1
+else
+  python -m pytest tests/ -q --maxfail=1 -m "not slow"
+fi
 
 echo "== 3/4 app smoke runs =="
 smoke() { echo "-- $*"; python "$@" > /dev/null; }

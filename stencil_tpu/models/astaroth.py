@@ -510,6 +510,19 @@ class Astaroth:
         spec = P("z", "y", "x")
         fields_spec = {q: spec for q in FIELDS}
 
+        # STENCIL_MHD_PAIR=1: fused substep-0+1 kernel on the halo path
+        # too — one radius-2R exchange + one HBM pass covers two of the
+        # three RK substeps (same opt-in as the wrap path; needs the
+        # slabs to carry 2R valid rows, hence 2R <= min(bz, ESUB))
+        import os
+        pair_on = (os.environ.get("STENCIL_MHD_PAIR", "").lower()
+                   in ("1", "true", "yes")
+                   and 2 * HALO_R <= min(bz, ESUB))
+        if pair_on:
+            from ..ops.pallas_halo import mhd_substep01_halo_pallas
+            from ..utils.logging import LOG_INFO
+            LOG_INFO("astaroth halo path: fused substep-0+1 kernel")
+
         def extract_shard(fields):
             return {q: lax.slice(
                 p, (lo.z, lo.y, lo.x),
@@ -520,17 +533,27 @@ class Astaroth:
             extract_shard, mesh=dd.mesh, in_specs=(fields_spec,),
             out_specs=fields_spec, check_vma=False))
 
+        def exchange_all(f, radius_rows):
+            return {q: exchange_interior_slabs(
+                f[q], counts, rz=bz, ry=ESUB,
+                radius_rows=radius_rows, y_z_extended=True)
+                for q in FIELDS}
+
         def loop_shard(inner, w, n):
             def body(_, fw):
                 f, wk = fw
-                for s in range(3):
-                    slabs = {q: exchange_interior_slabs(
-                        f[q], counts, rz=bz, ry=ESUB,
-                        radius_rows=HALO_R, y_z_extended=True)
-                        for q in FIELDS}
-                    f, wk = mhd_substep_halo_pallas(f, wk, slabs, s,
-                                                    prm, dt, block_z=bz,
-                                                    block_y=by)
+                if pair_on:
+                    f, wk = mhd_substep01_halo_pallas(
+                        f, exchange_all(f, 2 * HALO_R), prm, dt,
+                        block_z=bz, block_y=by)
+                    f, wk = mhd_substep_halo_pallas(
+                        f, wk, exchange_all(f, HALO_R), 2, prm, dt,
+                        block_z=bz, block_y=by)
+                else:
+                    for s in range(3):
+                        f, wk = mhd_substep_halo_pallas(
+                            f, wk, exchange_all(f, HALO_R), s, prm, dt,
+                            block_z=bz, block_y=by)
                 return f, wk
             return lax.fori_loop(0, n, body, (inner, w))
 
@@ -548,6 +571,10 @@ class Astaroth:
         self._insert = jax.jit(jax.shard_map(
             insert_shard, mesh=dd.mesh, in_specs=(fields_spec, fields_spec),
             out_specs=fields_spec, check_vma=False), donate_argnums=0)
+        # exchange accounting for exchange_stats(): per iteration the
+        # pair path does one radius-2R + one radius-R extended slab
+        # round; the sequential path three radius-R rounds
+        self._slab_exchange_cfg = dict(rz=bz, pair=pair_on)
         self._install_inner_iter(extract, loop)
 
     def _install_inner_iter(self, extract, loop) -> None:
@@ -565,6 +592,77 @@ class Astaroth:
 
         self._iter_n = iteration_n
         self._iter = lambda f, w: iteration_n(f, w, jnp.asarray(1, jnp.int32))
+
+    def exchange_stats(self) -> dict:
+        """Per-iteration exchange accounting for the BUILT compute path
+        (whole-mesh bytes, the ``exchange_bytes_total`` convention) —
+        honest numbers for the fused fast paths that never call
+        ``dd.exchange()`` (reference per-iteration exchange stats:
+        src/stencil.cu:1005-1008,1174-1181; astaroth.cu:668-676)."""
+        from ..ops.pallas_halo import R as HALO_R
+        from ..parallel.exchange import interior_slab_bytes
+
+        path = self.kernel_path
+        if path == "wrap":
+            return {"path": path, "bytes_per_iteration": 0,
+                    "rounds_per_iteration": 0.0}
+        counts = mesh_dim(self.dd.mesh)
+        local = self.dd.local_size
+        cfg = getattr(self, "_slab_exchange_cfg", None)
+        if cfg is not None and path == "halo":
+            shard = (local.z, local.y, local.x)
+            item = self._dtype.itemsize
+            n = counts.flatten() * len(FIELDS)
+
+            def rnd(r):
+                return interior_slab_bytes(shard, counts, r, item,
+                                           y_z_extended=True) * n
+
+            if cfg["pair"]:
+                return {"path": path,
+                        "bytes_per_iteration": rnd(2 * HALO_R) + rnd(HALO_R),
+                        "rounds_per_iteration": 2.0}
+            return {"path": path, "bytes_per_iteration": 3 * rnd(HALO_R),
+                    "rounds_per_iteration": 3.0}
+        return {"path": path,
+                "bytes_per_iteration": 3.0 * self.dd.exchange_bytes_total(),
+                "rounds_per_iteration": 3.0}
+
+    def measure_exchange_seconds(self, reps: int = 5) -> float:
+        """Estimated exchange seconds per ITERATION, measured
+        standalone per round config (the fused loops exchange inside
+        one XLA program where the cost cannot be timed separately) —
+        the same per-iteration convention as
+        ``Jacobi3D.measure_exchange_seconds``. Returns 0.0 on the wrap
+        path."""
+        from ..ops.pallas_halo import ESUB, R as HALO_R
+
+        path = self.kernel_path
+        if path == "wrap":
+            return 0.0
+        cfg = getattr(self, "_slab_exchange_cfg", None)
+        if cfg is not None and path == "halo":
+            from ..parallel.exchange import measure_slab_exchange_seconds
+
+            def rnd(r):
+                return measure_slab_exchange_seconds(
+                    self.dd.mesh, self.dd.local_size, self._dtype,
+                    rz=cfg["rz"], ry=ESUB, radius_rows=r,
+                    y_z_extended=True, nfields=len(FIELDS), reps=reps)
+
+            if cfg["pair"]:
+                return rnd(2 * HALO_R) + rnd(HALO_R)
+            return 3 * rnd(HALO_R)
+        import time
+
+        from ..utils.timers import device_sync
+        self.dd.exchange()
+        device_sync(self.dd.curr[FIELDS[0]])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            self.dd.exchange()
+        device_sync(self.dd.curr[FIELDS[0]])
+        return 3 * (time.perf_counter() - t0) / reps
 
     def sync_domain(self) -> None:
         """Materialize interior-resident fast-path state back into the

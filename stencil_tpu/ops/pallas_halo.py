@@ -554,7 +554,8 @@ def mhd_halo_blocks(Z: int, Y: int, block_z: int = 8,
     return bz, by
 
 
-def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int):
+def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
+                     rr: int = R):
     """One closed unit (specs, inputs_for_field, select_window) for the
     MHD halo kernel's per-field stencil neighborhood on the slab
     layout — the spec list, the matching input ordering, and the
@@ -562,6 +563,12 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int):
     cannot desynchronize (the positional ref-slicing contract lives
     only here). Mirrors ops/pallas_mhd._window_plan for the wrap
     kernel.
+
+    ``rr`` is the window radius: R for one substep, 2R for the fused
+    substep-0+1 pair (ring recompute). Needs rr <= ESUB (slab buffers
+    are one ESUB tile wide) and rr <= bz (z slabs hold bz rows); the
+    slabs must carry rr valid rows (``radius_rows=rr`` at the
+    exchange).
 
     Segment grid: z in {-,0,+} x y in {-,0,+}; edge/corner segments
     carry one spec per possible source (in-shard / z slab / y slab)
@@ -585,6 +592,7 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int):
     """
     from .pallas_mhd import _thin_z
 
+    assert rr <= ESUB and rr <= bz, (rr, ESUB, bz)
     thin = _thin_z()
     bzb = bz // ESUB
     byb = by // ESUB
@@ -608,24 +616,24 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int):
     main = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
     specs = [main]
     if thin:
-        # zm_y0: exact-radius single rows z = kz*bz + o, o in -R..-1
-        for o in range(-R, 0):
+        # zm_y0: exact-radius single rows z = kz*bz + o, o in -rr..-1
+        for o in range(-rr, 0):
             specs.append(pl.BlockSpec(
                 (1, by, X),
                 lambda kz, ky, o=o: (jnp.clip(kz * bz + o, 0, Z - 1),
                                      ky, 0)))
-        for o in range(-R, 0):  # zlo slab rows bz+o, fetched at kz == 0
+        for o in range(-rr, 0):  # zlo slab rows bz+o, fetched at kz == 0
             specs.append(pl.BlockSpec(
                 (1, by, X),
                 lambda kz, ky, o=o: (bz + o, jnp.where(kz == 0, ky, 0),
                                      0)))
-        # zp_y0: single rows z = kz*bz + bz + j, j in 0..R-1
-        for j in range(R):
+        # zp_y0: single rows z = kz*bz + bz + j, j in 0..rr-1
+        for j in range(rr):
             specs.append(pl.BlockSpec(
                 (1, by, X),
                 lambda kz, ky, j=j: (jnp.clip(kz * bz + bz + j, 0, Z - 1),
                                      ky, 0)))
-        for j in range(R):      # zhi slab rows j, fetched at kz == nzg-1
+        for j in range(rr):     # zhi slab rows j, fetched at kz == nzg-1
             specs.append(pl.BlockSpec(
                 (1, by, X),
                 lambda kz, ky, j=j: (j, jnp.where(kz == nzg - 1, ky, 0),
@@ -689,7 +697,7 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int):
         zlo, zhi = slabs["zlo"], slabs["zhi"]
         ylo, yhi = slabs["ylo"], slabs["yhi"]
         if thin:
-            zmid = [f] * R + [zlo] * R + [f] * R + [zhi] * R
+            zmid = [f] * rr + [zlo] * rr + [f] * rr + [zhi] * rr
         else:
             zmid = [f, zlo, f, zhi]    # tiled ESUB z segments
         return ([f] + zmid
@@ -701,7 +709,7 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int):
                    f, zhi, yhi])       # zp_yp
 
     def select_window(refs) -> jnp.ndarray:
-        """Assemble one field's (bz+2R, by+2R, X) stencil window from
+        """Assemble one field's (bz+2rr, by+2rr, X) stencil window from
         the segment refs, selecting slab sources at shard edges;
         x wraps per-derivative via pltpu.roll (x unsharded => in-core
         wrap IS the global periodic wrap)."""
@@ -713,25 +721,25 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int):
         at_yhi = ky == nyg - 1
         main = refs[0]
         if thin:
-            zm_in = refs[1:1 + R]
-            zm_zs = refs[1 + R:1 + 2 * R]
-            zp_in = refs[1 + 2 * R:1 + 3 * R]
-            zp_zs = refs[1 + 3 * R:1 + 4 * R]
-            rest = refs[1 + 4 * R:]
+            zm_in = refs[1:1 + rr]
+            zm_zs = refs[1 + rr:1 + 2 * rr]
+            zp_in = refs[1 + 2 * rr:1 + 3 * rr]
+            zp_zs = refs[1 + 3 * rr:1 + 4 * rr]
+            rest = refs[1 + 4 * rr:]
             zm_rows = [jnp.where(at_zlo, zm_zs[i][...], zm_in[i][...])
-                       for i in range(R)]
+                       for i in range(rr)]
             zp_rows = [jnp.where(at_zhi, zp_zs[i][...], zp_in[i][...])
-                       for i in range(R)]
+                       for i in range(rr)]
         else:
             zm0_in, zm0_zs, zp0_in, zp0_zs = refs[1:5]
             rest = refs[5:]
-            # tiled ESUB blocks: the adjacent R rows sit at the tile
+            # tiled ESUB blocks: the adjacent rr rows sit at the tile
             # end (zm) / start (zp)
             zm_y0 = jnp.where(at_zlo, zm0_zs[...], zm0_in[...])
             zp_y0 = jnp.where(at_zhi, zp0_zs[...], zp0_in[...])
-            zm_rows = [zm_y0[ESUB - R + i:ESUB - R + i + 1]
-                       for i in range(R)]
-            zp_rows = [zp_y0[i:i + 1] for i in range(R)]
+            zm_rows = [zm_y0[ESUB - rr + i:ESUB - rr + i + 1]
+                       for i in range(rr)]
+            zp_rows = [zp_y0[i:i + 1] for i in range(rr)]
         (ym0_in, ym0_ys, yp0_in, yp0_ys, mm_in, mm_zs, mm_ys, mp_in,
          mp_zs, mp_ys, pm_in, pm_zs, pm_ys, pp_in, pp_zs, pp_ys) = rest
         z0_ym = jnp.where(at_ylo, ym0_ys[...], ym0_in[...])
@@ -749,21 +757,21 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int):
                           jnp.where(at_zhi, pp_zs[...], pp_in[...]))
         c = main[...]
         # corner blocks are ESUB rows; the zm rows sit at block rows
-        # ESUB-R+i, the zp rows at block rows i
+        # ESUB-rr+i, the zp rows at block rows i
         rows = [
             jnp.concatenate(
-                [zm_ym[ESUB - R + i:ESUB - R + i + 1, ESUB - R:],
+                [zm_ym[ESUB - rr + i:ESUB - rr + i + 1, ESUB - rr:],
                  zm_rows[i],
-                 zm_yp[ESUB - R + i:ESUB - R + i + 1, :R]], axis=1)
-            for i in range(R)
+                 zm_yp[ESUB - rr + i:ESUB - rr + i + 1, :rr]], axis=1)
+            for i in range(rr)
         ]
         rows.append(
-            jnp.concatenate([z0_ym[:, ESUB - R:], c, z0_yp[:, :R]],
+            jnp.concatenate([z0_ym[:, ESUB - rr:], c, z0_yp[:, :rr]],
                             axis=1))
         rows.extend(
-            jnp.concatenate([zp_ym[i:i + 1, ESUB - R:], zp_rows[i],
-                             zp_yp[i:i + 1, :R]], axis=1)
-            for i in range(R))
+            jnp.concatenate([zp_ym[i:i + 1, ESUB - rr:], zp_rows[i],
+                             zp_yp[i:i + 1, :rr]], axis=1)
+            for i in range(rr))
         # x stays at full (unsharded, periodic) width: the per-
         # derivative pltpu.roll wrap (FieldData x_wrap) replaces the
         # lane-misaligned X+2R window, matching the wrap kernel
@@ -845,6 +853,116 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     for q in FIELDS:
         in_specs.append(main_spec)
         inputs.append(w[q])
+    out_shape = [jax.ShapeDtypeStruct((Z, Y, X), dtype)
+                 for _ in range(2 * nf)]
+    out_specs = [main_spec] * (2 * nf)
+
+    outs = pl.pallas_call(
+        kern,
+        grid=(nzg, nyg),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*inputs)
+    new_f = {q: outs[i] for i, q in enumerate(FIELDS)}
+    new_w = {q: outs[nf + i] for i, q in enumerate(FIELDS)}
+    return new_f, new_w
+
+
+def mhd_substep01_halo_pallas(fields: Dict[str, jnp.ndarray],
+                              slabs: Dict[str, Dict[str, jnp.ndarray]],
+                              prm, dt_phys: float,
+                              block_z: int = 8, block_y: int = 32,
+                              interpret: Optional[bool] = None
+                              ) -> Tuple[Dict[str, jnp.ndarray],
+                                         Dict[str, jnp.ndarray]]:
+    """RK3 substeps 0 AND 1 fused into one HBM pass on the multi-device
+    slab layout — the halo-path counterpart of
+    ``pallas_mhd.mhd_substep01_wrap_pallas``, so an N-chip mesh gets
+    the same two-substeps-per-pass temporal blocking as one chip.
+    alpha_0 == 0 makes the pair independent of the incoming w: each
+    block reads the 8 fields through a radius-2R window (slab-fed at
+    shard edges), evaluates rates_0 on the ring-extended region, forms
+    (f_1, w_1) in VMEM, evaluates rates_1 on the block, and writes
+    (f_2, w_2). Per-point op order matches two sequential substeps
+    exactly (ring recomputed, not approximated). One radius-2R
+    exchange replaces two radius-R exchanges: same wire bytes per
+    iteration, 2/3 the exchange latencies, one fewer full HBM
+    read+write sweep. Reference semantics: astaroth/kernels.cu:63-90
+    for substeps 0 and 1 over the astaroth.cu:552-646 exchange
+    choreography.
+
+    ``slabs[q]`` must come from ``exchange_interior_slabs(fields[q],
+    counts, rz=bz, ry=ESUB, radius_rows=2*R, y_z_extended=True)`` —
+    2R valid rows, not R (the window reaches 2R across shard edges).
+    Needs 2R <= min(bz, ESUB) (6 <= 8). Returns (new_fields, new_w).
+    """
+    from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
+    from .fd6 import FieldData
+
+    if interpret is None:
+        interpret = default_interpret()
+    assert float(RK3_ALPHA[0]) == 0.0, "pair fusion needs alpha_0 == 0"
+    R2 = 2 * R
+    Z, Y, X = fields[FIELDS[0]].shape
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
+    assert R2 <= ESUB and R2 <= bz, (R2, ESUB, bz)
+    for q in FIELDS:
+        assert slabs[q]["zlo"].shape == (bz, Y, X), slabs[q]["zlo"].shape
+        assert slabs[q]["ylo"].shape == (Z + 2 * bz, ESUB, X), \
+            slabs[q]["ylo"].shape
+    dtype = fields[FIELDS[0]].dtype
+    inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
+    beta0 = float(RK3_BETA[0])
+    alpha1 = float(RK3_ALPHA[1])
+    beta1 = float(RK3_BETA[1])
+    dt_ = float(dt_phys)
+    # rates_0 on the ring-extended region, rates_1 on the block (the
+    # same two FieldData views as the wrap pair kernel)
+    pad0 = Dim3(0, R, R)
+    int0 = Dim3(X, by + R2, bz + R2)
+    pad1 = Dim3(0, R, R)
+    int1 = Dim3(X, by, bz)
+    nzg = Z // bz
+    nyg = Y // by
+    field_specs, inputs_for_field, select_window = _mhd_window_plan(
+        Z, Y, X, bz, by, rr=R2)
+    nseg = len(field_specs)
+    nf = len(FIELDS)
+
+    main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
+
+    def kern(*refs):
+        field_refs = refs[:nseg * nf]
+        out_f = refs[nseg * nf:nseg * nf + nf]
+        out_w = refs[nseg * nf + nf:]
+        dta = jnp.dtype(dtype)
+        data0 = {}
+        for i, q in enumerate(FIELDS):
+            win = select_window(field_refs[nseg * i:nseg * (i + 1)])
+            data0[q] = FieldData(win, inv_ds, pad0, int0, x_wrap=True)
+        rates0 = mhd_rates(data0, prm, dtype)
+        data1 = {}
+        w1 = {}
+        for q in FIELDS:
+            w1[q] = dta.type(dt_) * rates0[q]          # alpha_0 == 0
+            f1 = data0[q].value + dta.type(beta0) * w1[q]
+            data1[q] = FieldData(f1, inv_ds, pad1, int1, x_wrap=True)
+        rates1 = mhd_rates(data1, prm, dtype)
+        for i, q in enumerate(FIELDS):
+            w1c = w1[q][R:R + bz, R:R + by]
+            wq = dta.type(alpha1) * w1c + dta.type(dt_) * rates1[q]
+            out_w[i][...] = wq
+            out_f[i][...] = data1[q].value + dta.type(beta1) * wq
+
+    in_specs = []
+    inputs = []
+    for q in FIELDS:
+        in_specs.extend(field_specs)
+        inputs.extend(inputs_for_field(fields[q], slabs[q]))
     out_shape = [jax.ShapeDtypeStruct((Z, Y, X), dtype)
                  for _ in range(2 * nf)]
     out_specs = [main_spec] * (2 * nf)

@@ -418,6 +418,76 @@ def make_exchange(mesh: Mesh, radius: Radius,
     return jax.jit(sm)
 
 
+def interior_slab_bytes(shard_zyx: Sequence[int], mesh_counts: Dim3,
+                        radius_rows: int, elem_size: int,
+                        y_z_extended: bool = False) -> int:
+    """Wire bytes ONE shard puts on the ICI per
+    ``exchange_interior_slabs`` call — the fast-path counterpart of
+    ``exchanged_bytes_per_sweep`` (reference byte-counter ethos:
+    src/stencil.cu:516-637). Counts the r-row transfers actually
+    ppermuted (buffer filler rows are local zeros, not traffic);
+    axes with one device are in-core wraps and cost nothing."""
+    Z, Y, X = shard_zyx
+    r = radius_rows
+    total = 0
+    if mesh_counts.z > 1:
+        total += 2 * r * Y * X * elem_size
+    if mesh_counts.y > 1:
+        zspan = Z + 2 * r if y_z_extended else Z
+        total += 2 * r * zspan * X * elem_size
+    return total
+
+
+def measure_slab_exchange_seconds(mesh: Mesh, local: Dim3, dtype,
+                                  rz: int, ry: int, radius_rows: int,
+                                  y_z_extended: bool, nfields: int = 1,
+                                  reps: int = 10) -> float:
+    """Time ONE standalone ``exchange_interior_slabs`` round for
+    ``nfields`` interior-resident fields over ``mesh`` — the honest
+    exchange-cost estimate for the fused fast paths, which perform
+    exactly this transfer inside their jitted loops where it cannot be
+    timed separately (the per-iteration exchange-stats analog of
+    src/stencil.cu:1005-1008,1174-1181). Returns seconds per exchange
+    round (all fields). Compiles a throwaway program on zeros; the
+    persistent compile cache keeps repeat calls cheap."""
+    import time as _time
+
+    from ..utils.timers import device_sync
+
+    counts = Dim3(mesh.shape["x"], mesh.shape["y"], mesh.shape["z"])
+    dim = Dim3(counts.x * local.x, counts.y * local.y,
+               counts.z * local.z)
+    sharding = jax.sharding.NamedSharding(mesh, P("z", "y", "x"))
+    # allocate the zeros SHARDED (out_shardings), never staged on one
+    # device — the global array at weak-scaled sizes would OOM the
+    # default device if materialized there first
+    make = jax.jit(lambda: jnp.zeros((dim.z, dim.y, dim.x), dtype),
+                   out_shardings=sharding)
+    fields = [make() for _ in range(nfields)]
+
+    def shard_fn(*fs):
+        outs = []
+        for f in fs:
+            s = exchange_interior_slabs(f, counts, rz=rz, ry=ry,
+                                        radius_rows=radius_rows,
+                                        y_z_extended=y_z_extended)
+            outs.append(s["zlo"])
+        return tuple(outs)
+
+    spec = P("z", "y", "x")
+    fn = jax.jit(jax.shard_map(shard_fn, mesh=mesh,
+                               in_specs=(spec,) * nfields,
+                               out_specs=(spec,) * nfields,
+                               check_vma=False))
+    out = fn(*fields)
+    device_sync(out[0])
+    t0 = _time.perf_counter()
+    for _ in range(reps):
+        out = fn(*fields)
+    device_sync(out[0])
+    return (_time.perf_counter() - t0) / reps
+
+
 def exchanged_bytes_per_sweep(shard_padded_shape_zyx: Sequence[int],
                               radius: Radius, mesh_counts: Dim3,
                               elem_size: int,

@@ -22,3 +22,11 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy interpret-mode Pallas parity tests (minutes each). "
+        "The smoke tier (ci/run_ci.sh default) runs -m 'not slow'; the "
+        "full tier and a bare pytest run everything.")

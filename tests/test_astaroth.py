@@ -30,6 +30,7 @@ def make_pair(size=(16, 16, 16), iters=2, dtype=np.float64):
 
 
 class TestDistributedParity:
+    @pytest.mark.slow
     def test_multi_matches_single_device(self):
         single, multi = make_pair()
         for q in FIELDS:
@@ -37,6 +38,7 @@ class TestDistributedParity:
             b = multi.field(q)
             np.testing.assert_allclose(a, b, rtol=0, atol=1e-12, err_msg=q)
 
+    @pytest.mark.slow
     def test_slab_method_matches(self):
         size = (16, 16, 16)
         a = Astaroth(*size, mesh_shape=(2, 2, 2), dtype=np.float64,
@@ -51,6 +53,7 @@ class TestDistributedParity:
 
 
 class TestStability:
+    @pytest.mark.slow
     @pytest.mark.parametrize("thinz,pair", [
         ("1", "0"), ("0", "0"),
         # fused substep-0+1 kernel (STENCIL_MHD_PAIR=1 opt-in), under

@@ -103,6 +103,7 @@ def test_jacobi_overlap_kernel_in_kernel_rdma():
         np.testing.assert_allclose(j.temperature(), temp, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_astaroth_overlap_matches_fused():
     from stencil_tpu.models.astaroth import Astaroth, MhdParams
 

@@ -142,6 +142,7 @@ def test_auto_partition_falls_back_to_uneven():
     dd.exchange()
 
 
+@pytest.mark.slow
 def test_uneven_astaroth_matches_single_device():
     """MHD on an uneven grid must match the 1-device run (regression:
     substeps once dropped dd.rem, silently corrupting wrap halos)."""
