@@ -59,11 +59,11 @@ def main() -> None:
 
     prm = MhdParams.from_conf(args.conf) if args.conf else MhdParams()
     ndev = len(jax.devices())
-    # halo-capable paths want the lane (x) axis unsharded; "auto" only
-    # selects them on TPU, so keep the cube-like mesh off-TPU
-    xfree = ((args.kernel == "halo"
-              or (args.kernel == "auto" and on_tpu()))
-             and not args.overlap)
+    # halo-capable paths (including the in-kernel RDMA overlap) want the
+    # lane (x) axis unsharded; "auto" only selects them on TPU, so keep
+    # the cube-like mesh off-TPU
+    xfree = (args.kernel == "halo"
+             or (args.kernel == "auto" and on_tpu()))
     mesh_shape = (dcn_mesh_shape(args, xfree)
                   or (default_mesh_shape_xfree(ndev) if xfree
                       else default_mesh_shape(ndev)))
@@ -118,6 +118,9 @@ def main() -> None:
     xstats = m.exchange_stats()
 
     if args.paraview_final:
+        # flush the interior-resident fast-path state into dd.curr —
+        # without this the dump would be the initial condition
+        m.sync_domain()
         m.dd.write_paraview(args.prefix + "final")
     print(csv_line(ndev, gx, gy, gz,
                    f"{stats.trimean():.6e}", f"{exch:.6e}",

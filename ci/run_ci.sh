@@ -36,6 +36,8 @@ smoke() { echo "-- $*"; python "$@" > /dev/null; }
   smoke jacobi3d.py --x 8 --y 8 --z 8 --iters 2 --batch 1 --fake-cpu 8 \
         --fake-slices 2 --dcn-axis z
   smoke astaroth.py --nx 8 --ny 8 --nz 8 --iters 1 --fake-cpu 8
+  smoke astaroth.py --nx 8 --ny 8 --nz 8 --iters 1 --fake-cpu 4 \
+        --kernel halo --overlap
   smoke bench_exchange.py --x 8 --y 8 --z 8 --iters 2 --fake-cpu 8
   smoke machine_info.py --fake-cpu 8
   smoke bench_qap.py --sizes 4 6

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""What binds the wrap kernel? Evidence for the 298-vs-500 gap.
+
+The round-4 measurement left a question (BASELINE.md): the temporally
+blocked pair kernel hit 298 iters/s at 512^3 against a ~500 iters/s
+HBM-traffic bound, so something other than traffic now binds. This
+script gathers the evidence on hardware in one run:
+
+1. streaming ceiling: an elementwise-copy pass over the same arrays
+   (the chip's practical HBM GB/s for this shape);
+2. depth ladder: wrap kernel at temporal depths 1/2/3/4 — if rates
+   saturate while per-iteration traffic keeps dropping, the limiter is
+   compute/issue, not HBM;
+3. per-pass model: effective GB/s of each depth vs the ceiling — a
+   depth whose per-PASS bandwidth sits well under the ceiling names
+   the in-core pipeline (compute, DMA descriptors, grid overhead) as
+   the binder; one that tracks the ceiling names traffic;
+4. optional --trace DIR: wraps one timed window in
+   ``jax.profiler.trace`` for TensorBoard-level confirmation.
+
+Prints one CSV row per experiment plus a LIMITER line with the
+verdict. Reference ethos: measure, then optimize
+(scripts/summit/512node_jacobi3d.sh).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--size", type=int, default=0,
+                    help="cube edge (default 512 on TPU, 64 off)")
+    ap.add_argument("--iters", type=int, default=0)
+    ap.add_argument("--trace", default="",
+                    help="capture a jax.profiler trace of one window "
+                         "into this directory")
+    ap.add_argument("--fake-cpu", type=int, default=0, metavar="N")
+    args = ap.parse_args()
+    from stencil_tpu.utils.config import apply_fake_cpu, enable_compile_cache
+    apply_fake_cpu(args.fake_cpu)
+    enable_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.numerics import trimean
+    from stencil_tpu.utils.timers import device_sync
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = args.size or (512 if on_tpu else 64)
+    iters = args.iters or (120 if on_tpu else 8)
+    item = 4  # f32
+
+    # --- 1. streaming ceiling: out = in + 1 over the same footprint ---
+    x = jnp.zeros((n, n, n), jnp.float32)
+    copy = jax.jit(lambda a: a + 1.0)
+    y = copy(x)
+    device_sync(y)
+    t0 = time.perf_counter()
+    reps = 20
+    for _ in range(reps):
+        y = copy(y)
+    device_sync(y)
+    dt = (time.perf_counter() - t0) / reps
+    ceiling = 2 * n * n * n * item / dt / 1e9     # read + write
+    print(f"profile_wrap,stream,{n},{ceiling:.1f} GB/s,"
+          f"{dt * 1e3:.3f} ms/pass")
+
+    # --- 2./3. depth ladder ------------------------------------------
+    rows = []
+    for depth in (1, 2, 3, 4):
+        os.environ["STENCIL_WRAP_STEPS"] = str(depth)
+        if depth == 1:
+            os.environ["STENCIL_DISABLE_WRAP2"] = "1"
+        else:
+            os.environ.pop("STENCIL_DISABLE_WRAP2", None)
+        j = Jacobi3D(n, n, n, mesh_shape=(1, 1, 1),
+                     devices=jax.devices()[:1], kernel="wrap",
+                     dtype=jnp.float32)
+        j.init()
+        j.run(depth * 2)
+        j.block()
+        window = max(iters // 4, depth)
+        window -= window % depth or 0
+        rates = []
+        for wi in range(4):
+            if args.trace and depth == 2 and wi == 0:
+                with jax.profiler.trace(args.trace):
+                    t0 = time.perf_counter()
+                    j.run(window)
+                    j.block()
+                    rates.append(window / (time.perf_counter() - t0))
+                print(f"profile_wrap,trace,{args.trace}")
+                continue
+            t0 = time.perf_counter()
+            j.run(window)
+            j.block()
+            rates.append(window / (time.perf_counter() - t0))
+        rate = trimean(rates)
+        # per-iteration HBM traffic of the depth-N kernel ~ (1 read +
+        # 1 write pass + ring refetch) / N; ring refetch small at 512
+        passes_per_iter = 2.0 / depth
+        gbs = rate * passes_per_iter * n * n * n * item / 1e9
+        rows.append((depth, rate, gbs))
+        print(f"profile_wrap,wrap,{n},depth {depth},"
+              f"{rate:.1f} iters/s,{gbs:.1f} GB/s-effective")
+        del j
+
+    # --- verdict ------------------------------------------------------
+    best = max(rows, key=lambda r: r[1])
+    sat = all(abs(rows[i][1] - rows[i - 1][1]) < 0.15 * rows[i - 1][1]
+              for i in range(2, len(rows)))
+    frac = best[2] / ceiling if ceiling else 0
+    if sat and frac < 0.7:
+        verdict = ("rate saturates across depths at {:.0%} of the "
+                   "stream ceiling: COMPUTE/ISSUE-bound — deeper "
+                   "blocking won't help; spend on in-core work (VPU "
+                   "ops per point, DMA descriptor count, grid "
+                   "shape)".format(frac))
+    elif frac >= 0.7:
+        verdict = ("best depth runs at {:.0%} of the stream ceiling: "
+                   "HBM-TRAFFIC-bound — deeper temporal blocking or "
+                   "bf16 still pays".format(frac))
+    else:
+        verdict = ("rates still rising with depth at {:.0%} of "
+                   "ceiling: mixed — keep laddering".format(frac))
+    print(f"profile_wrap,LIMITER,depth {best[0]} best "
+          f"({best[1]:.1f} iters/s),{verdict}")
+
+
+if __name__ == "__main__":
+    main()

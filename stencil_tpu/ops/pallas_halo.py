@@ -708,13 +708,17 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
                    f, zhi, ylo,        # zp_ym
                    f, zhi, yhi])       # zp_yp
 
-    def select_window(refs) -> jnp.ndarray:
+    def select_window(refs, kz=None, ky=None) -> jnp.ndarray:
         """Assemble one field's (bz+2rr, by+2rr, X) stencil window from
         the segment refs, selecting slab sources at shard edges;
         x wraps per-derivative via pltpu.roll (x unsharded => in-core
-        wrap IS the global periodic wrap)."""
-        kz = pl.program_id(0)
-        ky = pl.program_id(1)
+        wrap IS the global periodic wrap). ``kz``/``ky`` override the
+        block coordinates for kernels whose grid is remapped onto a
+        subset of blocks (the overlap fix-up strips)."""
+        if kz is None:
+            kz = pl.program_id(0)
+        if ky is None:
+            ky = pl.program_id(1)
         at_zlo = kz == 0
         at_zhi = kz == nzg - 1
         at_ylo = ky == 0

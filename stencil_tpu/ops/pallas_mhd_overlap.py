@@ -1,0 +1,523 @@
+"""In-kernel RDMA comm/compute overlap for the MHD substeps.
+
+The reference earns its overlap machinery in the astaroth app: every RK
+substep runs interior-launch / exchange / exterior-launch over 26
+per-region streams (reference: astaroth/astaroth.cu:552-646,476-486;
+polled transports src/stencil.cu:1081-1118). This module is the TPU
+re-creation for the multi-device slab layout, following the proven
+Jacobi pattern (ops/pallas_overlap.py) at MHD scale:
+
+* ``mhd_substep_overlap_pallas`` — ONE grid kernel per substep that
+  (a) barriers with its mesh neighbors, (b) issues the radius-R slab
+  RDMA for all 8 fields (z faces + the z-extended y faces, corner
+  ride-along pieces fired as soon as the z slabs land), and (c) streams
+  (bz, by, X) blocks through the SAME fused ``mhd_rates`` compute as
+  the halo megakernel while the DMAs fly — reading CLAMPED in-shard
+  windows, so blocks at shard edges hold placeholder values; the landed
+  slab buffers are kernel outputs in the standard
+  ``exchange_interior_slabs`` layout contract.
+* ``mhd_substep_fixup_pallas`` — thin strip kernels (grids remapped
+  onto only the z-edge / y-edge block rows, outputs aliased onto the
+  overlap kernel's results) that recompute the edge blocks from the
+  landed slabs via the halo kernel's own window plan — the exterior
+  launch of the reference choreography.
+* ``mhd_substep_overlap`` — the per-substep driver composing the two.
+
+Even grids, x unsharded (the slab-layout contract); numerics match
+``mhd_substep_halo_pallas`` exactly (same window values, same update).
+The whole choreography runs under the Pallas TPU interpreter off-TPU
+(interpreted inter-device DMA), which is how the multi-chip tests and
+the race detector exercise it on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..geometry import Dim3
+from .pallas_halo import ESUB, R, _mhd_window_plan, mhd_halo_blocks
+from .pallas_stencil import default_interpret, on_tpu
+
+# collective_id namespace distinct from pallas_overlap (21) and
+# pallas_exchange
+_MHD_OVERLAP_COLLECTIVE_ID = 23
+
+
+def _interpret_mode():
+    return False if on_tpu() else pltpu.InterpretParams()
+
+
+def _clamped_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
+                         rr: int = R):
+    """(specs, assemble) for one field's (bz+2rr, by+2rr, X) window
+    built from IN-SHARD data only, neighbor segments clamped at the
+    shard boundary — the overlap kernel's interior compute reads this
+    while the halo RDMA is in flight, so edge blocks produce
+    placeholder values (the fix-up strips rewrite them). Mirrors the
+    in-shard arm of ``pallas_halo._mhd_window_plan``."""
+    bzb = bz // ESUB
+    byb = by // ESUB
+    nzb8 = Z // ESUB
+    nyb8 = Y // ESUB
+
+    def clampy(k):
+        return jnp.maximum(k * byb - 1, 0)
+
+    def clampY(k):
+        return jnp.minimum(k * byb + byb, nyb8 - 1)
+
+    def clampz(k):
+        return jnp.maximum(k * bzb - 1, 0)
+
+    def clampZ(k):
+        return jnp.minimum(k * bzb + bzb, nzb8 - 1)
+
+    specs = [pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))]
+    for o in range(-rr, 0):        # z-minus single rows, clamped
+        specs.append(pl.BlockSpec(
+            (1, by, X),
+            lambda kz, ky, o=o: (jnp.clip(kz * bz + o, 0, Z - 1), ky, 0)))
+    for j in range(rr):            # z-plus single rows, clamped
+        specs.append(pl.BlockSpec(
+            (1, by, X),
+            lambda kz, ky, j=j: (jnp.clip(kz * bz + bz + j, 0, Z - 1),
+                                 ky, 0)))
+    specs += [
+        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz, clampy(ky), 0)),
+        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz, clampY(ky), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (clampz(kz), clampy(ky), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (clampz(kz), clampY(ky), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (clampZ(kz), clampy(ky), 0)),
+        pl.BlockSpec((ESUB, ESUB, X),
+                     lambda kz, ky: (clampZ(kz), clampY(ky), 0)),
+    ]
+
+    def assemble(refs) -> jnp.ndarray:
+        main = refs[0]
+        zm = refs[1:1 + rr]
+        zp = refs[1 + rr:1 + 2 * rr]
+        ym, yp, mm, mp, pm, pp = refs[1 + 2 * rr:]
+        rows = [
+            jnp.concatenate(
+                [mm[ESUB - rr + i:ESUB - rr + i + 1, ESUB - rr:],
+                 zm[i][...],
+                 mp[ESUB - rr + i:ESUB - rr + i + 1, :rr]], axis=1)
+            for i in range(rr)
+        ]
+        rows.append(jnp.concatenate(
+            [ym[:, ESUB - rr:], main[...], yp[:, :rr]], axis=1))
+        rows.extend(
+            jnp.concatenate([pm[i:i + 1, ESUB - rr:], zp[i][...],
+                             pp[i:i + 1, :rr]], axis=1)
+            for i in range(rr))
+        return jnp.concatenate(rows, axis=0)
+
+    return specs, assemble
+
+
+def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
+                               w: Dict[str, jnp.ndarray],
+                               s: int, prm, dt_phys: float,
+                               counts: Dim3,
+                               block_z: int = 8, block_y: int = 32,
+                               interpret: Optional[object] = None):
+    """One overlapped RK3 MHD substep on interior-resident (Z, Y, X)
+    shards: slab RDMA issued from inside the kernel, the fused
+    ``mhd_rates`` interior compute running behind the in-flight DMAs.
+    Call inside ``shard_map`` over mesh axes ('x','y','z') with x
+    unsharded. Returns ``(new_fields, new_w, slabs)`` where edge
+    blocks of the f/w outputs are PLACEHOLDERS (clamped windows) and
+    ``slabs[q]`` holds the landed halo data in the
+    ``exchange_interior_slabs(rz=bz, ry=ESUB, radius_rows=R,
+    y_z_extended=True)`` layout — feed both to
+    ``mhd_substep_fixup_pallas``. Reference choreography:
+    astaroth/astaroth.cu:552-646 (interior launch + transports in
+    flight), compressed into one kernel.
+    """
+    from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
+    from .fd6 import FieldData
+
+    if interpret is None:
+        interpret = _interpret_mode()
+    assert counts.x == 1, "x (lane) axis must not be mesh-sharded"
+    Z, Y, X = fields[FIELDS[0]].shape
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
+    assert R <= min(bz, ESUB)
+    dtype = fields[FIELDS[0]].dtype
+    dta = jnp.dtype(dtype)
+    inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
+    alpha = float(RK3_ALPHA[s])
+    beta = float(RK3_BETA[s])
+    dt_ = float(dt_phys)
+    pad_lo = Dim3(0, R, R)
+    interior = Dim3(X, by, bz)
+    nzg = Z // bz
+    nyg = Y // by
+    mz = counts.z
+    my = counts.y
+    nf = len(FIELDS)
+    zext = Z + 2 * bz
+
+    field_specs, assemble = _clamped_window_plan(Z, Y, X, bz, by, rr=R)
+    nseg = len(field_specs)
+    main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
+
+    def kern(*refs):
+        field_refs = refs[:nseg * nf]
+        w_refs = refs[nseg * nf:nseg * nf + nf]
+        any_refs = refs[nseg * nf + nf:nseg * nf + 2 * nf]
+        outs = refs[nseg * nf + 2 * nf:-2]
+        out_f = outs[:nf]
+        out_w = outs[nf:2 * nf]
+        zlo_o = outs[2 * nf:3 * nf]
+        zhi_o = outs[3 * nf:4 * nf]
+        ylo_o = outs[4 * nf:5 * nf]
+        yhi_o = outs[5 * nf:6 * nf]
+        send = refs[-2]
+        recv = refs[-1]
+        kz = pl.program_id(0)
+        ky = pl.program_id(1)
+        first = jnp.logical_and(kz == 0, ky == 0)
+        last = jnp.logical_and(kz == nzg - 1, ky == nyg - 1)
+
+        def nbr(axis, n, up):
+            me = lax.axis_index(axis)
+            d = (lax.rem(me + 1, jnp.int32(n)) if up
+                 else lax.rem(me + jnp.int32(n) - 1, jnp.int32(n)))
+            return {axis: d}
+
+        def z_copies(i):
+            """slots 0 (zlo to z-up) / 1 (zhi to z-down); local wrap
+            copies on a 1-count axis (sem: recv only)."""
+            f_any = any_refs[i]
+            if mz > 1:
+                return [
+                    pltpu.make_async_remote_copy(
+                        src_ref=f_any.at[Z - R:Z],
+                        dst_ref=zlo_o[i].at[bz - R:bz],
+                        send_sem=send.at[i, 0], recv_sem=recv.at[i, 0],
+                        device_id=nbr("z", mz, True)),
+                    pltpu.make_async_remote_copy(
+                        src_ref=f_any.at[0:R],
+                        dst_ref=zhi_o[i].at[0:R],
+                        send_sem=send.at[i, 1], recv_sem=recv.at[i, 1],
+                        device_id=nbr("z", mz, False)),
+                ]
+            return [
+                pltpu.make_async_copy(f_any.at[Z - R:Z],
+                                      zlo_o[i].at[bz - R:bz],
+                                      recv.at[i, 0]),
+                pltpu.make_async_copy(f_any.at[0:R], zhi_o[i].at[0:R],
+                                      recv.at[i, 1]),
+            ]
+
+        def y_interior_copies(i):
+            """slots 2/3: the Z interior rows of the z-extended y
+            faces (no z-slab dependency — fired at entry)."""
+            f_any = any_refs[i]
+            if my > 1:
+                return [
+                    pltpu.make_async_remote_copy(
+                        src_ref=f_any.at[:, Y - R:Y],
+                        dst_ref=ylo_o[i].at[bz:bz + Z, ESUB - R:ESUB],
+                        send_sem=send.at[i, 2], recv_sem=recv.at[i, 2],
+                        device_id=nbr("y", my, True)),
+                    pltpu.make_async_remote_copy(
+                        src_ref=f_any.at[:, 0:R],
+                        dst_ref=yhi_o[i].at[bz:bz + Z, 0:R],
+                        send_sem=send.at[i, 3], recv_sem=recv.at[i, 3],
+                        device_id=nbr("y", my, False)),
+                ]
+            return [
+                pltpu.make_async_copy(f_any.at[:, Y - R:Y],
+                                      ylo_o[i].at[bz:bz + Z,
+                                                  ESUB - R:ESUB],
+                                      recv.at[i, 2]),
+                pltpu.make_async_copy(f_any.at[:, 0:R],
+                                      yhi_o[i].at[bz:bz + Z, 0:R],
+                                      recv.at[i, 3]),
+            ]
+
+        def y_corner_copies(i):
+            """slots 4-7: the R-row yz corner pieces of the y faces,
+            sourced from MY landed z slabs (hence fired only after the
+            slot-0/1 recv waits) — the corner ride-along of the
+            sequential-sweep rule, as explicit messages."""
+            srcs = [
+                (zlo_o[i].at[bz - R:bz, Y - R:Y],
+                 lambda r: ylo_o[i].at[bz - R:bz, ESUB - R:ESUB], True, 4),
+                (zhi_o[i].at[0:R, Y - R:Y],
+                 lambda r: ylo_o[i].at[bz + Z:bz + Z + R, ESUB - R:ESUB],
+                 True, 5),
+                (zlo_o[i].at[bz - R:bz, 0:R],
+                 lambda r: yhi_o[i].at[bz - R:bz, 0:R], False, 6),
+                (zhi_o[i].at[0:R, 0:R],
+                 lambda r: yhi_o[i].at[bz + Z:bz + Z + R, 0:R], False, 7),
+            ]
+            out = []
+            for src, dstf, up, slot in srcs:
+                if my > 1:
+                    out.append(pltpu.make_async_remote_copy(
+                        src_ref=src, dst_ref=dstf(None),
+                        send_sem=send.at[i, slot],
+                        recv_sem=recv.at[i, slot],
+                        device_id=nbr("y", my, up)))
+                else:
+                    out.append(pltpu.make_async_copy(src, dstf(None),
+                                                     recv.at[i, slot]))
+            return out
+
+        # ---- phase A (first grid step): rendezvous, then fire the z
+        # slabs and the y interior rows for all fields
+        @pl.when(first)
+        def _():
+            n_remote_axes = (1 if mz > 1 else 0) + (1 if my > 1 else 0)
+            if n_remote_axes:
+                bsem = pltpu.get_barrier_semaphore()
+                if mz > 1:
+                    pltpu.semaphore_signal(bsem, inc=1,
+                                           device_id=nbr("z", mz, True))
+                    pltpu.semaphore_signal(bsem, inc=1,
+                                           device_id=nbr("z", mz, False))
+                if my > 1:
+                    pltpu.semaphore_signal(bsem, inc=1,
+                                           device_id=nbr("y", my, True))
+                    pltpu.semaphore_signal(bsem, inc=1,
+                                           device_id=nbr("y", my, False))
+                pltpu.semaphore_wait(bsem, 2 * n_remote_axes)
+            for i in range(nf):
+                for c in z_copies(i) + y_interior_copies(i):
+                    c.start()
+
+        # ---- interior compute for this block, behind the DMAs
+        data = {}
+        for i, q in enumerate(FIELDS):
+            win = assemble(field_refs[nseg * i:nseg * (i + 1)])
+            data[q] = FieldData(win, inv_ds, pad_lo, interior,
+                                x_wrap=True)
+        rates = mhd_rates(data, prm, dtype)
+        for i, q in enumerate(FIELDS):
+            wq = dta.type(alpha) * w_refs[i][...] + dta.type(dt_) * rates[q]
+            out_w[i][...] = wq
+            out_f[i][...] = data[q].value + dta.type(beta) * wq
+
+        # ---- phase B (still the first grid step, after one block of
+        # compute): z slabs have landed — fire the corner pieces
+        @pl.when(first)
+        def _():
+            for i in range(nf):
+                for c in z_copies(i):
+                    c.wait()
+                for c in y_corner_copies(i):
+                    c.start()
+
+        # ---- phase C (last grid step): drain everything else
+        @pl.when(last)
+        def _():
+            for i in range(nf):
+                for c in y_interior_copies(i) + y_corner_copies(i):
+                    c.wait()
+
+    in_specs = []
+    inputs = []
+    for q in FIELDS:
+        in_specs.extend(field_specs)
+        inputs.extend([fields[q]] * nseg)
+    for q in FIELDS:
+        in_specs.append(main_spec)
+        inputs.append(w[q])
+    for q in FIELDS:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        inputs.append(fields[q])
+
+    out_shape = ([jax.ShapeDtypeStruct((Z, Y, X), dtype)] * (2 * nf)
+                 + [jax.ShapeDtypeStruct((bz, Y, X), dtype)] * (2 * nf)
+                 + [jax.ShapeDtypeStruct((zext, ESUB, X), dtype)]
+                 * (2 * nf))
+    out_specs = ([main_spec] * (2 * nf)
+                 + [pl.BlockSpec(memory_space=pl.ANY)] * (4 * nf))
+
+    outs = pl.pallas_call(
+        kern,
+        grid=(nzg, nyg),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.SemaphoreType.DMA((nf, 8)),
+                        pltpu.SemaphoreType.DMA((nf, 8))],
+        compiler_params=pltpu.CompilerParams(
+            collective_id=_MHD_OVERLAP_COLLECTIVE_ID,
+            has_side_effects=True,
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*inputs)
+    new_f = {q: outs[i] for i, q in enumerate(FIELDS)}
+    new_w = {q: outs[nf + i] for i, q in enumerate(FIELDS)}
+    slabs = {}
+    for i, q in enumerate(FIELDS):
+        slabs[q] = {"zlo": outs[2 * nf + i], "zhi": outs[3 * nf + i],
+                    "ylo": outs[4 * nf + i], "yhi": outs[5 * nf + i]}
+    return new_f, new_w, slabs
+
+
+def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
+                             w: Dict[str, jnp.ndarray],
+                             f_partial: Dict[str, jnp.ndarray],
+                             w_partial: Dict[str, jnp.ndarray],
+                             slabs: Dict[str, Dict[str, jnp.ndarray]],
+                             s: int, prm, dt_phys: float, strip: str,
+                             block_z: int = 8, block_y: int = 32,
+                             interpret: Optional[bool] = None
+                             ) -> Tuple[Dict[str, jnp.ndarray],
+                                        Dict[str, jnp.ndarray]]:
+    """Exterior pass of the overlapped substep: recompute the shard-edge
+    blocks from the landed slabs, writing into ``f_partial``/
+    ``w_partial`` via output aliasing (unvisited blocks keep the
+    overlap kernel's interior results). ``strip`` selects the z-edge
+    block rows ("z": kz in {0, nzg-1}, all ky) or the y-edge columns
+    excluding those rows ("y": ky in {0, nyg-1}, kz in [1, nzg-1));
+    together they cover exactly the blocks whose clamped windows were
+    placeholders. Window values come from the halo kernel's own
+    ``_mhd_window_plan`` (same slab selection → numerics identical to
+    ``mhd_substep_halo_pallas``). ``fields``/``w`` are the PRE-substep
+    state. Reference: the exterior kernel launches of
+    astaroth/astaroth.cu:552-646."""
+    from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
+    from .fd6 import FieldData
+
+    if interpret is None:
+        interpret = default_interpret()
+    Z, Y, X = fields[FIELDS[0]].shape
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
+    nzg = Z // bz
+    nyg = Y // by
+    if strip == "z":
+        grid = (min(nzg, 2), nyg)
+
+        def remap(i, j):
+            return jnp.where(i == 0, 0, nzg - 1), j
+    else:
+        assert nzg > 2, "y strip only exists between the z strips"
+        grid = (nzg - 2, min(nyg, 2))
+
+        def remap(i, j):
+            return i + 1, jnp.where(j == 0, 0, nyg - 1)
+
+    dtype = fields[FIELDS[0]].dtype
+    dta = jnp.dtype(dtype)
+    inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
+    alpha = float(RK3_ALPHA[s])
+    beta = float(RK3_BETA[s])
+    dt_ = float(dt_phys)
+    pad_lo = Dim3(0, R, R)
+    interior = Dim3(X, by, bz)
+    nf = len(FIELDS)
+
+    plan_specs, inputs_for_field, select_window = _mhd_window_plan(
+        Z, Y, X, bz, by, rr=R)
+    nseg = len(plan_specs)
+
+    def rm(spec):
+        return pl.BlockSpec(
+            spec.block_shape,
+            functools.partial(lambda i, j, m: m(*remap(i, j)),
+                              m=spec.index_map))
+
+    field_specs = [rm(sp) for sp in plan_specs]
+    main_spec = rm(pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)))
+
+    def kern(*refs):
+        field_refs = refs[:nseg * nf]
+        w_refs = refs[nseg * nf:nseg * nf + nf]
+        # aliased f_partial/w_partial inputs follow; never read in-kern
+        out_f = refs[nseg * nf + 3 * nf:nseg * nf + 4 * nf]
+        out_w = refs[nseg * nf + 4 * nf:]
+        kz, ky = remap(pl.program_id(0), pl.program_id(1))
+        data = {}
+        for i, q in enumerate(FIELDS):
+            win = select_window(field_refs[nseg * i:nseg * (i + 1)],
+                                kz=kz, ky=ky)
+            data[q] = FieldData(win, inv_ds, pad_lo, interior,
+                                x_wrap=True)
+        rates = mhd_rates(data, prm, dtype)
+        for i, q in enumerate(FIELDS):
+            wq = dta.type(alpha) * w_refs[i][...] + dta.type(dt_) * rates[q]
+            out_w[i][...] = wq
+            out_f[i][...] = data[q].value + dta.type(beta) * wq
+
+    in_specs = []
+    inputs = []
+    for q in FIELDS:
+        in_specs.extend(field_specs)
+        inputs.extend(inputs_for_field(fields[q], slabs[q]))
+    for q in FIELDS:
+        in_specs.append(main_spec)
+        inputs.append(w[q])
+    alias_base = len(inputs)
+    for q in FIELDS:
+        in_specs.append(main_spec)
+        inputs.append(f_partial[q])
+    for q in FIELDS:
+        in_specs.append(main_spec)
+        inputs.append(w_partial[q])
+
+    out_shape = [jax.ShapeDtypeStruct((Z, Y, X), dtype)
+                 for _ in range(2 * nf)]
+    out_specs = [main_spec] * (2 * nf)
+    aliases = {alias_base + i: i for i in range(2 * nf)}
+
+    outs = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*inputs)
+    new_f = {q: outs[i] for i, q in enumerate(FIELDS)}
+    new_w = {q: outs[nf + i] for i, q in enumerate(FIELDS)}
+    return new_f, new_w
+
+
+def mhd_substep_overlap(fields: Dict[str, jnp.ndarray],
+                        w: Dict[str, jnp.ndarray],
+                        s: int, prm, dt_phys: float, counts: Dim3,
+                        block_z: int = 8, block_y: int = 32,
+                        interpret: Optional[object] = None
+                        ) -> Tuple[Dict[str, jnp.ndarray],
+                                   Dict[str, jnp.ndarray]]:
+    """One full overlapped substep: RDMA-overlap interior kernel, then
+    the z- and y-strip exterior fix-ups. Drop-in equivalent of an
+    exchange + ``mhd_substep_halo_pallas`` call (same numerics), with
+    the exchange hidden behind the interior compute."""
+    from ..models.astaroth import FIELDS
+
+    Z, Y, _ = fields[FIELDS[0]].shape
+    bz, _by = mhd_halo_blocks(Z, Y, block_z, block_y)
+    nzg = Z // bz
+    fix_interp = (None if interpret is None
+                  else not isinstance(interpret, bool) or interpret)
+    f1, w1, slabs = mhd_substep_overlap_pallas(
+        fields, w, s, prm, dt_phys, counts, block_z=block_z,
+        block_y=block_y, interpret=interpret)
+    f1, w1 = mhd_substep_fixup_pallas(
+        fields, w, f1, w1, slabs, s, prm, dt_phys, "z",
+        block_z=block_z, block_y=block_y, interpret=fix_interp)
+    if nzg > 2:
+        f1, w1 = mhd_substep_fixup_pallas(
+            fields, w, f1, w1, slabs, s, prm, dt_phys, "y",
+            block_z=block_z, block_y=block_y, interpret=fix_interp)
+    return f1, w1

@@ -471,13 +471,17 @@ def measure_slab_exchange_seconds(mesh: Mesh, local: Dim3, dtype,
             s = exchange_interior_slabs(f, counts, rz=rz, ry=ry,
                                         radius_rows=radius_rows,
                                         y_z_extended=y_z_extended)
-            outs.append(s["zlo"])
+            # ALL four slabs are outputs: returning only zlo would let
+            # XLA dead-code-eliminate the y-axis ppermutes (zlo depends
+            # on the z shift alone) and the timing would silently drop
+            # the y-face traffic
+            outs.extend([s["zlo"], s["zhi"], s["ylo"], s["yhi"]])
         return tuple(outs)
 
     spec = P("z", "y", "x")
     fn = jax.jit(jax.shard_map(shard_fn, mesh=mesh,
                                in_specs=(spec,) * nfields,
-                               out_specs=(spec,) * nfields,
+                               out_specs=(spec,) * (4 * nfields),
                                check_vma=False))
     out = fn(*fields)
     device_sync(out[0])

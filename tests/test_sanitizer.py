@@ -112,6 +112,44 @@ def test_rdma_exchange_race_free():
     np.testing.assert_array_equal(out[1:3, 1:3, 1:3], a[1:3, 1:3, 1:3])
 
 
+def test_mhd_overlap_kernel_race_free():
+    """The MHD in-kernel RDMA overlap substep (barrier + two-phase slab
+    DMA concurrent with the fused mhd_rates block pipeline + aliased
+    strip fix-ups) under the race detector on a (1,2,2) mesh."""
+    from stencil_tpu.models.astaroth import FIELDS, MhdParams
+    from stencil_tpu.ops.pallas_mhd_overlap import mhd_substep_overlap
+
+    mesh = make_mesh((1, 2, 2), jax.devices()[:4])
+    counts = Dim3(1, 2, 2)
+    prm = MhdParams()
+    params = pltpu.InterpretParams(detect_races=True)
+    gz, gy, gx = 16, 16, 8          # local (8, 8, 8): one block/shard
+
+    def shard(fields, w):
+        f, wk = mhd_substep_overlap(fields, w, 0, prm, prm.dt, counts,
+                                    interpret=params)
+        return f, wk
+
+    spec = P("z", "y", "x")
+    fspec = {q: spec for q in FIELDS}
+    sm = jax.jit(jax.shard_map(shard, mesh=mesh, in_specs=(fspec, fspec),
+                               out_specs=(fspec, fspec), check_vma=False))
+    rng = np.random.default_rng(11)
+    sh = NamedSharding(mesh, spec)
+    fields = {q: jax.device_put(
+        jnp.asarray(rng.random((gz, gy, gx)).astype(np.float32) * 0.1),
+        sh) for q in FIELDS}
+    w = {q: jax.device_put(jnp.zeros((gz, gy, gx), np.float32), sh)
+         for q in FIELDS}
+
+    out, (raced, text) = _capture_races(
+        lambda: jax.tree.map(np.asarray, sm(fields, w)))
+    assert not raced, text[:2000]
+    f_out, _ = out
+    for q in FIELDS:
+        assert np.all(np.isfinite(f_out[q])), q
+
+
 def test_overlap_kernel_race_free():
     """The in-kernel RDMA overlap step (remote slab DMA concurrent with
     the interior compute pipeline) under the race detector."""
