@@ -40,10 +40,16 @@ def main() -> None:
     samples = max(args.iters // args.batch, 1)
     stats = timed_samples(lambda: j.run(args.batch), j.block, samples)
     b = j.dd.exchange_bytes_per_axis()
+    # honest exchange estimate for the built path (fast paths bypass
+    # dd.exchange(); see Jacobi3D.exchange_stats)
+    xstats = j.exchange_stats()
+    ex_s = j.measure_exchange_seconds()
     print(csv_line("jacobi3d_strong", methods, ndev,
                    args.x, args.y, args.z, b["x"], b["y"], b["z"],
                    f"{stats.min() / args.batch:.6e}",
-                   f"{stats.trimean() / args.batch:.6e}"))
+                   f"{stats.trimean() / args.batch:.6e}",
+                   xstats["path"], int(xstats["bytes_per_iteration"]),
+                   f"{ex_s:.6e}"))
 
 
 if __name__ == "__main__":

@@ -63,6 +63,11 @@ for b in "8,32" "8,64" "16,32"; do
         ${WD[@]+"${WD[@]}"} \
         --iters "$([ "$SMOKE" = 1 ] && echo 2 || echo 10)" "${FAKE[@]}"
 done
+# fused RK substep-0+1 pair, wrap + halo paths (A/B vs the rows above)
+run kernels_mhd_pair.csv env STENCIL_MHD_PAIR=1 \
+    python scripts/bench_kernels.py --model mhd --kernels wrap,halo \
+    ${WD[@]+"${WD[@]}"} \
+    --iters "$([ "$SMOKE" = 1 ] && echo 2 || echo 10)" "${FAKE[@]}"
 
 # 4. exchange microbenchmarks (BASELINE.md configs 2/4 analogs)
 ( cd apps
@@ -74,6 +79,11 @@ done
   run bench_methods.csv python bench_methods.py \
       --x "$EX" --y "$EX" --z "$EX" --iters "$EI" "${FAKE[@]}"
   run bench_qap.csv python bench_qap.py --sizes 4 6 8
+  # the fused fast paths' transfer standalone (same byte accounting as
+  # the models' exchange_stats)
+  run exchange_slabs.csv python exchange_weak.py \
+      --x "$EX" --y "$EX" --z "$EX" --radius 3 --iters "$EI" \
+      --interior-slabs "${FAKE[@]}"
 )
 
 # 5. apps at reference configs (weak scaling on whatever devices exist)

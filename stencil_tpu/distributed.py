@@ -193,11 +193,12 @@ class DistributedDomain:
         self.local_size = Dim3(*(div_ceil(self.size[a], dim[a])
                                  for a in range(3)))
         self.rem = self.size % dim
-        if self.rem != Dim3(0, 0, 0) and pick_method(self.methods) != \
-                Method.PpermuteSlab:
+        if self.rem != Dim3(0, 0, 0) and pick_method(self.methods) not in \
+                (Method.PpermuteSlab, Method.PpermutePacked):
             raise NotImplementedError(
                 f"grid {self.size} over mesh {dim} has uneven (+-1) "
-                f"subdomains, supported only by Method.PpermuteSlab")
+                f"subdomains, supported only by the PpermuteSlab and "
+                f"PpermutePacked methods")
         min_local = [self.local_size[a] - (1 if self.rem[a] else 0)
                      for a in range(3)]
         if any(m < 1 for m in min_local):

@@ -555,13 +555,13 @@ def mhd_halo_blocks(Z: int, Y: int, block_z: int = 8,
 
 
 def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
-                     rr: int = R):
+                     rr: int = R, slabless: bool = False):
     """One closed unit (specs, inputs_for_field, select_window) for the
     MHD halo kernel's per-field stencil neighborhood on the slab
     layout — the spec list, the matching input ordering, and the
-    in-kernel window assembly share one layout decision, so they
-    cannot desynchronize (the positional ref-slicing contract lives
-    only here). Mirrors ops/pallas_mhd._window_plan for the wrap
+    in-kernel window assembly share one layout decision (each segment
+    is registered once with its source kind and index), so they cannot
+    desynchronize. Mirrors ops/pallas_mhd._window_plan for the wrap
     kernel.
 
     ``rr`` is the window radius: R for one substep, 2R for the fused
@@ -569,6 +569,13 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
     are one ESUB tile wide) and rr <= bz (z slabs hold bz rows); the
     slabs must carry rr valid rows (``radius_rows=rr`` at the
     exchange).
+
+    ``slabless=True`` emits only the clamped IN-SHARD segments (no
+    slab arrays exist yet): shard-edge blocks then assemble windows
+    from clamped reads and produce placeholder values — the interior
+    compute of the RDMA overlap kernel (ops/pallas_mhd_overlap.py),
+    whose fix-up strips rewrite those blocks from the landed slabs
+    using this same plan with slabs.
 
     Segment grid: z in {-,0,+} x y in {-,0,+}; edge/corner segments
     carry one spec per possible source (in-shard / z slab / y slab)
@@ -613,100 +620,94 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
     def clampY(k):            # y-plus
         return jnp.minimum(k * byb + byb, nyb8 - 1)
 
-    main = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
-    specs = [main]
+    specs = []
+    kinds = []   # parallel to specs: "f" | "zlo" | "zhi" | "ylo" | "yhi"
+
+    def add(kind, shape, imap):
+        """Register one segment spec and return its index; slab
+        segments vanish in slabless mode (index None — the selectors
+        then keep the clamped in-shard value, which is exactly the
+        overlap kernel's placeholder contract)."""
+        if slabless and kind != "f":
+            return None
+        specs.append(pl.BlockSpec(shape, imap))
+        kinds.append(kind)
+        return len(specs) - 1
+
+    i_main = add("f", (bz, by, X), lambda kz, ky: (kz, ky, 0))
     if thin:
         # zm_y0: exact-radius single rows z = kz*bz + o, o in -rr..-1
-        for o in range(-rr, 0):
-            specs.append(pl.BlockSpec(
-                (1, by, X),
-                lambda kz, ky, o=o: (jnp.clip(kz * bz + o, 0, Z - 1),
-                                     ky, 0)))
-        for o in range(-rr, 0):  # zlo slab rows bz+o, fetched at kz == 0
-            specs.append(pl.BlockSpec(
-                (1, by, X),
-                lambda kz, ky, o=o: (bz + o, jnp.where(kz == 0, ky, 0),
-                                     0)))
-        # zp_y0: single rows z = kz*bz + bz + j, j in 0..rr-1
-        for j in range(rr):
-            specs.append(pl.BlockSpec(
-                (1, by, X),
-                lambda kz, ky, j=j: (jnp.clip(kz * bz + bz + j, 0, Z - 1),
-                                     ky, 0)))
-        for j in range(rr):     # zhi slab rows j, fetched at kz == nzg-1
-            specs.append(pl.BlockSpec(
-                (1, by, X),
-                lambda kz, ky, j=j: (j, jnp.where(kz == nzg - 1, ky, 0),
-                                     0)))
+        # (in-shard clamped), with zlo slab rows bz+o fetched at kz==0;
+        # zp_y0: rows kz*bz + bz + j with zhi slab rows j at the z end
+        i_zm_in = [add("f", (1, by, X),
+                       lambda kz, ky, o=o: (jnp.clip(kz * bz + o, 0,
+                                                     Z - 1), ky, 0))
+                   for o in range(-rr, 0)]
+        i_zm_zs = [add("zlo", (1, by, X),
+                       lambda kz, ky, o=o: (bz + o,
+                                            jnp.where(kz == 0, ky, 0),
+                                            0))
+                   for o in range(-rr, 0)]
+        i_zp_in = [add("f", (1, by, X),
+                       lambda kz, ky, j=j: (jnp.clip(kz * bz + bz + j,
+                                                     0, Z - 1), ky, 0))
+                   for j in range(rr)]
+        i_zp_zs = [add("zhi", (1, by, X),
+                       lambda kz, ky, j=j: (j, jnp.where(kz == nzg - 1,
+                                                         ky, 0), 0))
+                   for j in range(rr)]
     else:
-        specs += [
-            pl.BlockSpec((ESUB, by, X),
-                         lambda kz, ky: (clampz(kz), ky, 0)),
-            pl.BlockSpec((ESUB, by, X),
-                         lambda kz, ky: (bzb - 1,
-                                         jnp.where(kz == 0, ky, 0), 0)),
-            pl.BlockSpec((ESUB, by, X),
-                         lambda kz, ky: (clampZ(kz), ky, 0)),
-            pl.BlockSpec((ESUB, by, X),
-                         lambda kz, ky: (0, jnp.where(kz == nzg - 1,
-                                                      ky, 0), 0)),
-        ]
-    specs += [
-        # z0_ym: rows y in [ky*by-8, ky*by)
-        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz, clampy(ky), 0)),
-        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0)),
-        # z0_yp
-        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz, clampY(ky), 0)),
-        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0)),
-        # zm_ym corner (8, 8, X)
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (clampz(kz), clampy(ky), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (bzb - 1,
-                                     jnp.where(kz == 0, clampy(ky), 0), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: ((kz + 1) * bzb - 1, 0, 0)),
-        # zm_yp
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (clampz(kz), clampY(ky), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (bzb - 1,
-                                     jnp.where(kz == 0, clampY(ky), 0), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: ((kz + 1) * bzb - 1, 0, 0)),
-        # zp_ym
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (clampZ(kz), clampy(ky), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (0, jnp.where(kz == nzg - 1,
-                                                  clampy(ky), 0), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: ((kz + 2) * bzb, 0, 0)),
-        # zp_yp
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (clampZ(kz), clampY(ky), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (0, jnp.where(kz == nzg - 1,
-                                                  clampY(ky), 0), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: ((kz + 2) * bzb, 0, 0)),
-    ]
+        i_zm0_in = add("f", (ESUB, by, X),
+                       lambda kz, ky: (clampz(kz), ky, 0))
+        i_zm0_zs = add("zlo", (ESUB, by, X),
+                       lambda kz, ky: (bzb - 1,
+                                       jnp.where(kz == 0, ky, 0), 0))
+        i_zp0_in = add("f", (ESUB, by, X),
+                       lambda kz, ky: (clampZ(kz), ky, 0))
+        i_zp0_zs = add("zhi", (ESUB, by, X),
+                       lambda kz, ky: (0, jnp.where(kz == nzg - 1,
+                                                    ky, 0), 0))
+    # z0_ym / z0_yp: rows y in [ky*by-8, ky*by) / [ky*by+by, +8)
+    i_ym_in = add("f", (bz, ESUB, X),
+                  lambda kz, ky: (kz, clampy(ky), 0))
+    i_ym_ys = add("ylo", (bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0))
+    i_yp_in = add("f", (bz, ESUB, X),
+                  lambda kz, ky: (kz, clampY(ky), 0))
+    i_yp_ys = add("yhi", (bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0))
+    # corners (8, 8, X): (in-shard, z-slab, y-slab) source triples
+    i_mm = (add("f", (ESUB, ESUB, X),
+                lambda kz, ky: (clampz(kz), clampy(ky), 0)),
+            add("zlo", (ESUB, ESUB, X),
+                lambda kz, ky: (bzb - 1,
+                                jnp.where(kz == 0, clampy(ky), 0), 0)),
+            add("ylo", (ESUB, ESUB, X),
+                lambda kz, ky: ((kz + 1) * bzb - 1, 0, 0)))
+    i_mp = (add("f", (ESUB, ESUB, X),
+                lambda kz, ky: (clampz(kz), clampY(ky), 0)),
+            add("zlo", (ESUB, ESUB, X),
+                lambda kz, ky: (bzb - 1,
+                                jnp.where(kz == 0, clampY(ky), 0), 0)),
+            add("yhi", (ESUB, ESUB, X),
+                lambda kz, ky: ((kz + 1) * bzb - 1, 0, 0)))
+    i_pm = (add("f", (ESUB, ESUB, X),
+                lambda kz, ky: (clampZ(kz), clampy(ky), 0)),
+            add("zhi", (ESUB, ESUB, X),
+                lambda kz, ky: (0, jnp.where(kz == nzg - 1,
+                                             clampy(ky), 0), 0)),
+            add("ylo", (ESUB, ESUB, X),
+                lambda kz, ky: ((kz + 2) * bzb, 0, 0)))
+    i_pp = (add("f", (ESUB, ESUB, X),
+                lambda kz, ky: (clampZ(kz), clampY(ky), 0)),
+            add("zhi", (ESUB, ESUB, X),
+                lambda kz, ky: (0, jnp.where(kz == nzg - 1,
+                                             clampY(ky), 0), 0)),
+            add("yhi", (ESUB, ESUB, X),
+                lambda kz, ky: ((kz + 2) * bzb, 0, 0)))
 
-    def inputs_for_field(f, slabs):
-        """Input arrays matching ``specs`` order."""
-        zlo, zhi = slabs["zlo"], slabs["zhi"]
-        ylo, yhi = slabs["ylo"], slabs["yhi"]
-        if thin:
-            zmid = [f] * rr + [zlo] * rr + [f] * rr + [zhi] * rr
-        else:
-            zmid = [f, zlo, f, zhi]    # tiled ESUB z segments
-        return ([f] + zmid
-                + [f, ylo,             # z0_ym
-                   f, yhi,             # z0_yp
-                   f, zlo, ylo,        # zm_ym
-                   f, zlo, yhi,        # zm_yp
-                   f, zhi, ylo,        # zp_ym
-                   f, zhi, yhi])       # zp_yp
+    def inputs_for_field(f, slabs=None):
+        """Input arrays matching ``specs`` order (``slabs`` unused —
+        and optional — in slabless mode)."""
+        return [f if k == "f" else slabs[k] for k in kinds]
 
     def select_window(refs, kz=None, ky=None) -> jnp.ndarray:
         """Assemble one field's (bz+2rr, by+2rr, X) stencil window from
@@ -723,43 +724,45 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
         at_zhi = kz == nzg - 1
         at_ylo = ky == 0
         at_yhi = ky == nyg - 1
-        main = refs[0]
+
+        def sel(i_in, i_slab, at_edge):
+            v = refs[i_in][...]
+            if i_slab is None:
+                return v
+            return jnp.where(at_edge, refs[i_slab][...], v)
+
+        def sel3(idx3, at_zedge, at_yedge):
+            # the y slab is z-extended, so a y-edge corner always comes
+            # from it (covering simultaneous z edges); otherwise the z
+            # slab covers z-edge corners at interior y
+            i_in, i_zs, i_ys = idx3
+            v = refs[i_in][...]
+            if i_zs is not None:
+                v = jnp.where(at_zedge, refs[i_zs][...], v)
+            if i_ys is not None:
+                v = jnp.where(at_yedge, refs[i_ys][...], v)
+            return v
+
         if thin:
-            zm_in = refs[1:1 + rr]
-            zm_zs = refs[1 + rr:1 + 2 * rr]
-            zp_in = refs[1 + 2 * rr:1 + 3 * rr]
-            zp_zs = refs[1 + 3 * rr:1 + 4 * rr]
-            rest = refs[1 + 4 * rr:]
-            zm_rows = [jnp.where(at_zlo, zm_zs[i][...], zm_in[i][...])
+            zm_rows = [sel(i_zm_in[i], i_zm_zs[i], at_zlo)
                        for i in range(rr)]
-            zp_rows = [jnp.where(at_zhi, zp_zs[i][...], zp_in[i][...])
+            zp_rows = [sel(i_zp_in[i], i_zp_zs[i], at_zhi)
                        for i in range(rr)]
         else:
-            zm0_in, zm0_zs, zp0_in, zp0_zs = refs[1:5]
-            rest = refs[5:]
             # tiled ESUB blocks: the adjacent rr rows sit at the tile
             # end (zm) / start (zp)
-            zm_y0 = jnp.where(at_zlo, zm0_zs[...], zm0_in[...])
-            zp_y0 = jnp.where(at_zhi, zp0_zs[...], zp0_in[...])
+            zm_y0 = sel(i_zm0_in, i_zm0_zs, at_zlo)
+            zp_y0 = sel(i_zp0_in, i_zp0_zs, at_zhi)
             zm_rows = [zm_y0[ESUB - rr + i:ESUB - rr + i + 1]
                        for i in range(rr)]
             zp_rows = [zp_y0[i:i + 1] for i in range(rr)]
-        (ym0_in, ym0_ys, yp0_in, yp0_ys, mm_in, mm_zs, mm_ys, mp_in,
-         mp_zs, mp_ys, pm_in, pm_zs, pm_ys, pp_in, pp_zs, pp_ys) = rest
-        z0_ym = jnp.where(at_ylo, ym0_ys[...], ym0_in[...])
-        z0_yp = jnp.where(at_yhi, yp0_ys[...], yp0_in[...])
-        # corners: the y slab is z-extended, so a y-edge corner always
-        # comes from it (covering simultaneous z edges); otherwise the
-        # z slab covers z-edge corners at interior y
-        zm_ym = jnp.where(at_ylo, mm_ys[...],
-                          jnp.where(at_zlo, mm_zs[...], mm_in[...]))
-        zm_yp = jnp.where(at_yhi, mp_ys[...],
-                          jnp.where(at_zlo, mp_zs[...], mp_in[...]))
-        zp_ym = jnp.where(at_ylo, pm_ys[...],
-                          jnp.where(at_zhi, pm_zs[...], pm_in[...]))
-        zp_yp = jnp.where(at_yhi, pp_ys[...],
-                          jnp.where(at_zhi, pp_zs[...], pp_in[...]))
-        c = main[...]
+        z0_ym = sel(i_ym_in, i_ym_ys, at_ylo)
+        z0_yp = sel(i_yp_in, i_yp_ys, at_yhi)
+        zm_ym = sel3(i_mm, at_zlo, at_ylo)
+        zm_yp = sel3(i_mp, at_zlo, at_yhi)
+        zp_ym = sel3(i_pm, at_zhi, at_ylo)
+        zp_yp = sel3(i_pp, at_zhi, at_yhi)
+        c = refs[i_main][...]
         # corner blocks are ESUB rows; the zm rows sit at block rows
         # ESUB-rr+i, the zp rows at block rows i
         rows = [

@@ -54,77 +54,6 @@ def _interpret_mode():
     return False if on_tpu() else pltpu.InterpretParams()
 
 
-def _clamped_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
-                         rr: int = R):
-    """(specs, assemble) for one field's (bz+2rr, by+2rr, X) window
-    built from IN-SHARD data only, neighbor segments clamped at the
-    shard boundary — the overlap kernel's interior compute reads this
-    while the halo RDMA is in flight, so edge blocks produce
-    placeholder values (the fix-up strips rewrite them). Mirrors the
-    in-shard arm of ``pallas_halo._mhd_window_plan``."""
-    bzb = bz // ESUB
-    byb = by // ESUB
-    nzb8 = Z // ESUB
-    nyb8 = Y // ESUB
-
-    def clampy(k):
-        return jnp.maximum(k * byb - 1, 0)
-
-    def clampY(k):
-        return jnp.minimum(k * byb + byb, nyb8 - 1)
-
-    def clampz(k):
-        return jnp.maximum(k * bzb - 1, 0)
-
-    def clampZ(k):
-        return jnp.minimum(k * bzb + bzb, nzb8 - 1)
-
-    specs = [pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))]
-    for o in range(-rr, 0):        # z-minus single rows, clamped
-        specs.append(pl.BlockSpec(
-            (1, by, X),
-            lambda kz, ky, o=o: (jnp.clip(kz * bz + o, 0, Z - 1), ky, 0)))
-    for j in range(rr):            # z-plus single rows, clamped
-        specs.append(pl.BlockSpec(
-            (1, by, X),
-            lambda kz, ky, j=j: (jnp.clip(kz * bz + bz + j, 0, Z - 1),
-                                 ky, 0)))
-    specs += [
-        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz, clampy(ky), 0)),
-        pl.BlockSpec((bz, ESUB, X), lambda kz, ky: (kz, clampY(ky), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (clampz(kz), clampy(ky), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (clampz(kz), clampY(ky), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (clampZ(kz), clampy(ky), 0)),
-        pl.BlockSpec((ESUB, ESUB, X),
-                     lambda kz, ky: (clampZ(kz), clampY(ky), 0)),
-    ]
-
-    def assemble(refs) -> jnp.ndarray:
-        main = refs[0]
-        zm = refs[1:1 + rr]
-        zp = refs[1 + rr:1 + 2 * rr]
-        ym, yp, mm, mp, pm, pp = refs[1 + 2 * rr:]
-        rows = [
-            jnp.concatenate(
-                [mm[ESUB - rr + i:ESUB - rr + i + 1, ESUB - rr:],
-                 zm[i][...],
-                 mp[ESUB - rr + i:ESUB - rr + i + 1, :rr]], axis=1)
-            for i in range(rr)
-        ]
-        rows.append(jnp.concatenate(
-            [ym[:, ESUB - rr:], main[...], yp[:, :rr]], axis=1))
-        rows.extend(
-            jnp.concatenate([pm[i:i + 1, ESUB - rr:], zp[i][...],
-                             pp[i:i + 1, :rr]], axis=1)
-            for i in range(rr))
-        return jnp.concatenate(rows, axis=0)
-
-    return specs, assemble
-
-
 def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
                                w: Dict[str, jnp.ndarray],
                                s: int, prm, dt_phys: float,
@@ -168,7 +97,10 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     nf = len(FIELDS)
     zext = Z + 2 * bz
 
-    field_specs, assemble = _clamped_window_plan(Z, Y, X, bz, by, rr=R)
+    # the halo kernel's own window plan in slabless mode: clamped
+    # in-shard segments only, one source of truth for the geometry
+    field_specs, inputs_for_field, select_window = _mhd_window_plan(
+        Z, Y, X, bz, by, rr=R, slabless=True)
     nseg = len(field_specs)
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
 
@@ -302,7 +234,7 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
         # ---- interior compute for this block, behind the DMAs
         data = {}
         for i, q in enumerate(FIELDS):
-            win = assemble(field_refs[nseg * i:nseg * (i + 1)])
+            win = select_window(field_refs[nseg * i:nseg * (i + 1)])
             data[q] = FieldData(win, inv_ds, pad_lo, interior,
                                 x_wrap=True)
         rates = mhd_rates(data, prm, dtype)
@@ -332,7 +264,7 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     inputs = []
     for q in FIELDS:
         in_specs.extend(field_specs)
-        inputs.extend([fields[q]] * nseg)
+        inputs.extend(inputs_for_field(fields[q]))
     for q in FIELDS:
         in_specs.append(main_spec)
         inputs.append(w[q])
@@ -508,8 +440,11 @@ def mhd_substep_overlap(fields: Dict[str, jnp.ndarray],
     Z, Y, _ = fields[FIELDS[0]].shape
     bz, _by = mhd_halo_blocks(Z, Y, block_z, block_y)
     nzg = Z // bz
-    fix_interp = (None if interpret is None
-                  else not isinstance(interpret, bool) or interpret)
+    # pass the caller's interpret mode through VERBATIM: an
+    # InterpretParams (e.g. detect_races=True from the sanitizer tests)
+    # must reach the aliased fix-up kernels too, not be downgraded to a
+    # plain interpreter
+    fix_interp = interpret
     f1, w1, slabs = mhd_substep_overlap_pallas(
         fields, w, s, prm, dt_phys, counts, block_z=block_z,
         block_y=block_y, interpret=interpret)

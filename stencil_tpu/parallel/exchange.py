@@ -257,7 +257,8 @@ def exchange_interior_slabs(p: jnp.ndarray, mesh_counts: Dim3,
 
 def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                           mesh_counts: Dim3,
-                          axis_order: Tuple[int, ...] = (0, 1, 2)
+                          axis_order: Tuple[int, ...] = (0, 1, 2),
+                          rem: Dim3 = Dim3(0, 0, 0)
                           ) -> Dict[str, jnp.ndarray]:
     """Multi-quantity exchange with per-direction packing: all
     quantities' slabs for one axis-direction are flattened and
@@ -268,6 +269,14 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
     All quantities are bitcast to a common byte layout via flattening in
     float32/raw dtype groups; quantities of differing dtypes are packed
     in separate groups (alignment rule analog, src/packer.cu:76-82).
+
+    ``rem``: uneven (+-1) subdomain counts (reference:
+    partition.hpp:55-69) — same placement rule as ``exchange_shard``:
+    a short shard's hi-edge send comes from its ACTUAL last interior
+    rows (dynamic slice at the traced interior length) and its hi-side
+    halo lands immediately after the actual interior; packed buffer
+    shapes stay static (capacity-sized slabs), so one program serves
+    every shard.
     """
     names = sorted(arrs.keys())  # sorted so both endpoints agree on
     # layout (reference sorts messages by size, src/packer.cu:69,182-183)
@@ -280,6 +289,7 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
         dim = AXIS_TO_DIM[a]
         name = AXIS_NAME[a]
         n_dev = mesh_counts[a]
+        uneven_axis = rem[a] != 0
 
         for side, r_fill in ((1, r_hi), (-1, r_lo)):
             if r_fill == 0:
@@ -295,8 +305,14 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                     arr = out[q]
                     alloc = arr.shape[dim]
                     interior = alloc - r_lo - r_hi
+                    L = shard_interior_len(a, interior, rem)
                     if side == 1:
                         src = lax.slice_in_dim(arr, r_lo, r_lo + r_hi, axis=dim)
+                    elif uneven_axis:
+                        # hi edge of a short shard sits at its actual
+                        # interior end [L, L + r_lo)
+                        src = lax.dynamic_slice_in_dim(arr, L, r_lo,
+                                                       axis=dim)
                     else:
                         src = lax.slice_in_dim(arr, interior, r_lo + interior,
                                                axis=dim)
@@ -315,7 +331,11 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                     arr = out[q]
                     alloc = arr.shape[dim]
                     interior = alloc - r_lo - r_hi
-                    start = (r_lo + interior) if side == 1 else 0
+                    if side == 1:
+                        L = shard_interior_len(a, interior, rem)
+                        start = r_lo + L
+                    else:
+                        start = 0
                     out[q] = lax.dynamic_update_slice_in_dim(arr, recv, start,
                                                              axis=dim)
     return out
@@ -375,16 +395,18 @@ def dispatch_exchange(fields: Dict[str, jnp.ndarray], radius: Radius,
     the single dispatch point shared by the orchestrator and the fused
     model steps (the Method-routing analog of src/stencil.cu:371-458)."""
     uneven = rem != Dim3(0, 0, 0)
-    if uneven and method != Method.PpermuteSlab:
+    if uneven and method not in (Method.PpermuteSlab,
+                                 Method.PpermutePacked):
         raise NotImplementedError(
             f"uneven (+-1 remainder) subdomains are only supported by "
-            f"Method.PpermuteSlab, not {method}")
+            f"the PpermuteSlab and PpermutePacked methods, not {method}")
     if method == Method.PallasDMA:
         from .pallas_exchange import exchange_shard_pallas
         return {k: exchange_shard_pallas(v, radius, mesh_counts, axis_order)
                 for k, v in fields.items()}
     if method == Method.PpermutePacked:
-        return exchange_shard_packed(fields, radius, mesh_counts, axis_order)
+        return exchange_shard_packed(fields, radius, mesh_counts,
+                                     axis_order, rem)
     if method == Method.AllGather:
         return {k: exchange_shard_allgather(v, radius, mesh_counts, axis_order)
                 for k, v in fields.items()}

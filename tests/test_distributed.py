@@ -200,3 +200,54 @@ def test_rejects_bad_configs():
     dd.add_data("q", np.float32)
     with pytest.raises(ValueError):
         dd.realize()
+
+
+def test_fast_path_exchange_stats():
+    """The models' per-iteration exchange accounting must (a) agree
+    between the pair and sequential MHD halo paths (same wire bytes,
+    the pair's whole point), (b) match interior_slab_bytes exactly,
+    and (c) produce a positive standalone timing — the honest fast-path
+    stats the orchestrator counters cannot provide (reference:
+    src/stencil.cu:1005-1008,1174-1181)."""
+    import os
+
+    import jax
+
+    from stencil_tpu.models.astaroth import FIELDS, Astaroth
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.parallel.exchange import interior_slab_bytes
+    from stencil_tpu.parallel.mesh import mesh_dim
+
+    prior = os.environ.get("STENCIL_MHD_PAIR")
+    os.environ["STENCIL_MHD_PAIR"] = "1"
+    try:
+        a = Astaroth(16, 8, 16, mesh_shape=(1, 1, 2), dtype=np.float64,
+                     devices=jax.devices()[:2], kernel="halo")
+    finally:
+        if prior is None:
+            os.environ.pop("STENCIL_MHD_PAIR", None)
+        else:
+            os.environ["STENCIL_MHD_PAIR"] = prior
+    b = Astaroth(16, 8, 16, mesh_shape=(1, 1, 2), dtype=np.float64,
+                 devices=jax.devices()[:2], kernel="halo")
+    sa, sb = a.exchange_stats(), b.exchange_stats()
+    assert (sa["rounds_per_iteration"], sb["rounds_per_iteration"]) == (2.0, 3.0)
+    assert sa["bytes_per_iteration"] == sb["bytes_per_iteration"]
+    counts = mesh_dim(b.dd.mesh)
+    local = b.dd.local_size
+    per = interior_slab_bytes((local.z, local.y, local.x), counts, 3, 8,
+                              y_z_extended=True)
+    assert sb["bytes_per_iteration"] == 3 * per * 2 * len(FIELDS)
+    assert b.measure_exchange_seconds(reps=2) > 0
+
+    j = Jacobi3D(16, 16, 16, mesh_shape=(1, 2, 2), dtype=np.float32,
+                 devices=jax.devices()[:4], kernel="halo")
+    js = j.exchange_stats()
+    assert js["path"] == "halo"
+    assert js["rounds_per_iteration"] == 0.5     # 2-step groups
+    assert j.measure_exchange_seconds(reps=2) > 0
+    w = Jacobi3D(16, 16, 16, mesh_shape=(1, 1, 1),
+                 devices=jax.devices()[:1], kernel="wrap",
+                 dtype=np.float32)
+    assert w.exchange_stats()["bytes_per_iteration"] == 0
+    assert w.measure_exchange_seconds() == 0.0

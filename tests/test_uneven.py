@@ -124,10 +124,32 @@ def test_uneven_rejects_unsupported_methods():
     dd = DistributedDomain(9, 8, 8)
     dd.set_mesh_shape((2, 2, 2))
     dd.set_radius(1)
-    dd.set_methods(Method.PpermutePacked)
+    dd.set_methods(Method.AllGather)
     dd.add_data("q", np.float32)
     with pytest.raises(NotImplementedError):
         dd.realize()
+
+
+@pytest.mark.parametrize("n", [17])
+def test_uneven_packed_matches_dense_oracle(n):
+    """The packed multi-quantity exchange on uneven (+-1) shards: the
+    hi-edge sends slice at the traced interior length and the hi halo
+    lands after the actual interior (the partition.hpp:55-69 placement
+    rule), so packed and slab methods agree with the dense oracle."""
+    from stencil_tpu.models.jacobi import Jacobi3D, dense_reference_step
+
+    j = Jacobi3D(n, n, n, mesh_shape=(2, 2, 2), dtype=np.float64,
+                 methods=Method.PpermutePacked)
+    assert j.dd.rem == Dim3(1, 1, 1)
+    j.init()
+    temp = j.temperature()
+    hot = (n // 3, n // 2, n // 2)
+    cold = (2 * n // 3, n // 2, n // 2)
+    for _ in range(3):
+        temp = dense_reference_step(temp, hot, cold, n // 10)
+        j.step()
+    np.testing.assert_allclose(j.temperature(), temp, rtol=1e-12,
+                               atol=1e-12)
 
 
 def test_auto_partition_falls_back_to_uneven():
