@@ -226,10 +226,23 @@ class Astaroth:
         if mesh_shape is not None:
             self.dd.set_mesh_shape(mesh_shape)
         elif dcn_axis is not None or dcn_groups is not None:
-            # DCN tier with no explicit shape: let realize() derive the
-            # grid from NodePartition's two-level split, which knows the
-            # slice count (the auto x-free pick below does not)
-            pass
+            # DCN tier with no explicit shape: normally realize()
+            # derives the grid from NodePartition's two-level split —
+            # but the halo fast paths need x unsharded, which that
+            # split does not know (same rule as Jacobi3D; the f32 gate
+            # matches the kernel-selection gate below)
+            from ..models.jacobi import _dcn_xfree_shape
+            from ..ops.pallas_stencil import on_tpu
+            halo_want = (kernel == "halo"
+                         or (kernel == "auto" and on_tpu()
+                             and np.dtype(dtype) == np.float32))
+            shape = _dcn_xfree_shape(Dim3(nx, ny, nz),
+                                     self.dd._devices, dcn_axis,
+                                     dcn_groups,
+                                     "halo" if halo_want else "xla",
+                                     align=8)
+            if shape is not None:
+                self.dd.set_mesh_shape(shape)
         else:
             from ..ops.pallas_stencil import on_tpu
             # auto only takes the halo megakernel on TPU AND f32 (the

@@ -124,6 +124,43 @@ def _wrap_steps(tile: int) -> int:
     return min(max(n, 1), tile)
 
 
+def _dcn_xfree_shape(size: Dim3, devices, dcn_axis, dcn_groups, kernel,
+                     align: int = 1):
+    """Slice-compatible x-unsharded mesh shape when a DCN tier is
+    requested together with a halo-family fast path (explicit
+    kernel='halo', or 'auto' on TPU) — NodePartition's derived split
+    may shard x, which the slab kernels cannot use. Returns None —
+    letting realize()'s NodePartition ladder stand — for non-halo
+    kernels, an x-axis DCN tier, indivisible device counts, or a
+    candidate shape the GRID cannot host (every axis must divide
+    evenly with local z/y multiples of ``align``; the same
+    guarantee-or-decline contract as ``partition_dims_even_xfree``)."""
+    from ..ops.pallas_stencil import on_tpu
+
+    if not (kernel == "halo" or (kernel == "auto" and on_tpu())):
+        return None
+    axis = dcn_axis
+    if isinstance(axis, str):
+        axis = {"x": 0, "y": 1, "z": 2, "auto": None}[axis]
+    if axis == 0:
+        return None          # x-axis DCN tier cannot be x-free
+    from ..parallel.mesh import default_mesh_shape_dcn
+    from ..parallel.multihost import slice_groups
+
+    groups = dcn_groups or slice_groups(devices)
+    if len(groups) <= 1 or len(devices) % len(groups):
+        return None
+    shape = default_mesh_shape_dcn(len(devices), len(groups),
+                                   axis=2 if axis is None else axis,
+                                   xfree=True)
+    for a in range(3):
+        if size[a] % shape[a]:
+            return None
+    if (size.z // shape.z) % align or (size.y // shape.y) % align:
+        return None
+    return shape
+
+
 class Jacobi3D:
     """Distributed Jacobi-3D solver over a TPU mesh."""
 
@@ -147,10 +184,16 @@ class Jacobi3D:
         if mesh_shape is not None:
             self.dd.set_mesh_shape(mesh_shape)
         elif dcn_axis is not None or dcn_groups is not None:
-            # DCN tier with no explicit shape: let realize() derive the
-            # grid from NodePartition's two-level split, which knows the
-            # slice count (the auto x-free pick below does not)
-            pass
+            # DCN tier with no explicit shape: normally let realize()
+            # derive the grid from NodePartition's two-level split —
+            # but the halo fast paths need the lane (x) axis unsharded,
+            # which that split does not know, so derive the x-free
+            # slice-compatible shape here (the apps' dcn_mesh_shape
+            # rule, in the model so library users get it too)
+            shape = _dcn_xfree_shape(Dim3(x, y, z), self.dd._devices,
+                                     dcn_axis, dcn_groups, kernel)
+            if shape is not None:
+                self.dd.set_mesh_shape(shape)
         else:
             from ..ops.pallas_stencil import on_tpu
             if (len(self.dd._devices) > 1 and not overlap

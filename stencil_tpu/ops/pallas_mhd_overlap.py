@@ -440,19 +440,17 @@ def mhd_substep_overlap(fields: Dict[str, jnp.ndarray],
     Z, Y, _ = fields[FIELDS[0]].shape
     bz, _by = mhd_halo_blocks(Z, Y, block_z, block_y)
     nzg = Z // bz
-    # pass the caller's interpret mode through VERBATIM: an
+    # the caller's interpret mode passes through VERBATIM: an
     # InterpretParams (e.g. detect_races=True from the sanitizer tests)
-    # must reach the aliased fix-up kernels too, not be downgraded to a
-    # plain interpreter
-    fix_interp = interpret
+    # must reach the aliased fix-up kernels too
     f1, w1, slabs = mhd_substep_overlap_pallas(
         fields, w, s, prm, dt_phys, counts, block_z=block_z,
         block_y=block_y, interpret=interpret)
     f1, w1 = mhd_substep_fixup_pallas(
         fields, w, f1, w1, slabs, s, prm, dt_phys, "z",
-        block_z=block_z, block_y=block_y, interpret=fix_interp)
+        block_z=block_z, block_y=block_y, interpret=interpret)
     if nzg > 2:
         f1, w1 = mhd_substep_fixup_pallas(
             fields, w, f1, w1, slabs, s, prm, dt_phys, "y",
-            block_z=block_z, block_y=block_y, interpret=fix_interp)
+            block_z=block_z, block_y=block_y, interpret=interpret)
     return f1, w1

@@ -153,3 +153,51 @@ def test_profiling_scopes_and_reports():
     j.dd.enable_timing(True)
     j.dd.exchange()
     assert "trimean" in exchange_stats_report(j.dd)
+
+
+def test_dcn_tier_halo_kernel_matches_dense_oracle():
+    """DCN tier x the fused halo fast path: with no explicit mesh the
+    model derives an x-free slice-compatible shape (NodePartition's
+    split may shard x, which the slab kernels cannot use), and the
+    temporally-blocked slab exchange runs across the inter-slice
+    boundary unchanged."""
+    import numpy as np
+
+    from stencil_tpu.models.jacobi import Jacobi3D, dense_reference_step
+
+    devs = jax.devices()[:8]
+    groups = [devs[:4], devs[4:]]
+    n = 16
+    j = Jacobi3D(n, n, n, dtype=np.float32, devices=devs,
+                 kernel="halo", dcn_axis="z", dcn_groups=groups)
+    assert j.kernel_path == "halo"
+    assert j.dd.n_slices == 2
+    dim = j.dd.placement.dim()
+    assert dim.x == 1 and dim.z % 2 == 0, tuple(dim)
+    assert j.dd.exchange_bytes_dcn() > 0
+    j.init()
+    temp = j.temperature()
+    hot = (n // 3, n // 2, n // 2)
+    cold = (2 * n // 3, n // 2, n // 2)
+    for _ in range(3):
+        temp = dense_reference_step(temp, hot, cold, n // 10)
+    j.run(3)
+    np.testing.assert_allclose(j.temperature(), temp, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_dcn_tier_astaroth_halo_mesh_derivation():
+    """Astaroth mirrors the Jacobi rule: DCN tier + kernel='halo'
+    derives an x-free slice-compatible mesh (radius-3 slab kernels)."""
+    import numpy as np
+
+    from stencil_tpu.models.astaroth import Astaroth
+
+    devs = jax.devices()[:8]
+    groups = [devs[:4], devs[4:]]
+    m = Astaroth(16, 16, 32, dtype=np.float64, devices=devs,
+                 kernel="halo", dcn_axis="z", dcn_groups=groups)
+    assert m.kernel_path == "halo"
+    assert m.dd.n_slices == 2
+    dim = m.dd.placement.dim()
+    assert dim.x == 1 and dim.z % 2 == 0, tuple(dim)
