@@ -55,6 +55,11 @@ run env STENCIL_MHD_THINZ=0 python scripts/bench_kernels.py --model mhd \
     --kernels halo "${WD[@]}"
 run env STENCIL_MHD_PAIR=1 python scripts/bench_kernels.py --model mhd \
     --kernels halo "${WD[@]}"
+# pair x in-kernel-RDMA-overlap composition (single chip: local wrap
+# copies; the overlap benefit needs multi-chip ICI, but the schedule
+# must not cost throughput)
+run timeout 2400 env STENCIL_MHD_PAIR=1 python apps/astaroth.py \
+    --nx 256 --ny 256 --nz 256 --iters 10 --kernel halo --overlap
 
 # 6. overlap structure, single-chip (serialized vs in-kernel-RDMA
 #    schedule with local wrap copies; real overlap_efficiency needs

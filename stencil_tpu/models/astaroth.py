@@ -482,9 +482,8 @@ class Astaroth:
         # (one HBM pass for two of the three RK substeps; alpha_0 == 0
         # makes the pair independent of the incoming w) — experimental
         # until hardware-measured, so default off
-        import os
-        pair_on = os.environ.get("STENCIL_MHD_PAIR", "").lower() in (
-            "1", "true", "yes")
+        from ..utils.config import mhd_pair_requested
+        pair_on = mhd_pair_requested()
         if pair_on:
             from ..ops.pallas_mhd import mhd_substep01_wrap_pallas
             from ..utils.logging import LOG_INFO
@@ -547,9 +546,8 @@ class Astaroth:
         # too — one radius-2R exchange + one HBM pass covers two of the
         # three RK substeps (same opt-in as the wrap path; needs the
         # slabs to carry 2R valid rows, hence 2R <= min(bz, ESUB))
-        import os
-        pair_on = (os.environ.get("STENCIL_MHD_PAIR", "").lower()
-                   in ("1", "true", "yes")
+        from ..utils.config import mhd_pair_requested
+        pair_on = (mhd_pair_requested()
                    and 2 * HALO_R <= min(bz, ESUB))
         if pair_on:
             from ..ops.pallas_halo import mhd_substep01_halo_pallas
@@ -619,7 +617,7 @@ class Astaroth:
         astaroth/astaroth.cu:552-646; see ops/pallas_mhd_overlap.py).
         Same extract/loop/insert program split and interior-resident
         caching as the halo path."""
-        from ..ops.pallas_halo import mhd_halo_blocks
+        from ..ops.pallas_halo import ESUB, R as HALO_R, mhd_halo_blocks
         from ..ops.pallas_mhd_overlap import mhd_substep_overlap
 
         dd = self.dd
@@ -643,13 +641,31 @@ class Astaroth:
             extract_shard, mesh=dd.mesh, in_specs=(fields_spec,),
             out_specs=fields_spec, check_vma=False))
 
+        # STENCIL_MHD_PAIR composes with the overlap path too: one
+        # radius-2R overlapped exchange + one fused pass covers RK
+        # substeps 0+1, then substep 2 runs overlapped as usual
+        from ..utils.config import mhd_pair_requested
+        pair_on = (mhd_pair_requested()
+                   and 2 * HALO_R <= min(bz, ESUB))
+        if pair_on:
+            from ..utils.logging import LOG_INFO
+            LOG_INFO("astaroth halo-overlap path: fused substep-0+1")
+
         def loop_shard(inner, w, n):
             def body(_, fw):
                 f, wk = fw
-                for s in range(3):
-                    f, wk = mhd_substep_overlap(f, wk, s, prm, dt,
+                if pair_on:
+                    f, wk = mhd_substep_overlap(f, wk, 0, prm, dt,
+                                                counts, block_z=bz,
+                                                block_y=by, pair=True)
+                    f, wk = mhd_substep_overlap(f, wk, 2, prm, dt,
                                                 counts, block_z=bz,
                                                 block_y=by)
+                else:
+                    for s in range(3):
+                        f, wk = mhd_substep_overlap(f, wk, s, prm, dt,
+                                                    counts, block_z=bz,
+                                                    block_y=by)
                 return f, wk
             return lax.fori_loop(0, n, body, (inner, w))
 
@@ -667,9 +683,10 @@ class Astaroth:
         self._insert = jax.jit(jax.shard_map(
             insert_shard, mesh=dd.mesh, in_specs=(fields_spec, fields_spec),
             out_specs=fields_spec, check_vma=False), donate_argnums=0)
-        # same wire traffic as the sequential halo path (3 radius-R
-        # rounds per iteration), issued in-kernel
-        self._slab_exchange_cfg = dict(rz=bz, pair=False)
+        # same wire traffic as the sequential halo path (pair: one
+        # radius-2R + one radius-R round; else 3 radius-R rounds per
+        # iteration), issued in-kernel
+        self._slab_exchange_cfg = dict(rz=bz, pair=pair_on)
         self._install_inner_iter(extract, loop)
 
     def _install_inner_iter(self, extract, loop) -> None:

@@ -907,12 +907,10 @@ def mhd_substep01_halo_pallas(fields: Dict[str, jnp.ndarray],
     2R valid rows, not R (the window reaches 2R across shard edges).
     Needs 2R <= min(bz, ESUB) (6 <= 8). Returns (new_fields, new_w).
     """
-    from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
-    from .fd6 import FieldData
+    from ..models.astaroth import FIELDS
 
     if interpret is None:
         interpret = default_interpret()
-    assert float(RK3_ALPHA[0]) == 0.0, "pair fusion needs alpha_0 == 0"
     R2 = 2 * R
     Z, Y, X = fields[FIELDS[0]].shape
     bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
@@ -922,17 +920,6 @@ def mhd_substep01_halo_pallas(fields: Dict[str, jnp.ndarray],
         assert slabs[q]["ylo"].shape == (Z + 2 * bz, ESUB, X), \
             slabs[q]["ylo"].shape
     dtype = fields[FIELDS[0]].dtype
-    inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
-    beta0 = float(RK3_BETA[0])
-    alpha1 = float(RK3_ALPHA[1])
-    beta1 = float(RK3_BETA[1])
-    dt_ = float(dt_phys)
-    # rates_0 on the ring-extended region, rates_1 on the block (the
-    # same two FieldData views as the wrap pair kernel)
-    pad0 = Dim3(0, R, R)
-    int0 = Dim3(X, by + R2, bz + R2)
-    pad1 = Dim3(0, R, R)
-    int1 = Dim3(X, by, bz)
     nzg = Z // bz
     nyg = Y // by
     field_specs, inputs_for_field, select_window = _mhd_window_plan(
@@ -943,27 +930,17 @@ def mhd_substep01_halo_pallas(fields: Dict[str, jnp.ndarray],
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
 
     def kern(*refs):
+        from .pallas_mhd import mhd_pair_update
+
         field_refs = refs[:nseg * nf]
         out_f = refs[nseg * nf:nseg * nf + nf]
         out_w = refs[nseg * nf + nf:]
-        dta = jnp.dtype(dtype)
-        data0 = {}
+        wins = {q: select_window(field_refs[nseg * i:nseg * (i + 1)])
+                for i, q in enumerate(FIELDS)}
+        f2, w2 = mhd_pair_update(wins, prm, dtype, dt_phys, bz, by)
         for i, q in enumerate(FIELDS):
-            win = select_window(field_refs[nseg * i:nseg * (i + 1)])
-            data0[q] = FieldData(win, inv_ds, pad0, int0, x_wrap=True)
-        rates0 = mhd_rates(data0, prm, dtype)
-        data1 = {}
-        w1 = {}
-        for q in FIELDS:
-            w1[q] = dta.type(dt_) * rates0[q]          # alpha_0 == 0
-            f1 = data0[q].value + dta.type(beta0) * w1[q]
-            data1[q] = FieldData(f1, inv_ds, pad1, int1, x_wrap=True)
-        rates1 = mhd_rates(data1, prm, dtype)
-        for i, q in enumerate(FIELDS):
-            w1c = w1[q][R:R + bz, R:R + by]
-            wq = dta.type(alpha1) * w1c + dta.type(dt_) * rates1[q]
-            out_w[i][...] = wq
-            out_f[i][...] = data1[q].value + dta.type(beta1) * wq
+            out_w[i][...] = w2[q]
+            out_f[i][...] = f2[q]
 
     in_specs = []
     inputs = []

@@ -217,6 +217,55 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     return new_f, new_w
 
 
+def mhd_pair_update(wins: Dict[str, jnp.ndarray], prm, dtype,
+                    dt_phys: float, bz: int, by: int
+                    ) -> Tuple[Dict[str, jnp.ndarray],
+                               Dict[str, jnp.ndarray]]:
+    """The fused RK substep-0+1 update on radius-2R windows — the ONE
+    implementation of the pair math shared by the halo-path pair
+    kernel and the RDMA-overlap pair mode (the wrap-path kernel
+    predates it and is kept verbatim as the hardware-measured
+    reference). ``wins[q]`` is the (bz + 4R, by + 4R, X) window;
+    returns ``({q: f2}, {q: w2})`` as (bz, by, X) blocks. alpha_0 == 0
+    makes the pair independent of the incoming w: rates_0 is evaluated
+    on the ring-extended region, (f_1, w_1) formed in VMEM, rates_1 on
+    the block — per-point op order matches two sequential substeps
+    exactly. Reference semantics: astaroth/kernels.cu:63-90 applied
+    for substeps 0 and 1."""
+    from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
+    from .fd6 import FieldData
+
+    assert float(RK3_ALPHA[0]) == 0.0, "pair fusion needs alpha_0 == 0"
+    R2 = 2 * R
+    dta = jnp.dtype(dtype)
+    dt_ = dta.type(float(dt_phys))
+    beta0 = dta.type(float(RK3_BETA[0]))
+    alpha1 = dta.type(float(RK3_ALPHA[1]))
+    beta1 = dta.type(float(RK3_BETA[1]))
+    inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
+    pad = Dim3(0, R, R)
+    int0 = Dim3(wins[FIELDS[0]].shape[2], by + R2, bz + R2)
+    int1 = Dim3(wins[FIELDS[0]].shape[2], by, bz)
+    data0 = {q: FieldData(wins[q], inv_ds, pad, int0, x_wrap=True)
+             for q in FIELDS}
+    rates0 = mhd_rates(data0, prm, dtype)
+    data1 = {}
+    w1 = {}
+    for q in FIELDS:
+        w1[q] = dt_ * rates0[q]                    # alpha_0 == 0
+        f1 = data0[q].value + beta0 * w1[q]
+        data1[q] = FieldData(f1, inv_ds, pad, int1, x_wrap=True)
+    rates1 = mhd_rates(data1, prm, dtype)
+    out_f = {}
+    out_w = {}
+    for q in FIELDS:
+        w1c = w1[q][R:R + bz, R:R + by]
+        wq = alpha1 * w1c + dt_ * rates1[q]
+        out_w[q] = wq
+        out_f[q] = data1[q].value + beta1 * wq
+    return out_f, out_w
+
+
 def mhd_substep01_wrap_pallas(fields: Dict[str, jnp.ndarray],
                               prm, dt_phys: float,
                               block_z: int = 8, block_y: int = 32,

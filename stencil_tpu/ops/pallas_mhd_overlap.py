@@ -59,6 +59,7 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
                                s: int, prm, dt_phys: float,
                                counts: Dim3,
                                block_z: int = 8, block_y: int = 32,
+                               pair: bool = False,
                                interpret: Optional[object] = None):
     """One overlapped RK3 MHD substep on interior-resident (Z, Y, X)
     shards: slab RDMA issued from inside the kernel, the fused
@@ -72,16 +73,24 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     ``mhd_substep_fixup_pallas``. Reference choreography:
     astaroth/astaroth.cu:552-646 (interior launch + transports in
     flight), compressed into one kernel.
+
+    ``pair=True`` fuses RK substeps 0+1 into the pass (the
+    STENCIL_MHD_PAIR temporal blocking, ``pallas_mhd.mhd_pair_update``):
+    ``s`` and the incoming ``w`` are ignored (alpha_0 == 0), the
+    windows and the RDMA carry radius 2R, and the slabs come back with
+    2R valid rows.
     """
     from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
     from .fd6 import FieldData
+    from .pallas_mhd import mhd_pair_update
 
     if interpret is None:
         interpret = _interpret_mode()
     assert counts.x == 1, "x (lane) axis must not be mesh-sharded"
+    hr = 2 * R if pair else R      # halo rows windows and DMAs carry
     Z, Y, X = fields[FIELDS[0]].shape
     bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
-    assert R <= min(bz, ESUB)
+    assert hr <= min(bz, ESUB), (hr, bz)
     dtype = fields[FIELDS[0]].dtype
     dta = jnp.dtype(dtype)
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
@@ -100,15 +109,21 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     # the halo kernel's own window plan in slabless mode: clamped
     # in-shard segments only, one source of truth for the geometry
     field_specs, inputs_for_field, select_window = _mhd_window_plan(
-        Z, Y, X, bz, by, rr=R, slabless=True)
+        Z, Y, X, bz, by, rr=hr, slabless=True)
     nseg = len(field_specs)
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
 
+    # pair mode never reads the incoming w (alpha_0 == 0): feeding it
+    # anyway would stream a full HBM read sweep of all 8 w fields per
+    # pass — exactly the sweep the pair exists to save — so the w
+    # inputs vanish from the operand list entirely
+    nw = 0 if pair else nf
+
     def kern(*refs):
         field_refs = refs[:nseg * nf]
-        w_refs = refs[nseg * nf:nseg * nf + nf]
-        any_refs = refs[nseg * nf + nf:nseg * nf + 2 * nf]
-        outs = refs[nseg * nf + 2 * nf:-2]
+        w_refs = refs[nseg * nf:nseg * nf + nw]
+        any_refs = refs[nseg * nf + nw:nseg * nf + nw + nf]
+        outs = refs[nseg * nf + nw + nf:-2]
         out_f = outs[:nf]
         out_w = outs[nf:2 * nf]
         zlo_o = outs[2 * nf:3 * nf]
@@ -135,21 +150,21 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
             if mz > 1:
                 return [
                     pltpu.make_async_remote_copy(
-                        src_ref=f_any.at[Z - R:Z],
-                        dst_ref=zlo_o[i].at[bz - R:bz],
+                        src_ref=f_any.at[Z - hr:Z],
+                        dst_ref=zlo_o[i].at[bz - hr:bz],
                         send_sem=send.at[i, 0], recv_sem=recv.at[i, 0],
                         device_id=nbr("z", mz, True)),
                     pltpu.make_async_remote_copy(
-                        src_ref=f_any.at[0:R],
-                        dst_ref=zhi_o[i].at[0:R],
+                        src_ref=f_any.at[0:hr],
+                        dst_ref=zhi_o[i].at[0:hr],
                         send_sem=send.at[i, 1], recv_sem=recv.at[i, 1],
                         device_id=nbr("z", mz, False)),
                 ]
             return [
-                pltpu.make_async_copy(f_any.at[Z - R:Z],
-                                      zlo_o[i].at[bz - R:bz],
+                pltpu.make_async_copy(f_any.at[Z - hr:Z],
+                                      zlo_o[i].at[bz - hr:bz],
                                       recv.at[i, 0]),
-                pltpu.make_async_copy(f_any.at[0:R], zhi_o[i].at[0:R],
+                pltpu.make_async_copy(f_any.at[0:hr], zhi_o[i].at[0:hr],
                                       recv.at[i, 1]),
             ]
 
@@ -160,23 +175,23 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
             if my > 1:
                 return [
                     pltpu.make_async_remote_copy(
-                        src_ref=f_any.at[:, Y - R:Y],
-                        dst_ref=ylo_o[i].at[bz:bz + Z, ESUB - R:ESUB],
+                        src_ref=f_any.at[:, Y - hr:Y],
+                        dst_ref=ylo_o[i].at[bz:bz + Z, ESUB - hr:ESUB],
                         send_sem=send.at[i, 2], recv_sem=recv.at[i, 2],
                         device_id=nbr("y", my, True)),
                     pltpu.make_async_remote_copy(
-                        src_ref=f_any.at[:, 0:R],
-                        dst_ref=yhi_o[i].at[bz:bz + Z, 0:R],
+                        src_ref=f_any.at[:, 0:hr],
+                        dst_ref=yhi_o[i].at[bz:bz + Z, 0:hr],
                         send_sem=send.at[i, 3], recv_sem=recv.at[i, 3],
                         device_id=nbr("y", my, False)),
                 ]
             return [
-                pltpu.make_async_copy(f_any.at[:, Y - R:Y],
+                pltpu.make_async_copy(f_any.at[:, Y - hr:Y],
                                       ylo_o[i].at[bz:bz + Z,
-                                                  ESUB - R:ESUB],
+                                                  ESUB - hr:ESUB],
                                       recv.at[i, 2]),
-                pltpu.make_async_copy(f_any.at[:, 0:R],
-                                      yhi_o[i].at[bz:bz + Z, 0:R],
+                pltpu.make_async_copy(f_any.at[:, 0:hr],
+                                      yhi_o[i].at[bz:bz + Z, 0:hr],
                                       recv.at[i, 3]),
             ]
 
@@ -185,27 +200,27 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
             sourced from MY landed z slabs (hence fired only after the
             slot-0/1 recv waits) — the corner ride-along of the
             sequential-sweep rule, as explicit messages."""
-            srcs = [
-                (zlo_o[i].at[bz - R:bz, Y - R:Y],
-                 lambda r: ylo_o[i].at[bz - R:bz, ESUB - R:ESUB], True, 4),
-                (zhi_o[i].at[0:R, Y - R:Y],
-                 lambda r: ylo_o[i].at[bz + Z:bz + Z + R, ESUB - R:ESUB],
+            pieces = [
+                (zlo_o[i].at[bz - hr:bz, Y - hr:Y],
+                 ylo_o[i].at[bz - hr:bz, ESUB - hr:ESUB], True, 4),
+                (zhi_o[i].at[0:hr, Y - hr:Y],
+                 ylo_o[i].at[bz + Z:bz + Z + hr, ESUB - hr:ESUB],
                  True, 5),
-                (zlo_o[i].at[bz - R:bz, 0:R],
-                 lambda r: yhi_o[i].at[bz - R:bz, 0:R], False, 6),
-                (zhi_o[i].at[0:R, 0:R],
-                 lambda r: yhi_o[i].at[bz + Z:bz + Z + R, 0:R], False, 7),
+                (zlo_o[i].at[bz - hr:bz, 0:hr],
+                 yhi_o[i].at[bz - hr:bz, 0:hr], False, 6),
+                (zhi_o[i].at[0:hr, 0:hr],
+                 yhi_o[i].at[bz + Z:bz + Z + hr, 0:hr], False, 7),
             ]
             out = []
-            for src, dstf, up, slot in srcs:
+            for src, dst, up, slot in pieces:
                 if my > 1:
                     out.append(pltpu.make_async_remote_copy(
-                        src_ref=src, dst_ref=dstf(None),
+                        src_ref=src, dst_ref=dst,
                         send_sem=send.at[i, slot],
                         recv_sem=recv.at[i, slot],
                         device_id=nbr("y", my, up)))
                 else:
-                    out.append(pltpu.make_async_copy(src, dstf(None),
+                    out.append(pltpu.make_async_copy(src, dst,
                                                      recv.at[i, slot]))
             return out
 
@@ -232,16 +247,22 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
                     c.start()
 
         # ---- interior compute for this block, behind the DMAs
-        data = {}
-        for i, q in enumerate(FIELDS):
-            win = select_window(field_refs[nseg * i:nseg * (i + 1)])
-            data[q] = FieldData(win, inv_ds, pad_lo, interior,
-                                x_wrap=True)
-        rates = mhd_rates(data, prm, dtype)
-        for i, q in enumerate(FIELDS):
-            wq = dta.type(alpha) * w_refs[i][...] + dta.type(dt_) * rates[q]
-            out_w[i][...] = wq
-            out_f[i][...] = data[q].value + dta.type(beta) * wq
+        wins = {q: select_window(field_refs[nseg * i:nseg * (i + 1)])
+                for i, q in enumerate(FIELDS)}
+        if pair:
+            f2, w2 = mhd_pair_update(wins, prm, dtype, dt_phys, bz, by)
+            for i, q in enumerate(FIELDS):
+                out_w[i][...] = w2[q]
+                out_f[i][...] = f2[q]
+        else:
+            data = {q: FieldData(wins[q], inv_ds, pad_lo, interior,
+                                 x_wrap=True) for q in FIELDS}
+            rates = mhd_rates(data, prm, dtype)
+            for i, q in enumerate(FIELDS):
+                wq = (dta.type(alpha) * w_refs[i][...]
+                      + dta.type(dt_) * rates[q])
+                out_w[i][...] = wq
+                out_f[i][...] = data[q].value + dta.type(beta) * wq
 
         # ---- phase B (still the first grid step, after one block of
         # compute): z slabs have landed — fire the corner pieces
@@ -265,9 +286,10 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     for q in FIELDS:
         in_specs.extend(field_specs)
         inputs.extend(inputs_for_field(fields[q]))
-    for q in FIELDS:
-        in_specs.append(main_spec)
-        inputs.append(w[q])
+    if not pair:
+        for q in FIELDS:
+            in_specs.append(main_spec)
+            inputs.append(w[q])
     for q in FIELDS:
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
         inputs.append(fields[q])
@@ -309,7 +331,8 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
                              slabs: Dict[str, Dict[str, jnp.ndarray]],
                              s: int, prm, dt_phys: float, strip: str,
                              block_z: int = 8, block_y: int = 32,
-                             interpret: Optional[bool] = None
+                             pair: bool = False,
+                             interpret: Optional[object] = None
                              ) -> Tuple[Dict[str, jnp.ndarray],
                                         Dict[str, jnp.ndarray]]:
     """Exterior pass of the overlapped substep: recompute the shard-edge
@@ -322,13 +345,16 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
     placeholders. Window values come from the halo kernel's own
     ``_mhd_window_plan`` (same slab selection → numerics identical to
     ``mhd_substep_halo_pallas``). ``fields``/``w`` are the PRE-substep
-    state. Reference: the exterior kernel launches of
-    astaroth/astaroth.cu:552-646."""
+    state. ``pair=True`` recomputes the fused substep-0+1 update on
+    radius-2R windows (slabs must carry 2R rows). Reference: the
+    exterior kernel launches of astaroth/astaroth.cu:552-646."""
     from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
     from .fd6 import FieldData
+    from .pallas_mhd import mhd_pair_update
 
     if interpret is None:
         interpret = default_interpret()
+    hr = 2 * R if pair else R
     Z, Y, X = fields[FIELDS[0]].shape
     bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
     nzg = Z // bz
@@ -356,7 +382,7 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
     nf = len(FIELDS)
 
     plan_specs, inputs_for_field, select_window = _mhd_window_plan(
-        Z, Y, X, bz, by, rr=R)
+        Z, Y, X, bz, by, rr=hr)
     nseg = len(plan_specs)
 
     def rm(spec):
@@ -368,19 +394,26 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
     field_specs = [rm(sp) for sp in plan_specs]
     main_spec = rm(pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)))
 
+    nw = 0 if pair else nf     # pair never reads w (alpha_0 == 0)
+
     def kern(*refs):
         field_refs = refs[:nseg * nf]
-        w_refs = refs[nseg * nf:nseg * nf + nf]
+        w_refs = refs[nseg * nf:nseg * nf + nw]
         # aliased f_partial/w_partial inputs follow; never read in-kern
-        out_f = refs[nseg * nf + 3 * nf:nseg * nf + 4 * nf]
-        out_w = refs[nseg * nf + 4 * nf:]
+        out_f = refs[nseg * nf + nw + 2 * nf:nseg * nf + nw + 3 * nf]
+        out_w = refs[nseg * nf + nw + 3 * nf:]
         kz, ky = remap(pl.program_id(0), pl.program_id(1))
-        data = {}
-        for i, q in enumerate(FIELDS):
-            win = select_window(field_refs[nseg * i:nseg * (i + 1)],
-                                kz=kz, ky=ky)
-            data[q] = FieldData(win, inv_ds, pad_lo, interior,
-                                x_wrap=True)
+        wins = {q: select_window(field_refs[nseg * i:nseg * (i + 1)],
+                                 kz=kz, ky=ky)
+                for i, q in enumerate(FIELDS)}
+        if pair:
+            f2, w2 = mhd_pair_update(wins, prm, dtype, dt_phys, bz, by)
+            for i, q in enumerate(FIELDS):
+                out_w[i][...] = w2[q]
+                out_f[i][...] = f2[q]
+            return
+        data = {q: FieldData(wins[q], inv_ds, pad_lo, interior,
+                             x_wrap=True) for q in FIELDS}
         rates = mhd_rates(data, prm, dtype)
         for i, q in enumerate(FIELDS):
             wq = dta.type(alpha) * w_refs[i][...] + dta.type(dt_) * rates[q]
@@ -392,9 +425,10 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
     for q in FIELDS:
         in_specs.extend(field_specs)
         inputs.extend(inputs_for_field(fields[q], slabs[q]))
-    for q in FIELDS:
-        in_specs.append(main_spec)
-        inputs.append(w[q])
+    if not pair:
+        for q in FIELDS:
+            in_specs.append(main_spec)
+            inputs.append(w[q])
     alias_base = len(inputs)
     for q in FIELDS:
         in_specs.append(main_spec)
@@ -428,13 +462,16 @@ def mhd_substep_overlap(fields: Dict[str, jnp.ndarray],
                         w: Dict[str, jnp.ndarray],
                         s: int, prm, dt_phys: float, counts: Dim3,
                         block_z: int = 8, block_y: int = 32,
+                        pair: bool = False,
                         interpret: Optional[object] = None
                         ) -> Tuple[Dict[str, jnp.ndarray],
                                    Dict[str, jnp.ndarray]]:
     """One full overlapped substep: RDMA-overlap interior kernel, then
     the z- and y-strip exterior fix-ups. Drop-in equivalent of an
     exchange + ``mhd_substep_halo_pallas`` call (same numerics), with
-    the exchange hidden behind the interior compute."""
+    the exchange hidden behind the interior compute. ``pair=True`` is
+    the fused substep-0+1 equivalent (one radius-2R overlapped
+    exchange + one pass for two substeps)."""
     from ..models.astaroth import FIELDS
 
     Z, Y, _ = fields[FIELDS[0]].shape
@@ -445,12 +482,14 @@ def mhd_substep_overlap(fields: Dict[str, jnp.ndarray],
     # must reach the aliased fix-up kernels too
     f1, w1, slabs = mhd_substep_overlap_pallas(
         fields, w, s, prm, dt_phys, counts, block_z=block_z,
-        block_y=block_y, interpret=interpret)
+        block_y=block_y, pair=pair, interpret=interpret)
     f1, w1 = mhd_substep_fixup_pallas(
         fields, w, f1, w1, slabs, s, prm, dt_phys, "z",
-        block_z=block_z, block_y=block_y, interpret=interpret)
+        block_z=block_z, block_y=block_y, pair=pair,
+        interpret=interpret)
     if nzg > 2:
         f1, w1 = mhd_substep_fixup_pallas(
             fields, w, f1, w1, slabs, s, prm, dt_phys, "y",
-            block_z=block_z, block_y=block_y, interpret=interpret)
+            block_z=block_z, block_y=block_y, pair=pair,
+            interpret=interpret)
     return f1, w1

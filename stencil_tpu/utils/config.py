@@ -48,6 +48,16 @@ def enable_compile_cache(path: str = "") -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
+def mhd_pair_requested() -> bool:
+    """STENCIL_MHD_PAIR=1 opts the MHD fast paths (wrap, halo, and
+    halo-overlap) into the fused RK substep-0+1 pair kernels — the ONE
+    parse of the flag, shared by every builder that gates on it."""
+    import os
+
+    return (os.environ.get("STENCIL_MHD_PAIR", "").lower()
+            in ("1", "true", "yes"))
+
+
 def wrap2_disabled() -> bool:
     """STENCIL_DISABLE_WRAP2=1 is the kill-switch harnesses use to fall
     back from the temporally-blocked pair kernels to the hardware-proven
