@@ -63,8 +63,11 @@ run timeout 2400 env STENCIL_MHD_PAIR=1 python apps/astaroth.py \
 
 # 6. overlap structure, single-chip (serialized vs in-kernel-RDMA
 #    schedule with local wrap copies; real overlap_efficiency needs
-#    multi-chip ICI — VERDICT r4 weak #2)
+#    multi-chip ICI — VERDICT r4 weak #2). MHD is where overlap pays
+#    3x per iteration.
 run timeout 2400 python apps/measure_overlap.py --x 256 --y 256 --z 256
+run timeout 2400 python apps/measure_overlap.py --model mhd \
+    --x 256 --y 256 --z 256 --iters 10
 
 # 7. headline JSON
 run python bench.py
