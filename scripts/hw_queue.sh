@@ -1,18 +1,73 @@
 #!/usr/bin/env bash
 # Hardware measurement queue: the ordered single-chip runs that
 # validate this round's kernels, sized so each item lands a number
-# (or a watchdog TIMEOUT line) even over a slow tunnel. Run when a
-# chip is reachable; results append to hw_queue_<ts>.log in CSV form.
-# The persistent compile cache (utils/config.enable_compile_cache)
-# makes reruns cheap once an item has compiled.
+# (or a watchdog TIMEOUT line) even over a slow tunnel. Results
+# append to hw_queue_<ts>.log in CSV form. The persistent compile
+# cache (utils/config.enable_compile_cache) makes reruns cheap once
+# an item has compiled.
+#
+# The axon tunnel FLAPS (up for a window, wedged for a while): a
+# probe can succeed and the very next backend init hang for 25 min
+# before dying UNAVAILABLE. So the queue treats chip access as a
+# perishable resource: it probes in a throwaway 90 s subprocess
+# before EVERY item, waits out downtime between items instead of
+# burning it inside backend init, and retries an item once if its
+# output shows the backend died mid-run. The highest-value
+# measurements (headline JSON, wrap pairs, halo pairs, bf16) are
+# ordered first so a short tunnel window still lands them.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 OUT="hw_queue_$(date +%Y%m%d_%H%M%S).log"
 echo "hw queue -> $OUT"
 WD=(--per-kernel-timeout 2400)
-run() { echo "== $*" | tee -a "$OUT"; "$@" 2>>"$OUT.err" | tee -a "$OUT"; }
+MAX_WAITS="${MAX_WAITS:-240}"   # 240 x 150 s = 10 h of patience, total
+waits=0
+. scripts/probe_tunnel.sh   # cwd is the repo root after the cd above
 
-# 1. headline + wrap depth ladder (validates jacobi7_wrapn on hardware)
+await_tunnel() {
+  while ! probe; do
+    waits=$((waits + 1))
+    echo "$(date +%T) tunnel down (wait $waits/$MAX_WAITS)" >>"$OUT"
+    if [ "$waits" -ge "$MAX_WAITS" ]; then
+      echo "$(date +%T) giving up: tunnel never recovered" | tee -a "$OUT"
+      exit 1
+    fi
+    sleep "$PROBE_INTERVAL_S"
+  done
+}
+
+run() {
+  # Apps without their own error handling (profile_wrap, measure_overlap,
+  # astaroth) only show a backend death in their stderr, so the retry
+  # check must read the new tail of BOTH $OUT and $OUT.err.
+  local attempt marker emarker
+  for attempt in 1 2; do
+    await_tunnel
+    echo "== [$(date +%T) try $attempt] $*" | tee -a "$OUT"
+    marker=$(wc -l <"$OUT")
+    emarker=$({ wc -l <"$OUT.err"; } 2>/dev/null || echo 0)
+    "$@" 2>>"$OUT.err" | tee -a "$OUT"
+    # tail -n +N starts AT line N, so +1 to read only this attempt's lines
+    if { tail -n +"$((marker + 1))" "$OUT";
+         tail -n +"$((emarker + 1))" "$OUT.err" 2>/dev/null; } \
+        | grep -q "Unable to initialize backend"; then
+      if [ "$attempt" -eq 2 ]; then
+        echo "-- backend died on both attempts; giving up on this item" \
+          | tee -a "$OUT"
+      else
+        echo "-- backend died mid-item; retrying after next good probe" \
+          | tee -a "$OUT"
+      fi
+      continue
+    fi
+    return 0
+  done
+}
+
+# 1. headline JSON first — the round artifact (fail-fast probe built in)
+run python bench.py
+
+# 2. wrap pairs (the 298 iters/s kernel) + depth ladder 3/4
 run python scripts/bench_kernels.py --model jacobi --kernels wrap \
     "${WD[@]}"
 for n in 3 4; do
@@ -20,11 +75,8 @@ for n in 3 4; do
       --model jacobi --kernels wrap "${WD[@]}"
 done
 
-# 1b. limiter evidence: stream ceiling + depth ladder + verdict line
-#     (what binds at 298 vs the ~500 traffic bound — BASELINE.md)
-run timeout 2400 python scripts/profile_wrap.py
-
-# 2. halo path: single-step vs pair vs depth-3 (multi-chip compute path)
+# 3. halo path: single-step vs pair vs depth-3 (multi-chip compute path;
+#    the halo-vs-wrap gap is VERDICT r4 weak #2)
 run env STENCIL_DISABLE_WRAP2=1 python scripts/bench_kernels.py \
     --model jacobi --kernels halo "${WD[@]}"
 run python scripts/bench_kernels.py --model jacobi --kernels halo \
@@ -32,11 +84,15 @@ run python scripts/bench_kernels.py --model jacobi --kernels halo \
 run env STENCIL_WRAP_STEPS=3 python scripts/bench_kernels.py \
     --model jacobi --kernels halo "${WD[@]}"
 
-# 3. bf16 wrap + halo (half-traffic ladder)
+# 4. bf16 wrap + halo (half-traffic ladder)
 run python scripts/bench_kernels.py --model jacobi --kernels wrap,halo \
     --dtype bf16 "${WD[@]}"
 
-# 4. MHD wrap (thin-z + x-roll scheme) at candidate blockings,
+# 5. limiter evidence: stream ceiling + depth ladder + verdict line
+#    (what binds at 298 vs the ~500 traffic bound — BASELINE.md)
+run timeout 2400 python scripts/profile_wrap.py
+
+# 6. MHD wrap (thin-z + x-roll scheme) at candidate blockings,
 #    plus the round-3 tiled-z layout as the A/B control
 for b in "8,64" "8,32" "16,64"; do
   run python scripts/bench_kernels.py --model mhd --kernels wrap \
@@ -47,7 +103,7 @@ run env STENCIL_MHD_THINZ=0 python scripts/bench_kernels.py --model mhd \
 run env STENCIL_MHD_PAIR=1 python scripts/bench_kernels.py --model mhd \
     --kernels wrap --blocks "8,32" "${WD[@]}"
 
-# 5. MHD halo (x-roll window), thin-z default + tiled-z control,
+# 7. MHD halo (x-roll window), thin-z default + tiled-z control,
 #    plus the fused substep-0+1 pair on the halo path
 run python scripts/bench_kernels.py --model mhd --kernels halo \
     "${WD[@]}"
@@ -61,7 +117,7 @@ run env STENCIL_MHD_PAIR=1 python scripts/bench_kernels.py --model mhd \
 run timeout 2400 env STENCIL_MHD_PAIR=1 python apps/astaroth.py \
     --nx 256 --ny 256 --nz 256 --iters 10 --kernel halo --overlap
 
-# 6. overlap structure, single-chip (serialized vs in-kernel-RDMA
+# 8. overlap structure, single-chip (serialized vs in-kernel-RDMA
 #    schedule with local wrap copies; real overlap_efficiency needs
 #    multi-chip ICI — VERDICT r4 weak #2). MHD is where overlap pays
 #    3x per iteration.
@@ -69,6 +125,6 @@ run timeout 2400 python apps/measure_overlap.py --x 256 --y 256 --z 256
 run timeout 2400 python apps/measure_overlap.py --model mhd \
     --x 256 --y 256 --z 256 --iters 10
 
-# 7. headline JSON
+# 9. headline JSON again at the end (fresh record after the campaign)
 run python bench.py
 echo "hw queue complete -> $OUT"

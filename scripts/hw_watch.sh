@@ -5,13 +5,12 @@
 # between probes (each probe is its own short-lived process).
 set -u -o pipefail
 cd "$(dirname "$0")/.."
+. scripts/probe_tunnel.sh   # cwd is the repo root after the cd above
 LOG="hw_watch.log"
 MAX_PROBES="${1:-200}"
 echo "$(date +%T) watcher start (max $MAX_PROBES probes)" | tee -a "$LOG"
 for ((i = 1; i <= MAX_PROBES; i++)); do
-  if timeout 90 python -c \
-      "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d" \
-      >/dev/null 2>&1; then
+  if probe; then
     echo "$(date +%T) tunnel UP on probe $i — running hw queue" | tee -a "$LOG"
     bash scripts/hw_queue.sh 2>&1 | tee -a "$LOG"
     rc=$?
@@ -19,7 +18,7 @@ for ((i = 1; i <= MAX_PROBES; i++)); do
     exit "$rc"
   fi
   echo "$(date +%T) probe $i: tunnel down" >>"$LOG"
-  sleep 150
+  sleep "$PROBE_INTERVAL_S"
 done
 echo "$(date +%T) watcher gave up after $MAX_PROBES probes" | tee -a "$LOG"
 exit 1

@@ -1,0 +1,12 @@
+# Shared axon-tunnel probe: sourced by hw_queue.sh and hw_watch.sh so
+# the two agree on what "tunnel up" means. A throwaway subprocess with
+# a hard timeout — a wedged backend init hangs without ever raising
+# (it waits on RPC delivery), so an in-process check cannot catch it.
+PROBE_TIMEOUT_S="${PROBE_TIMEOUT_S:-90}"
+PROBE_INTERVAL_S="${PROBE_INTERVAL_S:-150}"
+
+probe() {
+  timeout "$PROBE_TIMEOUT_S" python -c \
+    "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d" \
+    >/dev/null 2>&1
+}
