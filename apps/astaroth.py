@@ -8,8 +8,10 @@ iteration loop, CSV line ``devices,nx,ny,nz,iter trimean,exch trimean``
 
 import argparse
 
-from _common import (add_dcn_flags, add_device_flags, apply_device_flags,
+from _common import (add_dcn_flags, add_device_flags, add_dtype_flags,
+                     apply_device_flags,
                      add_method_flags, csv_line, dcn_from_args,
+                     dtype_from_args,
                      dcn_mesh_shape, methods_from_args, timed_samples)
 
 
@@ -20,7 +22,7 @@ def main() -> None:
     ap.add_argument("--ny", type=int, default=64)
     ap.add_argument("--nz", type=int, default=64)
     ap.add_argument("--iters", "-n", type=int, default=10)
-    ap.add_argument("--f64", action="store_true")
+    add_dtype_flags(ap)
     ap.add_argument("--paraview-init", action="store_true")
     ap.add_argument("--paraview-final", action="store_true")
     ap.add_argument("--prefix", default="")
@@ -45,9 +47,7 @@ def main() -> None:
     add_device_flags(ap)
     args = ap.parse_args()
     apply_device_flags(args)
-    if getattr(args, 'f64', False):
-        import jax
-        jax.config.update('jax_enable_x64', True)
+    dtype = dtype_from_args(args)
 
     import jax
     import numpy as np
@@ -71,7 +71,7 @@ def main() -> None:
     gy = args.ny * mesh_shape.y
     gz = args.nz * mesh_shape.z
     m = Astaroth(gx, gy, gz, params=prm, mesh_shape=mesh_shape,
-                 dtype=np.float64 if args.f64 else np.float32,
+                 dtype=dtype,
                  methods=methods_from_args(args), overlap=args.overlap,
                  kernel=args.kernel, **dcn_from_args(args))
     m.init()

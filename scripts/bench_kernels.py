@@ -140,13 +140,17 @@ def _patch_jacobi_blocks(j, kernel, blocks):
             pallas_halo.fit_pair_halo_blocks = orig_fit
 
 
-def bench_mhd(size, iters, kernels, blocks):
+def bench_mhd(size, iters, kernels, blocks, dtype="f32"):
     import jax
+    import jax.numpy as jnp
     from stencil_tpu.models.astaroth import Astaroth
+
+    dt = jnp.bfloat16 if dtype == "bf16" else jnp.float32
 
     def ctor(kernel):
         return Astaroth(size, size, size, mesh_shape=(1, 1, 1),
-                        devices=jax.devices()[:1], kernel=kernel)
+                        devices=jax.devices()[:1], kernel=kernel,
+                        dtype=dt)
 
     _bench_model("mhd", ctor, size, iters, kernels, blocks,
                  _patch_mhd_blocks, warmup=2)
@@ -154,9 +158,21 @@ def bench_mhd(size, iters, kernels, blocks):
 
 def _patch_mhd_blocks(m, kernel, blocks):
     import functools
+    import sys
     from stencil_tpu.ops import pallas_mhd
 
     bz, by = blocks
+    # the kernels snap non-tile-multiple blocks down to the dtype's
+    # sublane tile (16-row for bf16): say so, or the CSV row would be
+    # labeled with a shape that was never measured (same stderr note
+    # the jacobi sweep prints on a substituted blocking)
+    local = m.dd.local_size
+    tile = pallas_mhd.mhd_tile(m._dtype)
+    actual = pallas_mhd._fit_blocks(local.z, local.y, bz, by, tile)
+    if actual != (bz, by):
+        print(f"note: blocks {bz},{by} snapped to "
+              f"{actual[0]},{actual[1]} (dtype tile {tile}, local "
+              f"{local.z}x{local.y})", file=sys.stderr)
     if kernel == "wrap":
         # patch the fused substep-0+1 kernel too (STENCIL_MHD_PAIR=1
         # runs it for two of the three substeps)
@@ -252,7 +268,8 @@ def main():
     ap.add_argument("--blocks", default="",
                     help="bz,by override for pallas kernels")
     ap.add_argument("--dtype", default="f32", choices=("f32", "bf16"),
-                    help="jacobi field dtype (bf16 halves HBM traffic)")
+                    help="field dtype (bf16 halves HBM traffic; MHD "
+                         "bf16 stores half-width, computes f32)")
     ap.add_argument("--fake-cpu", type=int, default=0, metavar="N",
                     help="run on N virtual CPU devices (smoke mode)")
     ap.add_argument("--per-kernel-timeout", type=int, default=0,
@@ -281,7 +298,7 @@ def main():
     if args.model in ("mhd", "both"):
         size = args.size or (256 if on_tpu else 16)
         iters = args.iters or (20 if on_tpu else 2)
-        bench_mhd(size, iters, kernels, blocks)
+        bench_mhd(size, iters, kernels, blocks, args.dtype)
 
 
 if __name__ == "__main__":

@@ -117,6 +117,16 @@ run env STENCIL_MHD_PAIR=1 python scripts/bench_kernels.py --model mhd \
 run timeout 2400 env STENCIL_MHD_PAIR=1 python apps/astaroth.py \
     --nx 256 --ny 256 --nz 256 --iters 10 --kernel halo --overlap
 
+# 7b. MHD bf16 (storage bf16, compute f32 — ops/pallas_mhd
+#     .compute_dtype): the half-traffic ladder for the MHD app;
+#     wrap + halo, then the substep-pair composition
+run python scripts/bench_kernels.py --model mhd --kernels wrap,halo \
+    --dtype bf16 "${WD[@]}"
+run env STENCIL_MHD_PAIR=1 python scripts/bench_kernels.py --model mhd \
+    --kernels wrap --dtype bf16 "${WD[@]}"
+run env STENCIL_MHD_PAIR=1 python scripts/bench_kernels.py --model mhd \
+    --kernels halo --dtype bf16 "${WD[@]}"
+
 # 8. overlap structure, single-chip (serialized vs in-kernel-RDMA
 #    schedule with local wrap copies; real overlap_efficiency needs
 #    multi-chip ICI — VERDICT r4 weak #2). MHD is where overlap pays

@@ -498,9 +498,14 @@ class DistributedDomain:
                 np.arange(org.y, org.y + sz.y),
                 np.arange(org.x, org.x + sz.x), indexing="ij")
             cols = [gz.ravel(), gy.ravel(), gx.ravel()]
-            cols += [interiors[q][org.z:org.z + sz.z,
-                                  org.y:org.y + sz.y,
-                                  org.x:org.x + sz.x].ravel()
+            # bfloat16 (ml_dtypes) cannot promote against the int64
+            # index columns in column_stack; widen to f32 for the dump
+            cols += [np.asarray(
+                interiors[q][org.z:org.z + sz.z,
+                             org.y:org.y + sz.y,
+                             org.x:org.x + sz.x].ravel(),
+                dtype=np.float32 if self._dtypes[q].itemsize < 4
+                else self._dtypes[q])
                      for q in self._names]
             table = np.column_stack(cols)
             header = "Z,Y,X," + ",".join(self._names)

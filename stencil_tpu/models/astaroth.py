@@ -104,6 +104,16 @@ class MhdParams:
         return cls(**kw)
 
 
+def _fast_dtype_ok(dtype) -> bool:
+    """True when the fused Pallas kernel paths support ``dtype``:
+    float32 (native) and bfloat16 (stored half-width, computed in
+    float32 — see ops/pallas_mhd.compute_dtype). float64 falls back
+    to the XLA path (TPU f64 is emulated anyway)."""
+    import jax.numpy as jnp
+    return np.dtype(dtype) in (np.dtype(np.float32),
+                               np.dtype(jnp.bfloat16))
+
+
 def _dot(a, b):
     return a[0] * b[0] + a[1] * b[1] + a[2] * b[2]
 
@@ -235,7 +245,7 @@ class Astaroth:
             from ..ops.pallas_stencil import on_tpu
             halo_want = (kernel == "halo"
                          or (kernel == "auto" and on_tpu()
-                             and np.dtype(dtype) == np.float32))
+                             and _fast_dtype_ok(dtype)))
             shape = _dcn_xfree_shape(Dim3(nx, ny, nz),
                                      self.dd._devices, dcn_axis,
                                      dcn_groups,
@@ -253,7 +263,7 @@ class Astaroth:
             if (len(self.dd._devices) > 1
                     and (kernel == "halo"
                          or (kernel == "auto" and on_tpu()
-                             and np.dtype(dtype) == np.float32))):
+                             and _fast_dtype_ok(dtype)))):
                 # prefer an x-unsharded decomposition so the fused halo
                 # megakernel path is available (ops/pallas_halo.py)
                 from ..partition import partition_dims_even_xfree
@@ -371,9 +381,11 @@ class Astaroth:
         # single-chip fast path: the fused Pallas "solve" megakernel
         # with periodic wrap in-kernel (ops/pallas_mhd.py) — ~25x the
         # slicing formulation at 256^3
-        aligned8 = (rem == Dim3(0, 0, 0)
-                    and local.z % 8 == 0 and local.y % 8 == 0)
-        aligned = aligned8 and not self._overlap
+        from ..ops.pallas_mhd import mhd_tile
+        tile = mhd_tile(self._dtype)
+        aligned_t = (rem == Dim3(0, 0, 0)
+                     and local.z % tile == 0 and local.y % tile == 0)
+        aligned = aligned_t and not self._overlap
         wrap_ok = counts == Dim3(1, 1, 1) and aligned
         # multi-device fast path: interior-resident shards + slab
         # exchange + fused halo megakernel (ops/pallas_halo.py)
@@ -384,7 +396,13 @@ class Astaroth:
         # (ops/pallas_mhd_overlap.py) — explicit kernel='halo' +
         # overlap opts in anywhere (tests run it interpreted); 'auto'
         # takes it on real TPU hardware with f32 fields
-        rdma_overlap_ok = (self._overlap and counts.x == 1 and aligned8)
+        # bf16 is excluded: ops/pallas_mhd_overlap has no 16-row slab
+        # tiling (f32/f64 keep the pre-bf16 behavior)
+        import jax.numpy as _jnp
+        rdma_overlap_ok = (self._overlap and counts.x == 1
+                           and aligned_t
+                           and np.dtype(self._dtype)
+                           != np.dtype(_jnp.bfloat16))
         if rdma_overlap_ok:
             from ..ops.pallas_stencil import on_tpu
             if (kernel == "halo"
@@ -399,7 +417,7 @@ class Astaroth:
         if kernel == "auto":
             from ..ops.pallas_stencil import on_tpu
             from ..utils.logging import LOG_INFO
-            if on_tpu() and self._dtype == np.float32:
+            if on_tpu() and _fast_dtype_ok(self._dtype):
                 kernel = ("wrap" if wrap_ok
                           else "halo" if halo_ok else "xla")
             else:
@@ -407,27 +425,31 @@ class Astaroth:
             why = ""
             if kernel == "xla" and on_tpu():
                 blockers = []
-                if self._dtype != np.float32:
+                if not _fast_dtype_ok(self._dtype):
                     blockers.append(f"dtype {np.dtype(self._dtype).name}")
                 if counts.x != 1:
                     blockers.append("x-axis sharded")
                 if not aligned:
-                    blockers.append("uneven grid / z,y % 8 != 0 / "
-                                    "overlap requested")
+                    blockers.append(
+                        f"uneven grid / z,y % {tile} != 0 / "
+                        "overlap requested")
                 why = f" (fast paths unavailable: {', '.join(blockers)})"
             LOG_INFO(f"astaroth kernel path: {kernel}{why}")
         if kernel == "wrap":
             if not wrap_ok:
-                raise ValueError("kernel='wrap' needs a (1,1,1) mesh, even "
-                                 "grid, z/y multiples of 8, overlap off")
+                raise ValueError(
+                    "kernel='wrap' needs a (1,1,1) mesh, even grid, z/y "
+                    f"multiples of the dtype sublane tile ({tile}), "
+                    "overlap off")
             self.kernel_path = "wrap"
             self._build_wrap_step()
             return
         if kernel == "halo":
             if not halo_ok:
-                raise ValueError("kernel='halo' needs an x-unsharded mesh, "
-                                 "even grid, local z/y multiples of 8, "
-                                 "overlap off")
+                raise ValueError(
+                    "kernel='halo' needs an x-unsharded mesh, even grid, "
+                    f"local z/y multiples of the dtype sublane tile "
+                    f"({tile}), overlap off")
             self.kernel_path = "halo"
             self._build_halo_step()
             return
@@ -527,8 +549,9 @@ class Astaroth:
         Same extract / substep-loop / insert program split (and
         interior-resident caching) as wrap mode, but each program is
         shard_map'ped over the mesh."""
-        from ..ops.pallas_halo import (ESUB, R as HALO_R, mhd_halo_blocks,
+        from ..ops.pallas_halo import (R as HALO_R, mhd_halo_blocks,
                                        mhd_substep_halo_pallas)
+        from ..ops.pallas_mhd import mhd_tile
         from ..parallel.exchange import exchange_interior_slabs
 
         dd = self.dd
@@ -537,18 +560,19 @@ class Astaroth:
         counts = mesh_dim(dd.mesh)
         prm = self.prm
         dt = prm.dt
+        tile = mhd_tile(self._dtype)   # 8 f32/f64, 16 bf16 slabs
         blk_z, blk_y = getattr(self, "_halo_blocks", None) or (8, 32)
-        bz, by = mhd_halo_blocks(local.z, local.y, blk_z, blk_y)
+        bz, by = mhd_halo_blocks(local.z, local.y, blk_z, blk_y, tile)
         spec = P("z", "y", "x")
         fields_spec = {q: spec for q in FIELDS}
 
         # STENCIL_MHD_PAIR=1: fused substep-0+1 kernel on the halo path
         # too — one radius-2R exchange + one HBM pass covers two of the
         # three RK substeps (same opt-in as the wrap path; needs the
-        # slabs to carry 2R valid rows, hence 2R <= min(bz, ESUB))
+        # slabs to carry 2R valid rows, hence 2R <= min(bz, tile))
         from ..utils.config import mhd_pair_requested
         pair_on = (mhd_pair_requested()
-                   and 2 * HALO_R <= min(bz, ESUB))
+                   and 2 * HALO_R <= min(bz, tile))
         if pair_on:
             from ..ops.pallas_halo import mhd_substep01_halo_pallas
             from ..utils.logging import LOG_INFO
@@ -566,7 +590,7 @@ class Astaroth:
 
         def exchange_all(f, radius_rows):
             return {q: exchange_interior_slabs(
-                f[q], counts, rz=bz, ry=ESUB,
+                f[q], counts, rz=bz, ry=tile,
                 radius_rows=radius_rows, y_z_extended=True)
                 for q in FIELDS}
 
@@ -605,7 +629,7 @@ class Astaroth:
         # exchange accounting for exchange_stats(): per iteration the
         # pair path does one radius-2R + one radius-R extended slab
         # round; the sequential path three radius-R rounds
-        self._slab_exchange_cfg = dict(rz=bz, pair=pair_on)
+        self._slab_exchange_cfg = dict(rz=bz, ry=tile, pair=pair_on)
         self._install_inner_iter(extract, loop)
 
     def _build_halo_overlap_step(self) -> None:
@@ -686,7 +710,7 @@ class Astaroth:
         # same wire traffic as the sequential halo path (pair: one
         # radius-2R + one radius-R round; else 3 radius-R rounds per
         # iteration), issued in-kernel
-        self._slab_exchange_cfg = dict(rz=bz, pair=pair_on)
+        self._slab_exchange_cfg = dict(rz=bz, ry=ESUB, pair=pair_on)
         self._install_inner_iter(extract, loop)
 
     def _install_inner_iter(self, extract, loop) -> None:
@@ -759,7 +783,8 @@ class Astaroth:
             def rnd(r):
                 return measure_slab_exchange_seconds(
                     self.dd.mesh, self.dd.local_size, self._dtype,
-                    rz=cfg["rz"], ry=ESUB, radius_rows=r,
+                    rz=cfg["rz"], ry=cfg.get("ry", ESUB),
+                    radius_rows=r,
                     y_z_extended=True, nfields=len(FIELDS), reps=reps)
 
             if cfg["pair"]:

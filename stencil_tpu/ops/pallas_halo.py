@@ -539,23 +539,22 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
 
 
 def mhd_halo_blocks(Z: int, Y: int, block_z: int = 8,
-                    block_y: int = 32) -> Tuple[int, int]:
+                    block_y: int = 32,
+                    esub: int = ESUB) -> Tuple[int, int]:
     """The (bz, by) blocking the MHD halo kernel will use for a
     (Z, Y, ·) shard — exposed so the slab exchange can size its z slabs
     to match (zlo/zhi must be (bz, Y, X); see mhd_substep_halo_pallas).
-    Both are multiples of ESUB and divide Z / Y."""
-    assert Z % ESUB == 0 and Y % ESUB == 0, (Z, Y)
-    bz, by = block_z, block_y
-    while bz > ESUB and Z % bz:
-        bz -= ESUB
-    while by > ESUB and Y % by:
-        by -= ESUB
-    assert bz % ESUB == 0 and by % ESUB == 0 and Z % bz == 0 and Y % by == 0
-    return bz, by
+    Both are multiples of the dtype's ``esub`` sublane tile (8 f32 /
+    16 bf16) and divide Z / Y. One rule shared with the wrap kernels
+    (pallas_mhd._fit_blocks) so the two paths never diverge."""
+    from .pallas_mhd import _fit_blocks
+
+    return _fit_blocks(Z, Y, block_z, block_y, esub)
 
 
 def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
-                     rr: int = R, slabless: bool = False):
+                     rr: int = R, slabless: bool = False,
+                     esub: int = ESUB):
     """One closed unit (specs, inputs_for_field, select_window) for the
     MHD halo kernel's per-field stencil neighborhood on the slab
     layout — the spec list, the matching input ordering, and the
@@ -565,8 +564,8 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
     kernel.
 
     ``rr`` is the window radius: R for one substep, 2R for the fused
-    substep-0+1 pair (ring recompute). Needs rr <= ESUB (slab buffers
-    are one ESUB tile wide) and rr <= bz (z slabs hold bz rows); the
+    substep-0+1 pair (ring recompute). Needs rr <= esub (slab buffers
+    are one esub tile wide) and rr <= bz (z slabs hold bz rows); the
     slabs must carry rr valid rows (``radius_rows=rr`` at the
     exchange).
 
@@ -588,23 +587,23 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
     segments are SINGLE ROWS at exactly the radius (z is the majormost,
     untiled dim) — at (8, 64) blocks this cuts per-block read
     amplification from ~4.5x to ~2.2x. STENCIL_MHD_THINZ=0 (tiled, 21
-    specs/field) restores ESUB-row z tiles (the round-3
+    specs/field) restores esub-row z tiles (the round-3
     hardware-measured layout, kept for A/B). Corner segments always
-    stay at ESUB granularity (a small fraction of the traffic).
+    stay at esub granularity (a small fraction of the traffic).
 
     Index-map geometry: the interior array A is (Z, Y, X); z slabs
     (bz, Y, X) with the adjacent planes at zlo[-1] / zhi[0]; y slabs
-    (Z + 2*bz, ry=ESUB, X), z origin at -bz (z-extended so yz corner
+    (Z + 2*bz, ry=esub, X), z origin at -bz (z-extended so yz corner
     data rides along).
     """
     from .pallas_mhd import _thin_z
 
-    assert rr <= ESUB and rr <= bz, (rr, ESUB, bz)
+    assert rr <= esub and rr <= bz, (rr, esub, bz)
     thin = _thin_z()
-    bzb = bz // ESUB
-    byb = by // ESUB
-    nzb8 = Z // ESUB
-    nyb8 = Y // ESUB
+    bzb = bz // esub
+    byb = by // esub
+    nzb8 = Z // esub
+    nyb8 = Y // esub
     nzg = Z // bz
     nyg = Y // by
 
@@ -657,51 +656,51 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
                                                          ky, 0), 0))
                    for j in range(rr)]
     else:
-        i_zm0_in = add("f", (ESUB, by, X),
+        i_zm0_in = add("f", (esub, by, X),
                        lambda kz, ky: (clampz(kz), ky, 0))
-        i_zm0_zs = add("zlo", (ESUB, by, X),
+        i_zm0_zs = add("zlo", (esub, by, X),
                        lambda kz, ky: (bzb - 1,
                                        jnp.where(kz == 0, ky, 0), 0))
-        i_zp0_in = add("f", (ESUB, by, X),
+        i_zp0_in = add("f", (esub, by, X),
                        lambda kz, ky: (clampZ(kz), ky, 0))
-        i_zp0_zs = add("zhi", (ESUB, by, X),
+        i_zp0_zs = add("zhi", (esub, by, X),
                        lambda kz, ky: (0, jnp.where(kz == nzg - 1,
                                                     ky, 0), 0))
     # z0_ym / z0_yp: rows y in [ky*by-8, ky*by) / [ky*by+by, +8)
-    i_ym_in = add("f", (bz, ESUB, X),
+    i_ym_in = add("f", (bz, esub, X),
                   lambda kz, ky: (kz, clampy(ky), 0))
-    i_ym_ys = add("ylo", (bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0))
-    i_yp_in = add("f", (bz, ESUB, X),
+    i_ym_ys = add("ylo", (bz, esub, X), lambda kz, ky: (kz + 1, 0, 0))
+    i_yp_in = add("f", (bz, esub, X),
                   lambda kz, ky: (kz, clampY(ky), 0))
-    i_yp_ys = add("yhi", (bz, ESUB, X), lambda kz, ky: (kz + 1, 0, 0))
+    i_yp_ys = add("yhi", (bz, esub, X), lambda kz, ky: (kz + 1, 0, 0))
     # corners (8, 8, X): (in-shard, z-slab, y-slab) source triples
-    i_mm = (add("f", (ESUB, ESUB, X),
+    i_mm = (add("f", (esub, esub, X),
                 lambda kz, ky: (clampz(kz), clampy(ky), 0)),
-            add("zlo", (ESUB, ESUB, X),
+            add("zlo", (esub, esub, X),
                 lambda kz, ky: (bzb - 1,
                                 jnp.where(kz == 0, clampy(ky), 0), 0)),
-            add("ylo", (ESUB, ESUB, X),
+            add("ylo", (esub, esub, X),
                 lambda kz, ky: ((kz + 1) * bzb - 1, 0, 0)))
-    i_mp = (add("f", (ESUB, ESUB, X),
+    i_mp = (add("f", (esub, esub, X),
                 lambda kz, ky: (clampz(kz), clampY(ky), 0)),
-            add("zlo", (ESUB, ESUB, X),
+            add("zlo", (esub, esub, X),
                 lambda kz, ky: (bzb - 1,
                                 jnp.where(kz == 0, clampY(ky), 0), 0)),
-            add("yhi", (ESUB, ESUB, X),
+            add("yhi", (esub, esub, X),
                 lambda kz, ky: ((kz + 1) * bzb - 1, 0, 0)))
-    i_pm = (add("f", (ESUB, ESUB, X),
+    i_pm = (add("f", (esub, esub, X),
                 lambda kz, ky: (clampZ(kz), clampy(ky), 0)),
-            add("zhi", (ESUB, ESUB, X),
+            add("zhi", (esub, esub, X),
                 lambda kz, ky: (0, jnp.where(kz == nzg - 1,
                                              clampy(ky), 0), 0)),
-            add("ylo", (ESUB, ESUB, X),
+            add("ylo", (esub, esub, X),
                 lambda kz, ky: ((kz + 2) * bzb, 0, 0)))
-    i_pp = (add("f", (ESUB, ESUB, X),
+    i_pp = (add("f", (esub, esub, X),
                 lambda kz, ky: (clampZ(kz), clampY(ky), 0)),
-            add("zhi", (ESUB, ESUB, X),
+            add("zhi", (esub, esub, X),
                 lambda kz, ky: (0, jnp.where(kz == nzg - 1,
                                              clampY(ky), 0), 0)),
-            add("yhi", (ESUB, ESUB, X),
+            add("yhi", (esub, esub, X),
                 lambda kz, ky: ((kz + 2) * bzb, 0, 0)))
 
     def inputs_for_field(f, slabs=None):
@@ -749,11 +748,11 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
             zp_rows = [sel(i_zp_in[i], i_zp_zs[i], at_zhi)
                        for i in range(rr)]
         else:
-            # tiled ESUB blocks: the adjacent rr rows sit at the tile
+            # tiled esub blocks: the adjacent rr rows sit at the tile
             # end (zm) / start (zp)
             zm_y0 = sel(i_zm0_in, i_zm0_zs, at_zlo)
             zp_y0 = sel(i_zp0_in, i_zp0_zs, at_zhi)
-            zm_rows = [zm_y0[ESUB - rr + i:ESUB - rr + i + 1]
+            zm_rows = [zm_y0[esub - rr + i:esub - rr + i + 1]
                        for i in range(rr)]
             zp_rows = [zp_y0[i:i + 1] for i in range(rr)]
         z0_ym = sel(i_ym_in, i_ym_ys, at_ylo)
@@ -763,20 +762,20 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
         zp_ym = sel3(i_pm, at_zhi, at_ylo)
         zp_yp = sel3(i_pp, at_zhi, at_yhi)
         c = refs[i_main][...]
-        # corner blocks are ESUB rows; the zm rows sit at block rows
-        # ESUB-rr+i, the zp rows at block rows i
+        # corner blocks are esub rows; the zm rows sit at block rows
+        # esub-rr+i, the zp rows at block rows i
         rows = [
             jnp.concatenate(
-                [zm_ym[ESUB - rr + i:ESUB - rr + i + 1, ESUB - rr:],
+                [zm_ym[esub - rr + i:esub - rr + i + 1, esub - rr:],
                  zm_rows[i],
-                 zm_yp[ESUB - rr + i:ESUB - rr + i + 1, :rr]], axis=1)
+                 zm_yp[esub - rr + i:esub - rr + i + 1, :rr]], axis=1)
             for i in range(rr)
         ]
         rows.append(
-            jnp.concatenate([z0_ym[:, ESUB - rr:], c, z0_yp[:, :rr]],
+            jnp.concatenate([z0_ym[:, esub - rr:], c, z0_yp[:, :rr]],
                             axis=1))
         rows.extend(
-            jnp.concatenate([zp_ym[i:i + 1, ESUB - rr:], zp_rows[i],
+            jnp.concatenate([zp_ym[i:i + 1, esub - rr:], zp_rows[i],
                              zp_yp[i:i + 1, :rr]], axis=1)
             for i in range(rr))
         # x stays at full (unsharded, periodic) width: the per-
@@ -804,22 +803,25 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     mesh (x unsharded, wrap in-core).
 
     ``slabs[q]`` comes from ``exchange_interior_slabs(fields[q],
-    counts, rz=bz, ry=ESUB, radius_rows=R, y_z_extended=True)`` with
+    counts, rz=bz, ry=esub, radius_rows=R, y_z_extended=True)`` with
     (bz, _) = ``mhd_halo_blocks(Z, Y, block_z, block_y)``.
     Returns (new_fields, new_w).
     """
     from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
     from .fd6 import FieldData
+    from .pallas_mhd import compute_dtype, mhd_tile
 
     if interpret is None:
         interpret = default_interpret()
     Z, Y, X = fields[FIELDS[0]].shape
-    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
+    dtype = fields[FIELDS[0]].dtype
+    esub = mhd_tile(dtype)
+    comp = compute_dtype(dtype)
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub)
     for q in FIELDS:
         assert slabs[q]["zlo"].shape == (bz, Y, X), slabs[q]["zlo"].shape
-        assert slabs[q]["ylo"].shape == (Z + 2 * bz, ESUB, X), \
+        assert slabs[q]["ylo"].shape == (Z + 2 * bz, esub, X), \
             slabs[q]["ylo"].shape
-    dtype = fields[FIELDS[0]].dtype
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
     alpha = float(RK3_ALPHA[s])
     beta = float(RK3_BETA[s])
@@ -829,7 +831,7 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     nzg = Z // bz
     nyg = Y // by
     field_specs, inputs_for_field, select_window = _mhd_window_plan(
-        Z, Y, X, bz, by)
+        Z, Y, X, bz, by, esub=esub)
     nseg = len(field_specs)    # layout-dependent; kern slicing derives from it
     nf = len(FIELDS)
 
@@ -843,14 +845,16 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
         data = {}
         for i, q in enumerate(FIELDS):
             win = select_window(field_refs[nseg * i:nseg * (i + 1)])
-            data[q] = FieldData(win, inv_ds, pad_lo, interior,
-                                x_wrap=True)
-        rates = mhd_rates(data, prm, dtype)
-        dta = jnp.dtype(dtype)
+            data[q] = FieldData(win.astype(comp), inv_ds, pad_lo,
+                                interior, x_wrap=True)
+        rates = mhd_rates(data, prm, comp)
+        dta = jnp.dtype(comp)
         for i, q in enumerate(FIELDS):
-            wq = dta.type(alpha) * w_refs[i][...] + dta.type(dt_) * rates[q]
-            out_w[i][...] = wq
-            out_f[i][...] = data[q].value + dta.type(beta) * wq
+            wq = (dta.type(alpha) * w_refs[i][...].astype(comp)
+                  + dta.type(dt_) * rates[q])
+            out_w[i][...] = wq.astype(dtype)
+            out_f[i][...] = (data[q].value
+                             + dta.type(beta) * wq).astype(dtype)
 
     in_specs = []
     inputs = []
@@ -903,9 +907,9 @@ def mhd_substep01_halo_pallas(fields: Dict[str, jnp.ndarray],
     choreography.
 
     ``slabs[q]`` must come from ``exchange_interior_slabs(fields[q],
-    counts, rz=bz, ry=ESUB, radius_rows=2*R, y_z_extended=True)`` —
+    counts, rz=bz, ry=esub, radius_rows=2*R, y_z_extended=True)`` —
     2R valid rows, not R (the window reaches 2R across shard edges).
-    Needs 2R <= min(bz, ESUB) (6 <= 8). Returns (new_fields, new_w).
+    Needs 2R <= min(bz, esub) (6 <= 8). Returns (new_fields, new_w).
     """
     from ..models.astaroth import FIELDS
 
@@ -913,17 +917,19 @@ def mhd_substep01_halo_pallas(fields: Dict[str, jnp.ndarray],
         interpret = default_interpret()
     R2 = 2 * R
     Z, Y, X = fields[FIELDS[0]].shape
-    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
-    assert R2 <= ESUB and R2 <= bz, (R2, ESUB, bz)
+    dtype = fields[FIELDS[0]].dtype
+    from .pallas_mhd import mhd_tile
+    esub = mhd_tile(dtype)
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub)
+    assert R2 <= esub and R2 <= bz, (R2, esub, bz)
     for q in FIELDS:
         assert slabs[q]["zlo"].shape == (bz, Y, X), slabs[q]["zlo"].shape
-        assert slabs[q]["ylo"].shape == (Z + 2 * bz, ESUB, X), \
+        assert slabs[q]["ylo"].shape == (Z + 2 * bz, esub, X), \
             slabs[q]["ylo"].shape
-    dtype = fields[FIELDS[0]].dtype
     nzg = Z // bz
     nyg = Y // by
     field_specs, inputs_for_field, select_window = _mhd_window_plan(
-        Z, Y, X, bz, by, rr=R2)
+        Z, Y, X, bz, by, rr=R2, esub=esub)
     nseg = len(field_specs)
     nf = len(FIELDS)
 

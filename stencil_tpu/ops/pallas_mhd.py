@@ -29,10 +29,30 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..geometry import Dim3
-from .pallas_stencil import default_interpret
+from .pallas_stencil import default_interpret, sublane_tile
 
 R = 3          # stencil radius (6th order)
-ESUB = 8       # edge-slab sublane tile (f32)
+ESUB = 8       # edge-slab sublane tile (f32; bf16 paths use 16)
+
+
+def compute_dtype(store_dtype):
+    """In-kernel compute dtype for a storage dtype: bfloat16 fields
+    are STORED half-width (halving HBM traffic — the whole point) but
+    the 6th-order RHS is evaluated in float32 (bf16's ~8 mantissa bits
+    are not enough for the derivative coefficient sums; the MXU/VPU
+    idiom is bf16 in memory, f32 accumulate). Everything else computes
+    in its own dtype."""
+    dt = jnp.dtype(store_dtype)
+    return jnp.float32 if dt == jnp.dtype(jnp.bfloat16) else dt
+
+
+def mhd_tile(dtype) -> int:
+    """Edge-slab sublane granularity for the MHD kernels — the dtype's
+    minimum sublane tile (sublane_tile_bytes already floors at the f32
+    tile: 8 for f32/f64, 16 for bf16), named here because every MHD
+    window plan, block fitter, and slab exchange must agree on it and
+    the rr <= tile window contract (2R = 6 <= 8) relies on the floor."""
+    return sublane_tile(dtype)
 
 
 def _thin_z() -> bool:
@@ -49,36 +69,37 @@ _YSEGS = (-1, 0, 1)
 
 
 def _window_plan(Z: int, Y: int, X: int, bz: int, by: int,
-                 rr: int = R):
+                 rr: int = R, esub: int = ESUB):
     """(specs, assemble) for one field's (bz+2rr, by+2rr, X)
     neighborhood (rr defaults to the stencil radius R; the fused
     substep-pair kernel passes 2R), periodic via wrapped index maps;
     x is NOT extended (buffers stay lane-aligned at X; periodic x
     shifts happen per-derivative via ``pltpu.roll`` — the FieldData
-    ``x_wrap`` mode).
+    ``x_wrap`` mode). ``esub`` is the dtype's sublane tile (8 f32 /
+    16 bf16): the y edge-slab granularity.
 
     Default (thin-z) plan: 2rr+1 z segments (rr wrapped single rows
     below, the main bz-row block, rr above — exact-radius fetches,
     since the majormost dim has no tile granularity) x 3 y segments
-    (preceding ESUB-slab, main, following ESUB-slab); per-block read
-    amplification (1 + 2rr/bz) * (1 + 2*ESUB/by).
+    (preceding esub-slab, main, following esub-slab); per-block read
+    amplification (1 + 2rr/bz) * (1 + 2*esub/by).
 
-    STENCIL_MHD_THINZ=0 plan: 3 z segments (ESUB-row tile below, main,
-    ESUB-row tile above) x 3 y segments = 9 specs; amplification
-    (1 + 2*ESUB/bz) * (1 + 2*ESUB/by) — more traffic, but fewer/fatter
+    STENCIL_MHD_THINZ=0 plan: 3 z segments (esub-row tile below, main,
+    esub-row tile above) x 3 y segments = 9 specs; amplification
+    (1 + 2*esub/bz) * (1 + 2*esub/by) — more traffic, but fewer/fatter
     DMAs (the round-3 layout, kept for hardware A/B).
     """
-    assert rr <= ESUB, (rr, ESUB)   # y slabs are one ESUB tile wide
-    nyb = Y // ESUB
-    byb = by // ESUB
+    assert rr <= esub, (rr, esub)   # y slabs are one esub tile wide
+    nyb = Y // esub
+    byb = by // esub
     thin = _thin_z()
     if thin:
         zsegs = tuple(range(-rr, 0)) + (0,) + tuple(range(1, rr + 1))
     else:
-        assert bz % ESUB == 0 and Z % ESUB == 0, (Z, bz)
+        assert bz % esub == 0 and Z % esub == 0, (Z, bz)
         zsegs = (-1, 0, 1)
-        bzb = bz // ESUB
-        nzb = Z // ESUB
+        bzb = bz // esub
+        nzb = Z // esub
 
     def zy(zseg: int, yseg: int):
         if zseg == 0:
@@ -89,15 +110,15 @@ def _window_plan(Z: int, Y: int, X: int, bz: int, by: int,
             off = zseg if zseg < 0 else bz + zseg - 1
             zshape, zidx = 1, (lambda kz, o=off: (kz * bz + o) % Z)
         elif zseg < 0:
-            zshape, zidx = ESUB, (lambda kz: (kz * bzb - 1) % nzb)
+            zshape, zidx = esub, (lambda kz: (kz * bzb - 1) % nzb)
         else:
-            zshape, zidx = ESUB, (lambda kz: (kz * bzb + bzb) % nzb)
+            zshape, zidx = esub, (lambda kz: (kz * bzb + bzb) % nzb)
         if yseg == 0:
             yshape, yidx = by, (lambda ky: ky)
         elif yseg < 0:
-            yshape, yidx = ESUB, (lambda ky: (ky * byb - 1) % nyb)
+            yshape, yidx = esub, (lambda ky: (ky * byb - 1) % nyb)
         else:
-            yshape, yidx = ESUB, (lambda ky: (ky * byb + byb) % nyb)
+            yshape, yidx = esub, (lambda ky: (ky * byb + byb) % nyb)
         return pl.BlockSpec(
             (zshape, yshape, X),
             functools.partial(lambda kz, ky, zf, yf: (zf(kz), yf(ky), 0),
@@ -113,30 +134,34 @@ def _window_plan(Z: int, Y: int, X: int, bz: int, by: int,
             ym, y0, yp = refs[3 * zi:3 * zi + 3]
             if thin or zs == 0:
                 zslice = slice(None)
-            elif zs < 0:          # tiled: last rr rows of the ESUB tile
-                zslice = slice(ESUB - rr, None)
+            elif zs < 0:          # tiled: last rr rows of the esub tile
+                zslice = slice(esub - rr, None)
             else:                 # tiled: first rr rows
                 zslice = slice(None, rr)
             rows.append(jnp.concatenate(
-                [ym[zslice, ESUB - rr:], y0[zslice], yp[zslice, :rr]],
+                [ym[zslice, esub - rr:], y0[zslice], yp[zslice, :rr]],
                 axis=1))
         return jnp.concatenate(rows, axis=0)
 
     return specs, assemble
 
 
-def _fit_blocks(Z: int, Y: int, block_z: int,
-                block_y: int) -> Tuple[int, int]:
+def _fit_blocks(Z: int, Y: int, block_z: int, block_y: int,
+                esub: int = ESUB) -> Tuple[int, int]:
     """Shrink (block_z, block_y) to divide (Z, Y) while staying
-    multiples of the ESUB tile — the one block-shrink rule both wrap
-    substep kernels share."""
-    assert Z % ESUB == 0 and Y % ESUB == 0, (Z, Y)
+    multiples of the dtype's ``esub`` tile — the one block-shrink rule
+    both wrap substep kernels share."""
+    assert Z % esub == 0 and Y % esub == 0, (Z, Y, esub)
     bz, by = block_z, block_y
-    while bz > ESUB and Z % bz:
-        bz -= ESUB
-    while by > ESUB and Y % by:
-        by -= ESUB
-    assert bz % ESUB == 0 and by % ESUB == 0 and Z % bz == 0 and Y % by == 0
+    if bz % esub or bz < esub:
+        bz = max((bz // esub) * esub, esub)
+    if by % esub or by < esub:
+        by = max((by // esub) * esub, esub)
+    while bz > esub and Z % bz:
+        bz -= esub
+    while by > esub and Y % by:
+        by -= esub
+    assert bz % esub == 0 and by % esub == 0 and Z % bz == 0 and Y % by == 0
     return bz, by
 
 
@@ -150,8 +175,9 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     """One fused RK3 substep ``s`` on unpadded (Z, Y, X) fields with
     periodic wrap in-kernel. Returns (new_fields, new_w).
 
-    Requires Z, Y, block_z, block_y to be multiples of 8 and
-    block_z | Z, block_y | Y.
+    Requires Z, Y, block_z, block_y to be multiples of the dtype's
+    sublane tile (8 f32 / 16 bf16) and block_z | Z, block_y | Y.
+    bfloat16 fields compute in float32 (see ``compute_dtype``).
     """
     from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
     from .fd6 import FieldData
@@ -159,8 +185,10 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     if interpret is None:
         interpret = default_interpret()
     Z, Y, X = fields[FIELDS[0]].shape
-    bz, by = _fit_blocks(Z, Y, block_z, block_y)
     dtype = fields[FIELDS[0]].dtype
+    esub = mhd_tile(dtype)
+    comp = compute_dtype(dtype)
+    bz, by = _fit_blocks(Z, Y, block_z, block_y, esub)
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
     alpha = float(RK3_ALPHA[s])
     beta = float(RK3_BETA[s])
@@ -170,7 +198,7 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
 
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
     nf = len(FIELDS)
-    field_specs, assemble = _window_plan(Z, Y, X, bz, by)
+    field_specs, assemble = _window_plan(Z, Y, X, bz, by, esub=esub)
     nseg = len(field_specs)
 
     def kern(*refs):
@@ -181,14 +209,16 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
         data = {}
         for i, q in enumerate(FIELDS):
             win = assemble(field_refs[nseg * i:nseg * (i + 1)])
-            data[q] = FieldData(win, inv_ds, pad_lo, interior,
-                                x_wrap=True)
-        rates = mhd_rates(data, prm, dtype)
-        dta = jnp.dtype(dtype)
+            data[q] = FieldData(win.astype(comp), inv_ds, pad_lo,
+                                interior, x_wrap=True)
+        rates = mhd_rates(data, prm, comp)
+        dta = jnp.dtype(comp)
         for i, q in enumerate(FIELDS):
-            wq = dta.type(alpha) * w_refs[i][...] + dta.type(dt_) * rates[q]
-            out_w[i][...] = wq
-            out_f[i][...] = data[q].value + dta.type(beta) * wq
+            wq = (dta.type(alpha) * w_refs[i][...].astype(comp)
+                  + dta.type(dt_) * rates[q])
+            out_w[i][...] = wq.astype(dtype)
+            out_f[i][...] = (data[q].value
+                             + dta.type(beta) * wq).astype(dtype)
 
     in_specs = []
     inputs = []
@@ -231,13 +261,18 @@ def mhd_pair_update(wins: Dict[str, jnp.ndarray], prm, dtype,
     on the ring-extended region, (f_1, w_1) formed in VMEM, rates_1 on
     the block — per-point op order matches two sequential substeps
     exactly. Reference semantics: astaroth/kernels.cu:63-90 applied
-    for substeps 0 and 1."""
+    for substeps 0 and 1.
+
+    ``dtype`` is the STORAGE dtype: bfloat16 windows are cast to
+    float32 for the whole pair evaluation and the outputs cast back
+    (see ``compute_dtype``)."""
     from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
     from .fd6 import FieldData
 
     assert float(RK3_ALPHA[0]) == 0.0, "pair fusion needs alpha_0 == 0"
     R2 = 2 * R
-    dta = jnp.dtype(dtype)
+    comp = compute_dtype(dtype)
+    dta = jnp.dtype(comp)
     dt_ = dta.type(float(dt_phys))
     beta0 = dta.type(float(RK3_BETA[0]))
     alpha1 = dta.type(float(RK3_ALPHA[1]))
@@ -246,23 +281,24 @@ def mhd_pair_update(wins: Dict[str, jnp.ndarray], prm, dtype,
     pad = Dim3(0, R, R)
     int0 = Dim3(wins[FIELDS[0]].shape[2], by + R2, bz + R2)
     int1 = Dim3(wins[FIELDS[0]].shape[2], by, bz)
-    data0 = {q: FieldData(wins[q], inv_ds, pad, int0, x_wrap=True)
+    data0 = {q: FieldData(wins[q].astype(comp), inv_ds, pad, int0,
+                          x_wrap=True)
              for q in FIELDS}
-    rates0 = mhd_rates(data0, prm, dtype)
+    rates0 = mhd_rates(data0, prm, comp)
     data1 = {}
     w1 = {}
     for q in FIELDS:
         w1[q] = dt_ * rates0[q]                    # alpha_0 == 0
         f1 = data0[q].value + beta0 * w1[q]
         data1[q] = FieldData(f1, inv_ds, pad, int1, x_wrap=True)
-    rates1 = mhd_rates(data1, prm, dtype)
+    rates1 = mhd_rates(data1, prm, comp)
     out_f = {}
     out_w = {}
     for q in FIELDS:
         w1c = w1[q][R:R + bz, R:R + by]
         wq = alpha1 * w1c + dt_ * rates1[q]
-        out_w[q] = wq
-        out_f[q] = data1[q].value + beta1 * wq
+        out_w[q] = wq.astype(dtype)
+        out_f[q] = (data1[q].value + beta1 * wq).astype(dtype)
     return out_f, out_w
 
 
@@ -297,8 +333,10 @@ def mhd_substep01_wrap_pallas(fields: Dict[str, jnp.ndarray],
         interpret = default_interpret()
     assert float(RK3_ALPHA[0]) == 0.0, "pair fusion needs alpha_0 == 0"
     Z, Y, X = fields[FIELDS[0]].shape
-    bz, by = _fit_blocks(Z, Y, block_z, block_y)
     dtype = fields[FIELDS[0]].dtype
+    esub = mhd_tile(dtype)
+    comp = compute_dtype(dtype)
+    bz, by = _fit_blocks(Z, Y, block_z, block_y, esub)
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
     beta0 = float(RK3_BETA[0])
     alpha1 = float(RK3_ALPHA[1])
@@ -314,32 +352,35 @@ def mhd_substep01_wrap_pallas(fields: Dict[str, jnp.ndarray],
 
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
     nf = len(FIELDS)
-    field_specs, assemble = _window_plan(Z, Y, X, bz, by, rr=R2)
+    field_specs, assemble = _window_plan(Z, Y, X, bz, by, rr=R2,
+                                         esub=esub)
     nseg = len(field_specs)
 
     def kern(*refs):
         field_refs = refs[:nseg * nf]
         out_f = refs[nseg * nf:nseg * nf + nf]
         out_w = refs[nseg * nf + nf:]
-        dta = jnp.dtype(dtype)
+        dta = jnp.dtype(comp)
         data0 = {}
         for i, q in enumerate(FIELDS):
             win = assemble(field_refs[nseg * i:nseg * (i + 1)])
-            data0[q] = FieldData(win, inv_ds, pad0, int0, x_wrap=True)
-        rates0 = mhd_rates(data0, prm, dtype)
+            data0[q] = FieldData(win.astype(comp), inv_ds, pad0, int0,
+                                 x_wrap=True)
+        rates0 = mhd_rates(data0, prm, comp)
         data1 = {}
         w1 = {}
         for q in FIELDS:
             w1[q] = dta.type(dt_) * rates0[q]          # alpha_0 == 0
             f1 = data0[q].value + dta.type(beta0) * w1[q]
             data1[q] = FieldData(f1, inv_ds, pad1, int1, x_wrap=True)
-        rates1 = mhd_rates(data1, prm, dtype)
+        rates1 = mhd_rates(data1, prm, comp)
         for i, q in enumerate(FIELDS):
             # w_1 sliced to the block for the substep-1 update
             w1c = w1[q][R:R + bz, R:R + by]
             wq = dta.type(alpha1) * w1c + dta.type(dt_) * rates1[q]
-            out_w[i][...] = wq
-            out_f[i][...] = data1[q].value + dta.type(beta1) * wq
+            out_w[i][...] = wq.astype(dtype)
+            out_f[i][...] = (data1[q].value
+                             + dta.type(beta1) * wq).astype(dtype)
 
     in_specs = []
     inputs = []
