@@ -47,10 +47,13 @@ run() {
     marker=$(wc -l <"$OUT")
     emarker=$({ wc -l <"$OUT.err"; } 2>/dev/null || echo 0)
     "$@" 2>>"$OUT.err" | tee -a "$OUT"
-    # tail -n +N starts AT line N, so +1 to read only this attempt's lines
+    # tail -n +N starts AT line N, so +1 to read only this attempt's lines.
+    # Match init-time deaths AND mid-run tunnel losses (XlaRuntimeError
+    # UNAVAILABLE after a successful init) — both mean "the chip went
+    # away", not "the kernel is broken", so both earn the one retry.
     if { tail -n +"$((marker + 1))" "$OUT";
          tail -n +"$((emarker + 1))" "$OUT.err" 2>/dev/null; } \
-        | grep -q "Unable to initialize backend"; then
+        | grep -qE "Unable to initialize backend|UNAVAILABLE"; then
       if [ "$attempt" -eq 2 ]; then
         echo "-- backend died on both attempts; giving up on this item" \
           | tee -a "$OUT"

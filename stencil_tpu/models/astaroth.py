@@ -323,24 +323,31 @@ class Astaroth:
         dt = prm.dt
 
         rem = dd.rem
+        # bf16 stores half-width but must not EVALUATE the 6th-order
+        # RHS in bf16 — same storage/compute split as the Pallas paths
+        from ..ops.pallas_mhd import compute_dtype
+        comp = compute_dtype(self._dtype)
+        store = jnp.dtype(self._dtype)
 
         def substep_fused(fields, w, s):
             fields = dispatch_exchange(fields, radius, counts, method,
                                        rem=rem)
-            data = {q: FieldData(fields[q], inv_ds, pad_lo, local)
+            data = {q: FieldData(fields[q].astype(comp), inv_ds,
+                                 pad_lo, local)
                     for q in FIELDS}
-            rates = mhd_rates(data, prm, self._dtype)
-            alpha = jnp.asarray(RK3_ALPHA[s], self._dtype)
-            beta = jnp.asarray(RK3_BETA[s], self._dtype)
-            dt_ = jnp.asarray(dt, self._dtype)
+            rates = mhd_rates(data, prm, comp)
+            alpha = jnp.asarray(RK3_ALPHA[s], comp)
+            beta = jnp.asarray(RK3_BETA[s], comp)
+            dt_ = jnp.asarray(dt, comp)
             new_f = {}
             new_w = {}
             for q in FIELDS:
-                wq = alpha * w[q] + dt_ * rates[q]
+                wq = alpha * w[q].astype(comp) + dt_ * rates[q]
                 uq = data[q].value + beta * wq
-                new_w[q] = wq
+                new_w[q] = wq.astype(store)
                 new_f[q] = lax.dynamic_update_slice(
-                    fields[q], uq, (pad_lo.z, pad_lo.y, pad_lo.x))
+                    fields[q], uq.astype(store),
+                    (pad_lo.z, pad_lo.y, pad_lo.x))
             return new_f, new_w
 
         def substep_overlap(fields, w, s):
@@ -349,22 +356,24 @@ class Astaroth:
             astaroth/astaroth.cu:552-646, as one program)."""
             from ..parallel.overlap import overlapped_update
 
-            alpha = jnp.asarray(RK3_ALPHA[s], self._dtype)
-            beta = jnp.asarray(RK3_BETA[s], self._dtype)
-            dt_ = jnp.asarray(dt, self._dtype)
+            alpha = jnp.asarray(RK3_ALPHA[s], comp)
+            beta = jnp.asarray(RK3_BETA[s], comp)
+            dt_ = jnp.asarray(dt, comp)
 
             def upd(blocks, dims, off):
-                data = {q: FieldData(blocks[q], inv_ds, pad_lo, dims)
+                data = {q: FieldData(blocks[q].astype(comp), inv_ds,
+                                     pad_lo, dims)
                         for q in FIELDS}
-                rates = mhd_rates(data, prm, self._dtype)
+                rates = mhd_rates(data, prm, comp)
                 out = {}
                 for q in FIELDS:
                     w_blk = lax.slice(
                         w[q], (off[2], off[1], off[0]),
                         (off[2] + dims.z, off[1] + dims.y, off[0] + dims.x))
-                    wq = alpha * w_blk + dt_ * rates[q]
-                    out[f"w:{q}"] = wq
-                    out[f"f:{q}"] = data[q].value + beta * wq
+                    wq = alpha * w_blk.astype(comp) + dt_ * rates[q]
+                    out[f"w:{q}"] = wq.astype(store)
+                    out[f"f:{q}"] = (data[q].value
+                                     + beta * wq).astype(store)
                 return out
 
             fields_ex, parts = overlapped_update(fields, radius, counts,
@@ -396,18 +405,13 @@ class Astaroth:
         # (ops/pallas_mhd_overlap.py) — explicit kernel='halo' +
         # overlap opts in anywhere (tests run it interpreted); 'auto'
         # takes it on real TPU hardware with f32 fields
-        # bf16 is excluded: ops/pallas_mhd_overlap has no 16-row slab
-        # tiling (f32/f64 keep the pre-bf16 behavior)
-        import jax.numpy as _jnp
         rdma_overlap_ok = (self._overlap and counts.x == 1
-                           and aligned_t
-                           and np.dtype(self._dtype)
-                           != np.dtype(_jnp.bfloat16))
+                           and aligned_t)
         if rdma_overlap_ok:
             from ..ops.pallas_stencil import on_tpu
             if (kernel == "halo"
                     or (kernel == "auto" and on_tpu()
-                        and np.dtype(self._dtype) == np.float32)):
+                        and _fast_dtype_ok(self._dtype))):
                 from ..utils.logging import LOG_INFO
                 self.kernel_path = "halo-overlap"
                 self._build_halo_overlap_step()
@@ -641,7 +645,8 @@ class Astaroth:
         astaroth/astaroth.cu:552-646; see ops/pallas_mhd_overlap.py).
         Same extract/loop/insert program split and interior-resident
         caching as the halo path."""
-        from ..ops.pallas_halo import ESUB, R as HALO_R, mhd_halo_blocks
+        from ..ops.pallas_halo import R as HALO_R, mhd_halo_blocks
+        from ..ops.pallas_mhd import mhd_tile
         from ..ops.pallas_mhd_overlap import mhd_substep_overlap
 
         dd = self.dd
@@ -650,8 +655,9 @@ class Astaroth:
         counts = mesh_dim(dd.mesh)
         prm = self.prm
         dt = prm.dt
+        tile = mhd_tile(self._dtype)   # 8 f32/f64, 16 bf16 slabs
         blk_z, blk_y = getattr(self, "_halo_blocks", None) or (8, 32)
-        bz, by = mhd_halo_blocks(local.z, local.y, blk_z, blk_y)
+        bz, by = mhd_halo_blocks(local.z, local.y, blk_z, blk_y, tile)
         spec = P("z", "y", "x")
         fields_spec = {q: spec for q in FIELDS}
 
@@ -670,7 +676,7 @@ class Astaroth:
         # substeps 0+1, then substep 2 runs overlapped as usual
         from ..utils.config import mhd_pair_requested
         pair_on = (mhd_pair_requested()
-                   and 2 * HALO_R <= min(bz, ESUB))
+                   and 2 * HALO_R <= min(bz, tile))
         if pair_on:
             from ..utils.logging import LOG_INFO
             LOG_INFO("astaroth halo-overlap path: fused substep-0+1")
@@ -710,7 +716,7 @@ class Astaroth:
         # same wire traffic as the sequential halo path (pair: one
         # radius-2R + one radius-R round; else 3 radius-R rounds per
         # iteration), issued in-kernel
-        self._slab_exchange_cfg = dict(rz=bz, ry=ESUB, pair=pair_on)
+        self._slab_exchange_cfg = dict(rz=bz, ry=tile, pair=pair_on)
         self._install_inner_iter(extract, loop)
 
     def _install_inner_iter(self, extract, loop) -> None:

@@ -42,7 +42,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..geometry import Dim3
-from .pallas_halo import ESUB, R, _mhd_window_plan, mhd_halo_blocks
+from .pallas_halo import R, _mhd_window_plan, mhd_halo_blocks
+from .pallas_mhd import compute_dtype, mhd_tile
 from .pallas_stencil import default_interpret, on_tpu
 
 # collective_id namespace distinct from pallas_overlap (21) and
@@ -68,7 +69,7 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     unsharded. Returns ``(new_fields, new_w, slabs)`` where edge
     blocks of the f/w outputs are PLACEHOLDERS (clamped windows) and
     ``slabs[q]`` holds the landed halo data in the
-    ``exchange_interior_slabs(rz=bz, ry=ESUB, radius_rows=R,
+    ``exchange_interior_slabs(rz=bz, ry=mhd_tile(dtype), radius_rows=R,
     y_z_extended=True)`` layout — feed both to
     ``mhd_substep_fixup_pallas``. Reference choreography:
     astaroth/astaroth.cu:552-646 (interior launch + transports in
@@ -89,10 +90,12 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     assert counts.x == 1, "x (lane) axis must not be mesh-sharded"
     hr = 2 * R if pair else R      # halo rows windows and DMAs carry
     Z, Y, X = fields[FIELDS[0]].shape
-    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
-    assert hr <= min(bz, ESUB), (hr, bz)
     dtype = fields[FIELDS[0]].dtype
-    dta = jnp.dtype(dtype)
+    esub = mhd_tile(dtype)         # slab row tile: 8 f32/f64, 16 bf16
+    comp = compute_dtype(dtype)    # bf16 stores, f32 computes
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub)
+    assert hr <= min(bz, esub), (hr, bz, esub)
+    dta = jnp.dtype(comp)
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
     alpha = float(RK3_ALPHA[s])
     beta = float(RK3_BETA[s])
@@ -109,7 +112,7 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     # the halo kernel's own window plan in slabless mode: clamped
     # in-shard segments only, one source of truth for the geometry
     field_specs, inputs_for_field, select_window = _mhd_window_plan(
-        Z, Y, X, bz, by, rr=hr, slabless=True)
+        Z, Y, X, bz, by, rr=hr, slabless=True, esub=esub)
     nseg = len(field_specs)
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
 
@@ -176,7 +179,7 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
                 return [
                     pltpu.make_async_remote_copy(
                         src_ref=f_any.at[:, Y - hr:Y],
-                        dst_ref=ylo_o[i].at[bz:bz + Z, ESUB - hr:ESUB],
+                        dst_ref=ylo_o[i].at[bz:bz + Z, esub - hr:esub],
                         send_sem=send.at[i, 2], recv_sem=recv.at[i, 2],
                         device_id=nbr("y", my, True)),
                     pltpu.make_async_remote_copy(
@@ -188,7 +191,7 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
             return [
                 pltpu.make_async_copy(f_any.at[:, Y - hr:Y],
                                       ylo_o[i].at[bz:bz + Z,
-                                                  ESUB - hr:ESUB],
+                                                  esub - hr:esub],
                                       recv.at[i, 2]),
                 pltpu.make_async_copy(f_any.at[:, 0:hr],
                                       yhi_o[i].at[bz:bz + Z, 0:hr],
@@ -202,9 +205,9 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
             sequential-sweep rule, as explicit messages."""
             pieces = [
                 (zlo_o[i].at[bz - hr:bz, Y - hr:Y],
-                 ylo_o[i].at[bz - hr:bz, ESUB - hr:ESUB], True, 4),
+                 ylo_o[i].at[bz - hr:bz, esub - hr:esub], True, 4),
                 (zhi_o[i].at[0:hr, Y - hr:Y],
-                 ylo_o[i].at[bz + Z:bz + Z + hr, ESUB - hr:ESUB],
+                 ylo_o[i].at[bz + Z:bz + Z + hr, esub - hr:esub],
                  True, 5),
                 (zlo_o[i].at[bz - hr:bz, 0:hr],
                  yhi_o[i].at[bz - hr:bz, 0:hr], False, 6),
@@ -255,14 +258,16 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
                 out_w[i][...] = w2[q]
                 out_f[i][...] = f2[q]
         else:
-            data = {q: FieldData(wins[q], inv_ds, pad_lo, interior,
-                                 x_wrap=True) for q in FIELDS}
-            rates = mhd_rates(data, prm, dtype)
+            data = {q: FieldData(wins[q].astype(comp), inv_ds,
+                                 pad_lo, interior, x_wrap=True)
+                    for q in FIELDS}
+            rates = mhd_rates(data, prm, comp)
             for i, q in enumerate(FIELDS):
-                wq = (dta.type(alpha) * w_refs[i][...]
+                wq = (dta.type(alpha) * w_refs[i][...].astype(comp)
                       + dta.type(dt_) * rates[q])
-                out_w[i][...] = wq
-                out_f[i][...] = data[q].value + dta.type(beta) * wq
+                out_w[i][...] = wq.astype(dtype)
+                out_f[i][...] = (data[q].value
+                                 + dta.type(beta) * wq).astype(dtype)
 
         # ---- phase B (still the first grid step, after one block of
         # compute): z slabs have landed — fire the corner pieces
@@ -296,7 +301,7 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
 
     out_shape = ([jax.ShapeDtypeStruct((Z, Y, X), dtype)] * (2 * nf)
                  + [jax.ShapeDtypeStruct((bz, Y, X), dtype)] * (2 * nf)
-                 + [jax.ShapeDtypeStruct((zext, ESUB, X), dtype)]
+                 + [jax.ShapeDtypeStruct((zext, esub, X), dtype)]
                  * (2 * nf))
     out_specs = ([main_spec] * (2 * nf)
                  + [pl.BlockSpec(memory_space=pl.ANY)] * (4 * nf))
@@ -356,7 +361,9 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
         interpret = default_interpret()
     hr = 2 * R if pair else R
     Z, Y, X = fields[FIELDS[0]].shape
-    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y)
+    esub = mhd_tile(fields[FIELDS[0]].dtype)
+    comp = compute_dtype(fields[FIELDS[0]].dtype)
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub)
     nzg = Z // bz
     nyg = Y // by
     if strip == "z":
@@ -372,7 +379,7 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
             return i + 1, jnp.where(j == 0, 0, nyg - 1)
 
     dtype = fields[FIELDS[0]].dtype
-    dta = jnp.dtype(dtype)
+    dta = jnp.dtype(comp)
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
     alpha = float(RK3_ALPHA[s])
     beta = float(RK3_BETA[s])
@@ -382,7 +389,7 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
     nf = len(FIELDS)
 
     plan_specs, inputs_for_field, select_window = _mhd_window_plan(
-        Z, Y, X, bz, by, rr=hr)
+        Z, Y, X, bz, by, rr=hr, esub=esub)
     nseg = len(plan_specs)
 
     def rm(spec):
@@ -412,13 +419,15 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
                 out_w[i][...] = w2[q]
                 out_f[i][...] = f2[q]
             return
-        data = {q: FieldData(wins[q], inv_ds, pad_lo, interior,
-                             x_wrap=True) for q in FIELDS}
-        rates = mhd_rates(data, prm, dtype)
+        data = {q: FieldData(wins[q].astype(comp), inv_ds, pad_lo,
+                             interior, x_wrap=True) for q in FIELDS}
+        rates = mhd_rates(data, prm, comp)
         for i, q in enumerate(FIELDS):
-            wq = dta.type(alpha) * w_refs[i][...] + dta.type(dt_) * rates[q]
-            out_w[i][...] = wq
-            out_f[i][...] = data[q].value + dta.type(beta) * wq
+            wq = (dta.type(alpha) * w_refs[i][...].astype(comp)
+                  + dta.type(dt_) * rates[q])
+            out_w[i][...] = wq.astype(dtype)
+            out_f[i][...] = (data[q].value
+                             + dta.type(beta) * wq).astype(dtype)
 
     in_specs = []
     inputs = []
@@ -475,7 +484,8 @@ def mhd_substep_overlap(fields: Dict[str, jnp.ndarray],
     from ..models.astaroth import FIELDS
 
     Z, Y, _ = fields[FIELDS[0]].shape
-    bz, _by = mhd_halo_blocks(Z, Y, block_z, block_y)
+    bz, _by = mhd_halo_blocks(Z, Y, block_z, block_y,
+                              mhd_tile(fields[FIELDS[0]].dtype))
     nzg = Z // bz
     # the caller's interpret mode passes through VERBATIM: an
     # InterpretParams (e.g. detect_races=True from the sanitizer tests)

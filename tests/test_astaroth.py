@@ -216,16 +216,44 @@ class TestBfloat16:
         c.step()
         self._assert_close(c, ref, f"halo pair={pair}")
 
-    def test_bf16_overlap_falls_back(self):
-        """bf16 + overlap has no fused path (ops/pallas_mhd_overlap is
-        f32/f64-only): explicit halo must refuse, auto must fall back
-        to the XLA overlap formulation rather than crash."""
+    def test_xla_bf16_matches_f32_oracle(self):
+        """The XLA fallback path must apply the same storage/compute
+        split (bf16 in HBM, f32 RHS evaluation) as the Pallas paths —
+        a bf16-evaluated 6th-order RHS would drift far beyond storage
+        tolerance."""
         import jax.numpy as jnp
-        with pytest.raises(ValueError, match="overlap off"):
-            Astaroth(32, 32, 32, mesh_shape=(1, 2, 2),
-                     dtype=jnp.bfloat16, devices=jax.devices()[:4],
-                     kernel="halo", overlap=True)
+        size = (32, 32, 32)
+        ref = self._f32_oracle(size)
+        b = Astaroth(*size, mesh_shape=(2, 2, 2), dtype=jnp.bfloat16,
+                     kernel="xla")
+        b.init()
+        b.step()
+        b.step()
+        self._assert_close(b, ref, "xla bf16")
+
+    def test_bf16_overlap_selects_rdma_path(self):
+        """bf16 + overlap takes the in-kernel RDMA path like f32 (the
+        16-row slab tiling now runs through ops/pallas_mhd_overlap)."""
+        import jax.numpy as jnp
         m = Astaroth(32, 32, 32, mesh_shape=(1, 2, 2),
                      dtype=jnp.bfloat16, devices=jax.devices()[:4],
-                     kernel="auto", overlap=True)
-        assert m.kernel_path == "xla-overlap"
+                     kernel="halo", overlap=True)
+        assert m.kernel_path == "halo-overlap"
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("pair", ["0", "1"])
+    def test_overlap_bf16_matches_f32_oracle(self, pair, monkeypatch):
+        """The overlapped (in-kernel RDMA) path in bf16, alone and
+        composed with the substep-0+1 pair."""
+        import jax.numpy as jnp
+        monkeypatch.setenv("STENCIL_MHD_PAIR", pair)
+        size = (32, 32, 32)
+        ref = self._f32_oracle(size)
+        c = Astaroth(*size, mesh_shape=(1, 2, 2), dtype=jnp.bfloat16,
+                     devices=jax.devices()[:4], kernel="halo",
+                     overlap=True)
+        assert c.kernel_path == "halo-overlap"
+        c.init()
+        c.step()
+        c.step()
+        self._assert_close(c, ref, f"halo-overlap pair={pair}")

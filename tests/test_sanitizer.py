@@ -13,6 +13,7 @@ import contextlib
 import io
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -112,10 +113,12 @@ def test_rdma_exchange_race_free():
     np.testing.assert_array_equal(out[1:3, 1:3, 1:3], a[1:3, 1:3, 1:3])
 
 
-def test_mhd_overlap_kernel_race_free():
+@pytest.mark.parametrize("dtype", ["f32", "bf16"])
+def test_mhd_overlap_kernel_race_free(dtype):
     """The MHD in-kernel RDMA overlap substep (barrier + two-phase slab
     DMA concurrent with the fused mhd_rates block pipeline + aliased
-    strip fix-ups) under the race detector on a (1,2,2) mesh."""
+    strip fix-ups) under the race detector on a (1,2,2) mesh — in f32
+    (8-row slab tiles) and bf16 (16-row tiles, different DMA offsets)."""
     from stencil_tpu.models.astaroth import FIELDS, MhdParams
     from stencil_tpu.ops.pallas_mhd_overlap import mhd_substep_overlap
 
@@ -123,7 +126,9 @@ def test_mhd_overlap_kernel_race_free():
     counts = Dim3(1, 2, 2)
     prm = MhdParams()
     params = pltpu.InterpretParams(detect_races=True)
-    gz, gy, gx = 16, 16, 8          # local (8, 8, 8): one block/shard
+    dt = np.float32 if dtype == "f32" else jnp.bfloat16
+    # one block/shard: local (8,8,8) f32, (16,16,8) bf16 (tile-16 z/y)
+    gz, gy, gx = (16, 16, 8) if dtype == "f32" else (32, 32, 8)
 
     def shard(fields, w):
         f, wk = mhd_substep_overlap(fields, w, 0, prm, prm.dt, counts,
@@ -137,9 +142,9 @@ def test_mhd_overlap_kernel_race_free():
     rng = np.random.default_rng(11)
     sh = NamedSharding(mesh, spec)
     fields = {q: jax.device_put(
-        jnp.asarray(rng.random((gz, gy, gx)).astype(np.float32) * 0.1),
-        sh) for q in FIELDS}
-    w = {q: jax.device_put(jnp.zeros((gz, gy, gx), np.float32), sh)
+        jnp.asarray(rng.random((gz, gy, gx)).astype(np.float32) * 0.1,
+                    dtype=dt), sh) for q in FIELDS}
+    w = {q: jax.device_put(jnp.zeros((gz, gy, gx), dt), sh)
          for q in FIELDS}
 
     out, (raced, text) = _capture_races(
@@ -147,7 +152,7 @@ def test_mhd_overlap_kernel_race_free():
     assert not raced, text[:2000]
     f_out, _ = out
     for q in FIELDS:
-        assert np.all(np.isfinite(f_out[q])), q
+        assert np.all(np.isfinite(np.asarray(f_out[q], np.float32))), q
 
 
 def test_overlap_kernel_race_free():
