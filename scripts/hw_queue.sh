@@ -17,6 +17,16 @@
 # ordered first so a short tunnel window still lands them.
 set -uo pipefail
 cd "$(dirname "$0")/.."
+# Single-instance guard: two concurrent queues would contend for the one
+# chip (the loser burns its per-item retries on backend-init failures).
+# Exit 3 (not 0) on contention so hw_watch.sh can tell "skipped" from
+# "completed"; children run with fd 9 closed so an orphaned hung
+# benchmark process can't keep the lock held after this shell dies.
+exec 9>.hw_queue.lock
+if ! flock -n 9; then
+  echo "another hw_queue.sh holds .hw_queue.lock; exiting" >&2
+  exit 3
+fi
 OUT="hw_queue_$(date +%Y%m%d_%H%M%S).log"
 echo "hw queue -> $OUT"
 WD=(--per-kernel-timeout 2400)
@@ -25,14 +35,14 @@ waits=0
 . scripts/probe_tunnel.sh   # cwd is the repo root after the cd above
 
 await_tunnel() {
-  while ! probe; do
+  while ! probe 9>&-; do
     waits=$((waits + 1))
     echo "$(date +%T) tunnel down (wait $waits/$MAX_WAITS)" >>"$OUT"
     if [ "$waits" -ge "$MAX_WAITS" ]; then
       echo "$(date +%T) giving up: tunnel never recovered" | tee -a "$OUT"
       exit 1
     fi
-    sleep "$PROBE_INTERVAL_S"
+    sleep "$PROBE_INTERVAL_S" 9>&-
   done
 }
 
@@ -46,20 +56,25 @@ run() {
     echo "== [$(date +%T) try $attempt] $*" | tee -a "$OUT"
     marker=$(wc -l <"$OUT")
     emarker=$({ wc -l <"$OUT.err"; } 2>/dev/null || echo 0)
-    "$@" 2>>"$OUT.err" | tee -a "$OUT"
+    { "$@" 2>>"$OUT.err" | tee -a "$OUT"; } 9>&-
     # tail -n +N starts AT line N, so +1 to read only this attempt's lines.
-    # Match init-time deaths AND mid-run tunnel losses (XlaRuntimeError
-    # UNAVAILABLE after a successful init) — both mean "the chip went
-    # away", not "the kernel is broken", so both earn the one retry.
+    # Match init-time deaths, mid-run tunnel losses (the XlaRuntimeError
+    # UNAVAILABLE traceback), and bench.py's suspect JSON records — all
+    # mean "the chip went away", not "the kernel is broken", so all earn
+    # the one retry. Bare "UNAVAILABLE" is NOT enough: the TPU runtime
+    # logs benign recovered-gRPC warnings with that word on successful
+    # runs over a flaky tunnel.
     if { tail -n +"$((marker + 1))" "$OUT";
          tail -n +"$((emarker + 1))" "$OUT.err" 2>/dev/null; } \
-        | grep -qE "Unable to initialize backend|UNAVAILABLE"; then
+        | grep -qE 'Unable to initialize backend|XlaRuntimeError.*UNAVAILABLE|"suspect": true'; then
       if [ "$attempt" -eq 2 ]; then
-        echo "-- backend died on both attempts; giving up on this item" \
-          | tee -a "$OUT"
+        echo "-- backend death or suspect record on both attempts;" \
+             "giving up on this item (if the result reproduced, read the" \
+             "suspect_reason: it may be a real perf signal, not a tunnel" \
+             "failure)" | tee -a "$OUT"
       else
-        echo "-- backend died mid-item; retrying after next good probe" \
-          | tee -a "$OUT"
+        echo "-- backend death or suspect record; retrying after next" \
+             "good probe" | tee -a "$OUT"
       fi
       continue
     fi
