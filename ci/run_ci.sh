@@ -21,8 +21,17 @@ echo "== 1/4 native build =="
 bash ci/build.sh
 
 echo "== 2/4 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+# The full tier is dominated by interpret-mode Pallas parity tests
+# (CPU-bound, independent): fan them out with pytest-xdist when the
+# machine has cores to spare. Each worker process builds its own
+# 8-virtual-device CPU mesh (conftest env), so workers don't interact.
+NP=$(nproc 2>/dev/null || echo 1)
+XDIST=()
+if [ "$NP" -ge 4 ] && python -c "import xdist" 2>/dev/null; then
+  XDIST=(-n "$((NP / 2))")
+fi
 if [ "$TIER" = "full" ]; then
-  python -m pytest tests/ -q --maxfail=1
+  python -m pytest tests/ -q --maxfail=1 "${XDIST[@]+"${XDIST[@]}"}"
 else
   python -m pytest tests/ -q --maxfail=1 -m "not slow"
 fi

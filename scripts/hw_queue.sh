@@ -102,9 +102,12 @@ run python scripts/bench_kernels.py --model jacobi --kernels halo \
 run env STENCIL_WRAP_STEPS=3 python scripts/bench_kernels.py \
     --model jacobi --kernels halo "${WD[@]}"
 
-# 4. bf16 wrap + halo (half-traffic ladder)
+# 4. bf16 wrap + halo (half-traffic ladder), then bf16 x depth-3
+#    (the two biggest traffic levers composed)
 run python scripts/bench_kernels.py --model jacobi --kernels wrap,halo \
     --dtype bf16 "${WD[@]}"
+run env STENCIL_WRAP_STEPS=3 python scripts/bench_kernels.py \
+    --model jacobi --kernels wrap --dtype bf16 "${WD[@]}"
 
 # 5. limiter evidence: stream ceiling + depth ladder + verdict line
 #    (what binds at 298 vs the ~500 traffic bound — BASELINE.md)
@@ -144,6 +147,10 @@ run env STENCIL_MHD_PAIR=1 python scripts/bench_kernels.py --model mhd \
     --kernels wrap --dtype bf16 "${WD[@]}"
 run env STENCIL_MHD_PAIR=1 python scripts/bench_kernels.py --model mhd \
     --kernels halo --dtype bf16 "${WD[@]}"
+
+# 7c. MHD limiter evidence: stream ceiling + {seq,pair} x {f32,bf16}
+#     ladder + LIMITER verdict (the MHD analog of item 5)
+run timeout 2400 python scripts/profile_wrap.py --model mhd
 
 # 8. overlap structure, single-chip (serialized vs in-kernel-RDMA
 #    schedule with local wrap copies; real overlap_efficiency needs

@@ -517,14 +517,24 @@ class Astaroth:
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def loop(inner, w, n):
+            # dead-w elision: substep 0 never reads w (alpha_0 == 0,
+            # w=None) and nothing reads substep 2's w (the next
+            # iteration restarts at alpha_0 == 0; write_w=False) — the
+            # carry keeps the last WRITTEN w so the fori_loop structure
+            # is stable. Saves a full 8-field read + write sweep per
+            # iteration vs the reference's unconditional w traffic
+            # (astaroth/kernels.cu:63-90).
             def body(_, fw):
                 f, wk = fw
                 if pair_on:
                     f, wk = mhd_substep01_wrap_pallas(f, prm, dt)
-                    f, wk = mhd_substep_wrap_pallas(f, wk, 2, prm, dt)
+                    f, _ = mhd_substep_wrap_pallas(f, wk, 2, prm, dt,
+                                                   write_w=False)
                 else:
-                    for s in range(3):
-                        f, wk = mhd_substep_wrap_pallas(f, wk, s, prm, dt)
+                    f, wk = mhd_substep_wrap_pallas(f, None, 0, prm, dt)
+                    f, wk = mhd_substep_wrap_pallas(f, wk, 1, prm, dt)
+                    f, _ = mhd_substep_wrap_pallas(f, wk, 2, prm, dt,
+                                                   write_w=False)
                 return f, wk
             return lax.fori_loop(0, n, body, (inner, w))
 
@@ -599,20 +609,28 @@ class Astaroth:
                 for q in FIELDS}
 
         def loop_shard(inner, w, n):
+            # dead-w elision (see _build_wrap_step): substep 0 reads no
+            # w, substep 2 writes none; the carry keeps the last
+            # written w for fori_loop structural stability
             def body(_, fw):
                 f, wk = fw
                 if pair_on:
                     f, wk = mhd_substep01_halo_pallas(
                         f, exchange_all(f, 2 * HALO_R), prm, dt,
                         block_z=bz, block_y=by)
-                    f, wk = mhd_substep_halo_pallas(
+                    f, _ = mhd_substep_halo_pallas(
                         f, wk, exchange_all(f, HALO_R), 2, prm, dt,
-                        block_z=bz, block_y=by)
+                        block_z=bz, block_y=by, write_w=False)
                 else:
-                    for s in range(3):
-                        f, wk = mhd_substep_halo_pallas(
-                            f, wk, exchange_all(f, HALO_R), s, prm, dt,
-                            block_z=bz, block_y=by)
+                    f, wk = mhd_substep_halo_pallas(
+                        f, None, exchange_all(f, HALO_R), 0, prm, dt,
+                        block_z=bz, block_y=by)
+                    f, wk = mhd_substep_halo_pallas(
+                        f, wk, exchange_all(f, HALO_R), 1, prm, dt,
+                        block_z=bz, block_y=by)
+                    f, _ = mhd_substep_halo_pallas(
+                        f, wk, exchange_all(f, HALO_R), 2, prm, dt,
+                        block_z=bz, block_y=by, write_w=False)
                 return f, wk
             return lax.fori_loop(0, n, body, (inner, w))
 
@@ -682,20 +700,30 @@ class Astaroth:
             LOG_INFO("astaroth halo-overlap path: fused substep-0+1")
 
         def loop_shard(inner, w, n):
+            # dead-w elision (see _build_wrap_step): substep 0 reads no
+            # w, substep 2 writes none; the carry keeps the last
+            # written w for fori_loop structural stability
             def body(_, fw):
                 f, wk = fw
                 if pair_on:
-                    f, wk = mhd_substep_overlap(f, wk, 0, prm, dt,
+                    f, wk = mhd_substep_overlap(f, None, 0, prm, dt,
                                                 counts, block_z=bz,
                                                 block_y=by, pair=True)
-                    f, wk = mhd_substep_overlap(f, wk, 2, prm, dt,
+                    f, _ = mhd_substep_overlap(f, wk, 2, prm, dt,
+                                               counts, block_z=bz,
+                                               block_y=by,
+                                               write_w=False)
+                else:
+                    f, wk = mhd_substep_overlap(f, None, 0, prm, dt,
                                                 counts, block_z=bz,
                                                 block_y=by)
-                else:
-                    for s in range(3):
-                        f, wk = mhd_substep_overlap(f, wk, s, prm, dt,
-                                                    counts, block_z=bz,
-                                                    block_y=by)
+                    f, wk = mhd_substep_overlap(f, wk, 1, prm, dt,
+                                                counts, block_z=bz,
+                                                block_y=by)
+                    f, _ = mhd_substep_overlap(f, wk, 2, prm, dt,
+                                               counts, block_z=bz,
+                                               block_y=by,
+                                               write_w=False)
                 return f, wk
             return lax.fori_loop(0, n, body, (inner, w))
 

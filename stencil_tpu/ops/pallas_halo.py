@@ -787,13 +787,14 @@ def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
 
 
 def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
-                            w: Dict[str, jnp.ndarray],
+                            w: Optional[Dict[str, jnp.ndarray]],
                             slabs: Dict[str, Dict[str, jnp.ndarray]],
                             s: int, prm, dt_phys: float,
                             block_z: int = 8, block_y: int = 32,
+                            write_w: bool = True,
                             interpret: Optional[bool] = None
                             ) -> Tuple[Dict[str, jnp.ndarray],
-                                       Dict[str, jnp.ndarray]]:
+                                       Optional[Dict[str, jnp.ndarray]]]:
     """One fused RK3 MHD substep on interior-resident (Z, Y, X) shards
     with exchanged halo slabs — the multi-device counterpart of
     ``pallas_mhd.mhd_substep_wrap_pallas`` (same RHS evaluation via
@@ -806,6 +807,13 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     counts, rz=bz, ry=esub, radius_rows=R, y_z_extended=True)`` with
     (bz, _) = ``mhd_halo_blocks(Z, Y, block_z, block_y)``.
     Returns (new_fields, new_w).
+
+    Dead-w elision as in ``mhd_substep_wrap_pallas``: ``w=None`` drops
+    the w read sweep (only valid at alpha_s == 0, i.e. substep 0);
+    ``write_w=False`` drops the w write sweep (substep 2, whose w no
+    one reads) and returns (new_fields, None). write_w elision is
+    bit-exact; w=None is ~1-ulp (compiler fusion changes without the
+    0*w term).
     """
     from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
     from .fd6 import FieldData
@@ -825,6 +833,8 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
     alpha = float(RK3_ALPHA[s])
     beta = float(RK3_BETA[s])
+    if w is None:
+        assert alpha == 0.0, "w=None is only valid when alpha_s == 0"
     dt_ = float(dt_phys)
     pad_lo = Dim3(0, R, R)     # x unpadded: wrap via pltpu.roll
     interior = Dim3(X, by, bz)
@@ -834,14 +844,16 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
         Z, Y, X, bz, by, esub=esub)
     nseg = len(field_specs)    # layout-dependent; kern slicing derives from it
     nf = len(FIELDS)
+    nw = 0 if w is None else nf
+    nwo = nf if write_w else 0
 
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
 
     def kern(*refs):
         field_refs = refs[:nseg * nf]
-        w_refs = refs[nseg * nf:nseg * nf + nf]
-        out_f = refs[nseg * nf + nf:nseg * nf + 2 * nf]
-        out_w = refs[nseg * nf + 2 * nf:]
+        w_refs = refs[nseg * nf:nseg * nf + nw]
+        out_f = refs[nseg * nf + nw:nseg * nf + nw + nf]
+        out_w = refs[nseg * nf + nw + nf:]
         data = {}
         for i, q in enumerate(FIELDS):
             win = select_window(field_refs[nseg * i:nseg * (i + 1)])
@@ -850,9 +862,11 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
         rates = mhd_rates(data, prm, comp)
         dta = jnp.dtype(comp)
         for i, q in enumerate(FIELDS):
-            wq = (dta.type(alpha) * w_refs[i][...].astype(comp)
-                  + dta.type(dt_) * rates[q])
-            out_w[i][...] = wq.astype(dtype)
+            wq = dta.type(dt_) * rates[q]
+            if nw:
+                wq = dta.type(alpha) * w_refs[i][...].astype(comp) + wq
+            if nwo:
+                out_w[i][...] = wq.astype(dtype)
             out_f[i][...] = (data[q].value
                              + dta.type(beta) * wq).astype(dtype)
 
@@ -861,12 +875,13 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     for q in FIELDS:
         in_specs.extend(field_specs)
         inputs.extend(inputs_for_field(fields[q], slabs[q]))
-    for q in FIELDS:
-        in_specs.append(main_spec)
-        inputs.append(w[q])
+    if nw:
+        for q in FIELDS:
+            in_specs.append(main_spec)
+            inputs.append(w[q])
     out_shape = [jax.ShapeDtypeStruct((Z, Y, X), dtype)
-                 for _ in range(2 * nf)]
-    out_specs = [main_spec] * (2 * nf)
+                 for _ in range(nf + nwo)]
+    out_specs = [main_spec] * (nf + nwo)
 
     outs = pl.pallas_call(
         kern,
@@ -879,7 +894,8 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
         interpret=interpret,
     )(*inputs)
     new_f = {q: outs[i] for i, q in enumerate(FIELDS)}
-    new_w = {q: outs[nf + i] for i, q in enumerate(FIELDS)}
+    new_w = ({q: outs[nf + i] for i, q in enumerate(FIELDS)}
+             if write_w else None)
     return new_f, new_w
 
 

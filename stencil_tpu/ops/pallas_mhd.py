@@ -166,14 +166,29 @@ def _fit_blocks(Z: int, Y: int, block_z: int, block_y: int,
 
 
 def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
-                            w: Dict[str, jnp.ndarray],
+                            w: Optional[Dict[str, jnp.ndarray]],
                             s: int, prm, dt_phys: float,
                             block_z: int = 8, block_y: int = 32,
+                            write_w: bool = True,
                             interpret: Optional[bool] = None
                             ) -> Tuple[Dict[str, jnp.ndarray],
-                                       Dict[str, jnp.ndarray]]:
+                                       Optional[Dict[str, jnp.ndarray]]]:
     """One fused RK3 substep ``s`` on unpadded (Z, Y, X) fields with
     periodic wrap in-kernel. Returns (new_fields, new_w).
+
+    Dead-w elision (the model's integrate loop uses both): Williamson's
+    alpha_0 == 0 means substep 0 never consumes the incoming w — pass
+    ``w=None`` and the kernel drops the 8-field w read sweep entirely
+    (XLA cannot DCE through an opaque pallas_call, so feeding w anyway
+    would stream a full HBM pass of dead data). Likewise nothing reads
+    the w that substep 2 writes (the next iteration restarts at
+    alpha_0 == 0): ``write_w=False`` drops the 8-field w write sweep
+    and returns (new_fields, None). write_w elision is bit-exact;
+    w=None changes how the compiler fuses the update (the 0*w term
+    disappears, enabling different FMA contraction), so fields match
+    to ~1 ulp rather than bit-for-bit. The reference app pays both
+    sweeps every iteration (astaroth/kernels.cu:63-90 reads/writes w
+    unconditionally).
 
     Requires Z, Y, block_z, block_y to be multiples of the dtype's
     sublane tile (8 f32 / 16 bf16) and block_z | Z, block_y | Y.
@@ -192,20 +207,24 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
     alpha = float(RK3_ALPHA[s])
     beta = float(RK3_BETA[s])
+    if w is None:
+        assert alpha == 0.0, "w=None is only valid when alpha_s == 0"
     dt_ = float(dt_phys)
     pad_lo = Dim3(0, R, R)     # x unpadded: wrap via pltpu.roll
     interior = Dim3(X, by, bz)
 
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
     nf = len(FIELDS)
+    nw = 0 if w is None else nf
+    nwo = nf if write_w else 0
     field_specs, assemble = _window_plan(Z, Y, X, bz, by, esub=esub)
     nseg = len(field_specs)
 
     def kern(*refs):
         field_refs = refs[:nseg * nf]
-        w_refs = refs[nseg * nf:nseg * nf + nf]
-        out_f = refs[nseg * nf + nf:nseg * nf + 2 * nf]
-        out_w = refs[nseg * nf + 2 * nf:nseg * nf + 3 * nf]
+        w_refs = refs[nseg * nf:nseg * nf + nw]
+        out_f = refs[nseg * nf + nw:nseg * nf + nw + nf]
+        out_w = refs[nseg * nf + nw + nf:]
         data = {}
         for i, q in enumerate(FIELDS):
             win = assemble(field_refs[nseg * i:nseg * (i + 1)])
@@ -214,9 +233,11 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
         rates = mhd_rates(data, prm, comp)
         dta = jnp.dtype(comp)
         for i, q in enumerate(FIELDS):
-            wq = (dta.type(alpha) * w_refs[i][...].astype(comp)
-                  + dta.type(dt_) * rates[q])
-            out_w[i][...] = wq.astype(dtype)
+            wq = dta.type(dt_) * rates[q]
+            if nw:
+                wq = dta.type(alpha) * w_refs[i][...].astype(comp) + wq
+            if nwo:
+                out_w[i][...] = wq.astype(dtype)
             out_f[i][...] = (data[q].value
                              + dta.type(beta) * wq).astype(dtype)
 
@@ -225,12 +246,13 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     for q in FIELDS:
         in_specs.extend(field_specs)
         inputs.extend([fields[q]] * nseg)
-    for q in FIELDS:
-        in_specs.append(main_spec)
-        inputs.append(w[q])
+    if nw:
+        for q in FIELDS:
+            in_specs.append(main_spec)
+            inputs.append(w[q])
     out_shape = [jax.ShapeDtypeStruct((Z, Y, X), dtype)
-                 for _ in range(2 * nf)]
-    out_specs = [main_spec] * (2 * nf)
+                 for _ in range(nf + nwo)]
+    out_specs = [main_spec] * (nf + nwo)
 
     outs = pl.pallas_call(
         kern,
@@ -243,7 +265,8 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
         interpret=interpret,
     )(*inputs)
     new_f = {q: outs[i] for i, q in enumerate(FIELDS)}
-    new_w = {q: outs[nf + i] for i, q in enumerate(FIELDS)}
+    new_w = ({q: outs[nf + i] for i, q in enumerate(FIELDS)}
+             if write_w else None)
     return new_f, new_w
 
 

@@ -56,11 +56,12 @@ def _interpret_mode():
 
 
 def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
-                               w: Dict[str, jnp.ndarray],
+                               w: Optional[Dict[str, jnp.ndarray]],
                                s: int, prm, dt_phys: float,
                                counts: Dim3,
                                block_z: int = 8, block_y: int = 32,
                                pair: bool = False,
+                               write_w: bool = True,
                                interpret: Optional[object] = None):
     """One overlapped RK3 MHD substep on interior-resident (Z, Y, X)
     shards: slab RDMA issued from inside the kernel, the fused
@@ -80,6 +81,13 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     ``s`` and the incoming ``w`` are ignored (alpha_0 == 0), the
     windows and the RDMA carry radius 2R, and the slabs come back with
     2R valid rows.
+
+    Dead-w elision as in ``mhd_substep_wrap_pallas``: ``w=None`` drops
+    the w read sweep (valid only at alpha_s == 0, i.e. substep 0 —
+    pair mode always elides it); ``write_w=False`` drops the w write
+    sweep (substep 2, whose w no one reads) and returns ``new_w``
+    as None. write_w elision is bit-exact; w=None is ~1-ulp (compiler
+    fusion changes without the 0*w term).
     """
     from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
     from .fd6 import FieldData
@@ -116,11 +124,14 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     nseg = len(field_specs)
     main_spec = pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0))
 
-    # pair mode never reads the incoming w (alpha_0 == 0): feeding it
-    # anyway would stream a full HBM read sweep of all 8 w fields per
-    # pass — exactly the sweep the pair exists to save — so the w
-    # inputs vanish from the operand list entirely
-    nw = 0 if pair else nf
+    # pair mode (and w=None at alpha_s == 0) never reads the incoming
+    # w: feeding it anyway would stream a full HBM read sweep of all 8
+    # w fields per pass — exactly the sweep the elision exists to save
+    # — so the w inputs vanish from the operand list entirely
+    if w is None and not pair:
+        assert alpha == 0.0, "w=None is only valid when alpha_s == 0"
+    nw = 0 if (pair or w is None) else nf
+    nwo = nf if write_w else 0
 
     def kern(*refs):
         field_refs = refs[:nseg * nf]
@@ -128,11 +139,11 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
         any_refs = refs[nseg * nf + nw:nseg * nf + nw + nf]
         outs = refs[nseg * nf + nw + nf:-2]
         out_f = outs[:nf]
-        out_w = outs[nf:2 * nf]
-        zlo_o = outs[2 * nf:3 * nf]
-        zhi_o = outs[3 * nf:4 * nf]
-        ylo_o = outs[4 * nf:5 * nf]
-        yhi_o = outs[5 * nf:6 * nf]
+        out_w = outs[nf:nf + nwo]
+        zlo_o = outs[nf + nwo:2 * nf + nwo]
+        zhi_o = outs[2 * nf + nwo:3 * nf + nwo]
+        ylo_o = outs[3 * nf + nwo:4 * nf + nwo]
+        yhi_o = outs[4 * nf + nwo:5 * nf + nwo]
         send = refs[-2]
         recv = refs[-1]
         kz = pl.program_id(0)
@@ -255,7 +266,8 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
         if pair:
             f2, w2 = mhd_pair_update(wins, prm, dtype, dt_phys, bz, by)
             for i, q in enumerate(FIELDS):
-                out_w[i][...] = w2[q]
+                if nwo:
+                    out_w[i][...] = w2[q]
                 out_f[i][...] = f2[q]
         else:
             data = {q: FieldData(wins[q].astype(comp), inv_ds,
@@ -263,9 +275,12 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
                     for q in FIELDS}
             rates = mhd_rates(data, prm, comp)
             for i, q in enumerate(FIELDS):
-                wq = (dta.type(alpha) * w_refs[i][...].astype(comp)
-                      + dta.type(dt_) * rates[q])
-                out_w[i][...] = wq.astype(dtype)
+                wq = dta.type(dt_) * rates[q]
+                if nw:
+                    wq = (dta.type(alpha) * w_refs[i][...].astype(comp)
+                          + wq)
+                if nwo:
+                    out_w[i][...] = wq.astype(dtype)
                 out_f[i][...] = (data[q].value
                                  + dta.type(beta) * wq).astype(dtype)
 
@@ -291,7 +306,7 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     for q in FIELDS:
         in_specs.extend(field_specs)
         inputs.extend(inputs_for_field(fields[q]))
-    if not pair:
+    if nw:
         for q in FIELDS:
             in_specs.append(main_spec)
             inputs.append(w[q])
@@ -299,11 +314,11 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
         inputs.append(fields[q])
 
-    out_shape = ([jax.ShapeDtypeStruct((Z, Y, X), dtype)] * (2 * nf)
+    out_shape = ([jax.ShapeDtypeStruct((Z, Y, X), dtype)] * (nf + nwo)
                  + [jax.ShapeDtypeStruct((bz, Y, X), dtype)] * (2 * nf)
                  + [jax.ShapeDtypeStruct((zext, esub, X), dtype)]
                  * (2 * nf))
-    out_specs = ([main_spec] * (2 * nf)
+    out_specs = ([main_spec] * (nf + nwo)
                  + [pl.BlockSpec(memory_space=pl.ANY)] * (4 * nf))
 
     outs = pl.pallas_call(
@@ -321,25 +336,28 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
         interpret=interpret,
     )(*inputs)
     new_f = {q: outs[i] for i, q in enumerate(FIELDS)}
-    new_w = {q: outs[nf + i] for i, q in enumerate(FIELDS)}
+    new_w = ({q: outs[nf + i] for i, q in enumerate(FIELDS)}
+             if write_w else None)
+    base = nf + nwo
     slabs = {}
     for i, q in enumerate(FIELDS):
-        slabs[q] = {"zlo": outs[2 * nf + i], "zhi": outs[3 * nf + i],
-                    "ylo": outs[4 * nf + i], "yhi": outs[5 * nf + i]}
+        slabs[q] = {"zlo": outs[base + i], "zhi": outs[base + nf + i],
+                    "ylo": outs[base + 2 * nf + i],
+                    "yhi": outs[base + 3 * nf + i]}
     return new_f, new_w, slabs
 
 
 def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
-                             w: Dict[str, jnp.ndarray],
+                             w: Optional[Dict[str, jnp.ndarray]],
                              f_partial: Dict[str, jnp.ndarray],
-                             w_partial: Dict[str, jnp.ndarray],
+                             w_partial: Optional[Dict[str, jnp.ndarray]],
                              slabs: Dict[str, Dict[str, jnp.ndarray]],
                              s: int, prm, dt_phys: float, strip: str,
                              block_z: int = 8, block_y: int = 32,
                              pair: bool = False,
                              interpret: Optional[object] = None
                              ) -> Tuple[Dict[str, jnp.ndarray],
-                                        Dict[str, jnp.ndarray]]:
+                                        Optional[Dict[str, jnp.ndarray]]]:
     """Exterior pass of the overlapped substep: recompute the shard-edge
     blocks from the landed slabs, writing into ``f_partial``/
     ``w_partial`` via output aliasing (unvisited blocks keep the
@@ -351,8 +369,12 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
     ``_mhd_window_plan`` (same slab selection → numerics identical to
     ``mhd_substep_halo_pallas``). ``fields``/``w`` are the PRE-substep
     state. ``pair=True`` recomputes the fused substep-0+1 update on
-    radius-2R windows (slabs must carry 2R rows). Reference: the
-    exterior kernel launches of astaroth/astaroth.cu:552-646."""
+    radius-2R windows (slabs must carry 2R rows). Dead-w elision
+    mirrors the overlap kernel: ``w=None`` drops the w read (valid
+    only at alpha_s == 0); ``w_partial=None`` drops the w outputs and
+    aliases (the substep-2 case — the returned new_w is then None).
+    Reference: the exterior kernel launches of
+    astaroth/astaroth.cu:552-646."""
     from ..models.astaroth import FIELDS, RK3_ALPHA, RK3_BETA, mhd_rates
     from .fd6 import FieldData
     from .pallas_mhd import mhd_pair_update
@@ -401,14 +423,20 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
     field_specs = [rm(sp) for sp in plan_specs]
     main_spec = rm(pl.BlockSpec((bz, by, X), lambda kz, ky: (kz, ky, 0)))
 
-    nw = 0 if pair else nf     # pair never reads w (alpha_0 == 0)
+    # pair (and w=None at alpha_s == 0) never reads w
+    if w is None and not pair:
+        assert alpha == 0.0, "w=None is only valid when alpha_s == 0"
+    nw = 0 if (pair or w is None) else nf
+    write_w = w_partial is not None
+    nwo = nf if write_w else 0
 
     def kern(*refs):
         field_refs = refs[:nseg * nf]
         w_refs = refs[nseg * nf:nseg * nf + nw]
         # aliased f_partial/w_partial inputs follow; never read in-kern
-        out_f = refs[nseg * nf + nw + 2 * nf:nseg * nf + nw + 3 * nf]
-        out_w = refs[nseg * nf + nw + 3 * nf:]
+        out_f = refs[nseg * nf + nw + nf + nwo:
+                     nseg * nf + nw + nwo + 2 * nf]
+        out_w = refs[nseg * nf + nw + nwo + 2 * nf:]
         kz, ky = remap(pl.program_id(0), pl.program_id(1))
         wins = {q: select_window(field_refs[nseg * i:nseg * (i + 1)],
                                  kz=kz, ky=ky)
@@ -416,16 +444,19 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
         if pair:
             f2, w2 = mhd_pair_update(wins, prm, dtype, dt_phys, bz, by)
             for i, q in enumerate(FIELDS):
-                out_w[i][...] = w2[q]
+                if nwo:
+                    out_w[i][...] = w2[q]
                 out_f[i][...] = f2[q]
             return
         data = {q: FieldData(wins[q].astype(comp), inv_ds, pad_lo,
                              interior, x_wrap=True) for q in FIELDS}
         rates = mhd_rates(data, prm, comp)
         for i, q in enumerate(FIELDS):
-            wq = (dta.type(alpha) * w_refs[i][...].astype(comp)
-                  + dta.type(dt_) * rates[q])
-            out_w[i][...] = wq.astype(dtype)
+            wq = dta.type(dt_) * rates[q]
+            if nw:
+                wq = dta.type(alpha) * w_refs[i][...].astype(comp) + wq
+            if nwo:
+                out_w[i][...] = wq.astype(dtype)
             out_f[i][...] = (data[q].value
                              + dta.type(beta) * wq).astype(dtype)
 
@@ -434,7 +465,7 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
     for q in FIELDS:
         in_specs.extend(field_specs)
         inputs.extend(inputs_for_field(fields[q], slabs[q]))
-    if not pair:
+    if nw:
         for q in FIELDS:
             in_specs.append(main_spec)
             inputs.append(w[q])
@@ -442,14 +473,15 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
     for q in FIELDS:
         in_specs.append(main_spec)
         inputs.append(f_partial[q])
-    for q in FIELDS:
-        in_specs.append(main_spec)
-        inputs.append(w_partial[q])
+    if write_w:
+        for q in FIELDS:
+            in_specs.append(main_spec)
+            inputs.append(w_partial[q])
 
     out_shape = [jax.ShapeDtypeStruct((Z, Y, X), dtype)
-                 for _ in range(2 * nf)]
-    out_specs = [main_spec] * (2 * nf)
-    aliases = {alias_base + i: i for i in range(2 * nf)}
+                 for _ in range(nf + nwo)]
+    out_specs = [main_spec] * (nf + nwo)
+    aliases = {alias_base + i: i for i in range(nf + nwo)}
 
     outs = pl.pallas_call(
         kern,
@@ -463,24 +495,29 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
         interpret=interpret,
     )(*inputs)
     new_f = {q: outs[i] for i, q in enumerate(FIELDS)}
-    new_w = {q: outs[nf + i] for i, q in enumerate(FIELDS)}
+    new_w = ({q: outs[nf + i] for i, q in enumerate(FIELDS)}
+             if write_w else None)
     return new_f, new_w
 
 
 def mhd_substep_overlap(fields: Dict[str, jnp.ndarray],
-                        w: Dict[str, jnp.ndarray],
+                        w: Optional[Dict[str, jnp.ndarray]],
                         s: int, prm, dt_phys: float, counts: Dim3,
                         block_z: int = 8, block_y: int = 32,
                         pair: bool = False,
+                        write_w: bool = True,
                         interpret: Optional[object] = None
                         ) -> Tuple[Dict[str, jnp.ndarray],
-                                   Dict[str, jnp.ndarray]]:
+                                   Optional[Dict[str, jnp.ndarray]]]:
     """One full overlapped substep: RDMA-overlap interior kernel, then
     the z- and y-strip exterior fix-ups. Drop-in equivalent of an
     exchange + ``mhd_substep_halo_pallas`` call (same numerics), with
     the exchange hidden behind the interior compute. ``pair=True`` is
     the fused substep-0+1 equivalent (one radius-2R overlapped
-    exchange + one pass for two substeps)."""
+    exchange + one pass for two substeps). Dead-w elision as in
+    ``mhd_substep_wrap_pallas``: ``w=None`` skips the w read sweep
+    (alpha_s == 0 only), ``write_w=False`` skips the w write sweep
+    and returns (new_fields, None)."""
     from ..models.astaroth import FIELDS
 
     Z, Y, _ = fields[FIELDS[0]].shape
@@ -492,7 +529,8 @@ def mhd_substep_overlap(fields: Dict[str, jnp.ndarray],
     # must reach the aliased fix-up kernels too
     f1, w1, slabs = mhd_substep_overlap_pallas(
         fields, w, s, prm, dt_phys, counts, block_z=block_z,
-        block_y=block_y, pair=pair, interpret=interpret)
+        block_y=block_y, pair=pair, write_w=write_w,
+        interpret=interpret)
     f1, w1 = mhd_substep_fixup_pallas(
         fields, w, f1, w1, slabs, s, prm, dt_phys, "z",
         block_z=block_z, block_y=block_y, pair=pair,

@@ -101,6 +101,55 @@ class TestStability:
         assert changed == len(before)
 
 
+class TestDeadWElision:
+    """alpha_0 == 0 makes the incoming w dead at substep 0 and the
+    outgoing w dead at substep 2 (the next iteration restarts the
+    recurrence); the kernels elide those HBM sweeps on request
+    (w=None / write_w=False). Dropping the 0*w term changes how the
+    compiler fuses the update (FMA contraction), so fields match to
+    ~1 ulp rather than bit-for-bit; write_w elision IS bit-exact."""
+
+    @staticmethod
+    def _mk_state(seed=7, size=(16, 16, 16)):
+        rng = np.random.default_rng(seed)
+        f = {q: np.asarray(rng.normal(0.0, 0.1, size), np.float64)
+             for q in FIELDS}
+        wz = {q: np.zeros(size, np.float64) for q in FIELDS}
+        return f, wz
+
+    @pytest.mark.slow
+    def test_wrap_kernel_elision_bit_identical(self):
+        from stencil_tpu.ops.pallas_mhd import mhd_substep_wrap_pallas
+
+        prm = MhdParams()
+        f, wz = self._mk_state()
+        fa, wa = mhd_substep_wrap_pallas(f, wz, 0, prm, prm.dt)
+        fb, wb = mhd_substep_wrap_pallas(f, None, 0, prm, prm.dt)
+        for q in FIELDS:
+            np.testing.assert_allclose(np.asarray(fa[q]),
+                                       np.asarray(fb[q]),
+                                       rtol=1e-14, atol=1e-18,
+                                       err_msg=q)
+            np.testing.assert_array_equal(np.asarray(wa[q]),
+                                          np.asarray(wb[q]), err_msg=q)
+        fc, wc = mhd_substep_wrap_pallas(fb, wb, 2, prm, prm.dt)
+        fd, wd = mhd_substep_wrap_pallas(fb, wb, 2, prm, prm.dt,
+                                         write_w=False)
+        assert wd is None
+        assert wc is not None
+        for q in FIELDS:
+            np.testing.assert_array_equal(np.asarray(fc[q]),
+                                          np.asarray(fd[q]), err_msg=q)
+
+    def test_wrap_kernel_w_none_rejected_midstep(self):
+        from stencil_tpu.ops.pallas_mhd import mhd_substep_wrap_pallas
+
+        prm = MhdParams()
+        f, _ = self._mk_state()
+        with pytest.raises(AssertionError):
+            mhd_substep_wrap_pallas(f, None, 1, prm, prm.dt)
+
+
 class TestParams:
     def test_defaults_match_reference_conf(self):
         p = MhdParams()
