@@ -68,6 +68,22 @@ run kernels_mhd_pair.csv env STENCIL_MHD_PAIR=1 \
     python scripts/bench_kernels.py --model mhd --kernels wrap,halo \
     ${WD[@]+"${WD[@]}"} \
     --iters "$([ "$SMOKE" = 1 ] && echo 2 || echo 10)" "${FAKE[@]}"
+# bfloat16 (half HBM traffic; MHD stores bf16 / computes f32) — same
+# default iteration counts as kernels_default.csv for a like-for-like
+# f32-vs-bf16 A/B
+run kernels_bf16.csv python scripts/bench_kernels.py \
+    --model both --kernels wrap,halo --dtype bf16 ${WD[@]+"${WD[@]}"} \
+    "${FAKE[@]}"
+# limiter evidence: stream ceiling + ladder + LIMITER verdict per
+# model (timeout = the same wedged-tunnel-compile insurance as the
+# --per-kernel-timeout on the bench_kernels runs; profile_wrap
+# compiles several variants per run and has no per-kernel flag)
+PROF=()
+if [ "$SMOKE" = "1" ]; then PROF=(--size 16 --iters 2); fi
+run profile_jacobi.csv timeout 2400 python scripts/profile_wrap.py \
+    ${PROF[@]+"${PROF[@]}"} "${FAKE[@]}"
+run profile_mhd.csv timeout 2400 python scripts/profile_wrap.py \
+    --model mhd ${PROF[@]+"${PROF[@]}"} "${FAKE[@]}"
 
 # 4. exchange microbenchmarks (BASELINE.md configs 2/4 analogs)
 ( cd apps
