@@ -29,6 +29,29 @@ if ! flock -n 9; then
 fi
 OUT="hw_queue_$(date +%Y%m%d_%H%M%S).log"
 echo "hw queue -> $OUT"
+
+# The raw hw_queue_*.log files are gitignored, but measurements must
+# survive into the repo even if the session ends (or the tunnel dies)
+# mid-queue: on exit OR a fatal signal (HUP/INT/TERM — SIGKILL cannot
+# be covered), append this run's full transcript (tunnel-wait noise
+# stripped, capped at 200 KB per run to bound the tracked file) to
+# HW_RESULTS.md, skipping runs that never got past probing. The
+# driver's end-of-round commit picks it up.
+persist_results() {
+  [ -s "$OUT" ] || return 0
+  grep -q "^== \[" "$OUT" || return 0   # no item ever started
+  {
+    echo ""
+    echo "## hw_queue run $(date -u +%Y-%m-%dT%H:%M:%SZ) ($OUT)"
+    echo '```'
+    grep -v "tunnel down (wait" "$OUT" | head -c 200000
+    echo '```'
+  } >> HW_RESULTS.md
+}
+trap persist_results EXIT
+trap 'persist_results; trap - EXIT; exit 129' HUP
+trap 'persist_results; trap - EXIT; exit 130' INT
+trap 'persist_results; trap - EXIT; exit 143' TERM
 WD=(--per-kernel-timeout 2400)
 MAX_WAITS="${MAX_WAITS:-240}"   # 240 x 150 s = 10 h of patience, total
 waits=0
