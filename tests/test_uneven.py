@@ -218,3 +218,26 @@ def test_uneven_set_get_roundtrip():
     vals = _ripple_grid(size)
     dd.set_interior("q", vals)
     np.testing.assert_array_equal(dd.interior_to_host("q"), vals)
+
+
+@pytest.mark.slow
+def test_uneven_mhd_radius3_matches_oracle():
+    """Radius-3, 8-field MHD on +-1 shards (18 over 4 -> 5,5,4,4):
+    the uneven exchange at a 3-deep halo with multiple quantities —
+    a combination the radius-1 Jacobi uneven tests never reach
+    (reference: the partitioner serves the astaroth app the same +-1
+    subdomains it serves jacobi3d, partition.hpp:55-86)."""
+    import jax
+
+    from stencil_tpu.models.astaroth import FIELDS, Astaroth
+
+    a = Astaroth(18, 18, 18, mesh_shape=(1, 1, 1), dtype=np.float64,
+                 devices=jax.devices()[:1], kernel="xla")
+    b = Astaroth(18, 18, 18, mesh_shape=(1, 4, 1), dtype=np.float64,
+                 devices=jax.devices()[:4], kernel="xla")
+    for m in (a, b):
+        m.init()
+        m.step()
+    for q in FIELDS:
+        np.testing.assert_allclose(b.field(q), a.field(q),
+                                   rtol=0, atol=1e-12, err_msg=q)
