@@ -1,13 +1,19 @@
 #!/usr/bin/env bash
 # The CI pipeline, runnable locally or from a trigger (the
-# .travis.yml:1-20 analog): native build, unit tests on the 8-device
-# virtual CPU mesh, app smoke runs, and the multi-chip certification
-# sweep. No TPU required.
+# .travis.yml:1-20 analog): static lint gate, native build, unit tests
+# on the 8-device virtual CPU mesh, app smoke runs, and the multi-chip
+# certification sweep. No TPU required.
 #
 # Tiers (CI_TIER env): "smoke" (default) skips the @pytest.mark.slow
 # interpret-mode parity tests and finishes in a few minutes — the
 # pre-push / per-commit tier; "full" runs the entire suite (~15 min) —
 # the nightly/merge tier.
+#
+# Lint stage ("lint" job marker): smoke runs stencil-lint + ruff only
+# (seconds); full also runs mypy. ruff/mypy are optional dev deps
+# (pyproject.toml [project.optional-dependencies].lint) — absent, they
+# are skipped with a notice; stencil-lint is part of the tree and
+# always gates.
 #
 # Triggers that invoke this script:
 #   * .github/workflows/ci.yml  — push/PR (smoke) + nightly cron (full)
@@ -17,10 +23,32 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
-echo "== 1/4 native build =="
+echo "== 1/5 lint (stencil-lint + ruff; tier=$TIER) =="
+# stencil-lint: static halo-radius / DMA-discipline / ppermute checks
+# (python -m stencil_tpu.analysis, see README "Static analysis").
+# Exits nonzero on findings; the JSON report is the CI artifact.
+python -m stencil_tpu.analysis --json stencil_lint_report.json
+if python -c "import ruff" 2>/dev/null; then
+  python -m ruff check stencil_tpu/
+elif command -v ruff >/dev/null; then
+  ruff check stencil_tpu/
+else
+  echo "-- ruff not installed; skipping (pip install .[lint] to enable)"
+fi
+if [ "$TIER" = "full" ]; then
+  if python -c "import mypy" 2>/dev/null; then
+    python -m mypy stencil_tpu/
+  elif command -v mypy >/dev/null; then
+    mypy stencil_tpu/
+  else
+    echo "-- mypy not installed; skipping (pip install .[lint] to enable)"
+  fi
+fi
+
+echo "== 2/5 native build =="
 bash ci/build.sh
 
-echo "== 2/4 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+echo "== 3/5 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
 # The full tier is dominated by interpret-mode Pallas parity tests
 # (CPU-bound, independent): fan them out with pytest-xdist when the
 # machine has cores to spare. Each worker process builds its own
@@ -36,7 +64,11 @@ else
   python -m pytest tests/ -q --maxfail=1 -m "not slow"
 fi
 
-echo "== 3/4 app smoke runs =="
+echo "== 4/5 app smoke runs =="
+# overlap app smokes execute remote DMA: possible only on a TPU or
+# with the distributed (mosaic) interpreter — probe, don't assume
+RDMA_OK=$(python -c "from stencil_tpu._compat import remote_dma_runnable
+print(1 if remote_dma_runnable() else 0)")
 smoke() { echo "-- $*"; python "$@" > /dev/null; }
 ( cd apps
   smoke jacobi3d.py --x 8 --y 8 --z 8 --iters 2 --batch 1 --fake-cpu 8
@@ -45,14 +77,19 @@ smoke() { echo "-- $*"; python "$@" > /dev/null; }
   smoke jacobi3d.py --x 8 --y 8 --z 8 --iters 2 --batch 1 --fake-cpu 8 \
         --fake-slices 2 --dcn-axis z
   smoke astaroth.py --nx 8 --ny 8 --nz 8 --iters 1 --fake-cpu 8
-  smoke astaroth.py --nx 8 --ny 8 --nz 8 --iters 1 --fake-cpu 4 \
-        --kernel halo --overlap
+  if [ "$RDMA_OK" = "1" ]; then
+    smoke astaroth.py --nx 8 --ny 8 --nz 8 --iters 1 --fake-cpu 4 \
+          --kernel halo --overlap
+  else
+    echo "-- SKIP astaroth --overlap smoke (no interpreted remote DMA" \
+         "in this JAX; stencil-lint covers the kernels statically)"
+  fi
   smoke bench_exchange.py --x 8 --y 8 --z 8 --iters 2 --fake-cpu 8
   smoke machine_info.py --fake-cpu 8
   smoke bench_qap.py --sizes 4 6
 )
 
-echo "== 4/4 multi-chip certification sweep =="
+echo "== 5/5 multi-chip certification sweep =="
 python __graft_entry__.py 8 | tail -1
 
 echo "CI PASSED"

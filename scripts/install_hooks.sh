@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
 # Install the local git pre-push hook that runs the smoke-tier CI
-# pipeline (ci/run_ci.sh) before every push — the local analog of the
-# reference's service-triggered CI (.travis.yml:1-20). One-time setup:
+# pipeline (ci/run_ci.sh) before every push — stencil-lint is its
+# stage 1, so a broken invariant fails in seconds, before any build.
+# The local analog of the reference's service-triggered CI
+# (.travis.yml:1-20). One-time setup:
 #   bash scripts/install_hooks.sh
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -9,9 +11,11 @@ HOOK=.git/hooks/pre-push
 mkdir -p .git/hooks
 cat > "$HOOK" <<'EOF'
 #!/usr/bin/env bash
-# auto-installed by scripts/install_hooks.sh: smoke-tier CI gate.
-# Bypass with `git push --no-verify` (e.g. docs-only changes).
+# auto-installed by scripts/install_hooks.sh: smoke-tier CI gate
+# (stage 1 = stencil-lint, fails fast before the build). Bypass with
+# `git push --no-verify` (e.g. docs-only changes).
 exec env CI_TIER=smoke bash ci/run_ci.sh
 EOF
 chmod +x "$HOOK"
-echo "installed $HOOK (smoke-tier CI gate; bypass: git push --no-verify)"
+echo "installed $HOOK (stencil-lint + smoke-tier CI gate;" \
+     "bypass: git push --no-verify)"

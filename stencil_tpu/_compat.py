@@ -1,0 +1,139 @@
+"""JAX version-compatibility shims and capability probes.
+
+The framework targets the current JAX API surface (``jax.shard_map``,
+``pltpu.CompilerParams``, the distributed TPU interpreter's
+``pltpu.InterpretParams``); some deployment images pin an older JAX
+where those names either do not exist yet or are spelled differently.
+Importing :mod:`stencil_tpu` installs small forwarding shims so ONE
+codebase runs on both:
+
+* ``jax.shard_map``      -> ``jax.experimental.shard_map.shard_map``
+  (the ``check_vma`` kwarg becomes the older ``check_rep``);
+* ``pltpu.CompilerParams`` -> ``pltpu.TPUCompilerParams`` with unknown
+  kwargs dropped (e.g. ``has_side_effects``, which the old class does
+  not carry — only relevant to DCE on real TPUs, where a matching
+  modern JAX is installed anyway);
+* ``pltpu.InterpretParams`` -> a truthy stub, so modules can *construct*
+  interpreter parameters on any version. The stub enables the generic
+  Pallas interpreter; it does NOT provide the distributed TPU
+  interpreter's inter-device DMA emulation or vector-clock race
+  detector — code needing those must gate on the probes below.
+
+Capability probes (evaluated once, against the PRE-shim API):
+
+* ``HAS_NATIVE_SHARD_MAP``        — ``jax.shard_map`` existed already;
+* ``HAS_DISTRIBUTED_INTERPRET``   — the real ``pltpu.InterpretParams``
+  (mosaic interpret mode: emulated inter-device DMA on a host mesh);
+* ``has_race_detector()``         — distributed interpret with
+  ``detect_races`` (the vector-clock sanitizer the race tests need).
+
+Tests that exercise interpreted remote DMA use these to skip — not
+fail — on images whose JAX cannot run them (the "gate missing deps"
+rule), keeping the suite green everywhere while still running the full
+choreography wherever the real interpreter exists.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+import jax
+
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+try:  # the distributed (mosaic) TPU interpreter, JAX >= 0.5.x
+    from jax.experimental.pallas import tpu as _pltpu
+
+    HAS_DISTRIBUTED_INTERPRET = hasattr(_pltpu, "InterpretParams")
+except Exception:  # pragma: no cover - pallas always importable in CI
+    _pltpu = None
+    HAS_DISTRIBUTED_INTERPRET = False
+
+
+def has_race_detector() -> bool:
+    """True when ``pltpu.InterpretParams(detect_races=True)`` is the
+    real vector-clock race detector (not this module's stub)."""
+    if not HAS_DISTRIBUTED_INTERPRET or _pltpu is None:
+        return False
+    params = inspect.signature(_pltpu.InterpretParams).parameters
+    return "detect_races" in params
+
+
+def remote_dma_runnable() -> bool:
+    """True when the Pallas remote-DMA choreography can actually RUN in
+    this process: on a real TPU backend always; off-TPU only when the
+    distributed (mosaic) TPU interpreter exists to emulate inter-device
+    DMA. Tests, the certification sweep, and CI smoke stages gate the
+    RDMA/overlap paths on this (they are *traceable* everywhere — the
+    static analysis pass still covers them — just not executable)."""
+    if HAS_DISTRIBUTED_INTERPRET:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+class _InterpretParamsStub:
+    """Truthy stand-in for ``pltpu.InterpretParams`` on old JAX: lets
+    modules build interpreter params unconditionally; pallas_call treats
+    any truthy ``interpret=`` as the generic interpreter."""
+
+    _stencil_tpu_compat_stub = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        self.detect_races = bool(kwargs.pop("detect_races", False))
+        self.kwargs = kwargs
+
+    def __bool__(self) -> bool:
+        return True
+
+    def __repr__(self) -> str:
+        return f"InterpretParamsStub(detect_races={self.detect_races})"
+
+
+def _shard_map_shim(f=None, *, mesh=None, in_specs=None, out_specs=None,
+                    check_vma: bool = True, **kwargs: Any):
+    """``jax.shard_map`` on top of the legacy
+    ``jax.experimental.shard_map.shard_map`` (``check_vma`` was called
+    ``check_rep`` there). Unknown kwargs are REJECTED, not dropped —
+    silently ignoring a semantic option would make old-JAX runs
+    diverge from modern-JAX runs instead of failing loudly."""
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    if kwargs:
+        raise TypeError(
+            f"jax.shard_map compat shim does not support kwargs "
+            f"{sorted(kwargs)} on this JAX version")
+
+    def bind(fun):
+        return _legacy(fun, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=bool(check_vma))
+
+    return bind if f is None else bind(f)
+
+
+_installed = False
+
+
+def install() -> None:
+    """Install the shims (idempotent; called from package import)."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    if not HAS_NATIVE_SHARD_MAP:
+        jax.shard_map = _shard_map_shim
+    if _pltpu is not None:
+        if not hasattr(_pltpu, "CompilerParams"):
+            legacy = _pltpu.TPUCompilerParams
+            accepted = set(inspect.signature(legacy.__init__).parameters)
+
+            def _compiler_params(**kwargs: Any):
+                return legacy(**{k: v for k, v in kwargs.items()
+                                 if k in accepted})
+
+            _pltpu.CompilerParams = _compiler_params
+        if not HAS_DISTRIBUTED_INTERPRET:
+            _pltpu.InterpretParams = _InterpretParamsStub

@@ -1,0 +1,68 @@
+"""stencil-lint: static invariant checking for the stencil framework.
+
+Three checkers prove, WITHOUT executing anything (pure jaxpr tracing —
+seconds on any CPU box, no TPU, no interpreter), the invariants the
+whole framework hangs on:
+
+* :mod:`.footprint`   — every registered stencil op's true access
+  footprint is covered by its declared ``geometry.Radius`` in all 26
+  directions (asymmetric radii included);
+* :mod:`.dma`         — every Pallas kernel's remote DMA is barrier-
+  ordered, started exactly once per semaphore arm, and waited on both
+  ends (the static analog of the interpreter's race detector);
+* :mod:`.collectives` — every ``lax.ppermute`` permutation is a full
+  bijection of its mesh axis and all collective axis names resolve.
+
+Run ``python -m stencil_tpu.analysis`` (exit nonzero on findings,
+``--json`` for the CI artifact), or use :func:`run_targets` /
+:func:`stencil_tpu.analysis.registry.default_targets` from pytest.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+from .collectives import (CollectiveSpec, CollectiveTarget,
+                          check_collectives)
+from .dma import PallasKernelSpec, PallasKernelTarget, check_pallas_kernels
+from .footprint import StencilOpSpec, StencilOpTarget, check_stencil_op
+from .report import ERROR, WARNING, Finding, Report
+
+CHECKERS = ("footprint", "dma", "collectives")
+
+__all__ = [
+    "CHECKERS", "ERROR", "WARNING", "Finding", "Report",
+    "CollectiveSpec", "CollectiveTarget", "PallasKernelSpec",
+    "PallasKernelTarget", "StencilOpSpec", "StencilOpTarget",
+    "check_collectives", "check_pallas_kernels", "check_stencil_op",
+    "run_targets",
+]
+
+_DISPATCH = {
+    "footprint": check_stencil_op,
+    "dma": check_pallas_kernels,
+    "collectives": check_collectives,
+}
+
+
+def run_targets(targets: Iterable,
+                checkers: Optional[Sequence[str]] = None) -> Report:
+    """Run each target through its checker; aggregate into a Report."""
+    enabled = set(checkers) if checkers else set(CHECKERS)
+    unknown = enabled - set(CHECKERS)
+    if unknown:
+        raise ValueError(f"unknown checkers {sorted(unknown)}; "
+                         f"available: {list(CHECKERS)}")
+    report = Report()
+    for target in targets:
+        kind = getattr(target, "checker", None)
+        if kind not in _DISPATCH:
+            report.findings.append(Finding(
+                "runner", getattr(target, "name", repr(target)),
+                f"unknown target kind {type(target).__name__}"))
+            continue
+        if kind not in enabled:
+            continue
+        report.targets_checked.append(target.name)
+        report.extend(_DISPATCH[kind](target))
+    return report

@@ -1,0 +1,89 @@
+"""CLI entry: ``python -m stencil_tpu.analysis``.
+
+Exit status: 0 when every checked invariant holds, 1 when any
+error-severity finding exists, 2 on usage errors. ``--json PATH``
+writes the machine-readable report (schema in ``report.py``) for CI
+artifacts. Positional arguments are fixture module paths (files
+defining ``TARGETS``) checked INSTEAD of the shipped registry — the
+negative-control hook: the CLI must exit nonzero on every fixture
+under ``tests/fixtures/lint/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def _setup_backend() -> None:
+    """Analysis is pure tracing: force a small virtual-CPU mesh so the
+    shard_map targets resolve their axes without touching accelerators
+    (mirrors tests/conftest.py; shared old-JAX fallback lives in
+    apply_fake_cpu)."""
+    try:
+        from stencil_tpu.utils.config import apply_fake_cpu
+
+        apply_fake_cpu(8)
+    except RuntimeError:
+        pass  # backend already initialized; use whatever exists
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m stencil_tpu.analysis",
+        description="stencil-lint: static halo-radius / DMA-discipline "
+                    "/ collective-permutation checks (no execution)")
+    parser.add_argument("fixtures", nargs="*",
+                        help="fixture module paths (files defining "
+                             "TARGETS) to check instead of the shipped "
+                             "registry")
+    parser.add_argument("--json", metavar="PATH",
+                        help="write the JSON report here")
+    parser.add_argument("--checker", action="append", dest="checkers",
+                        choices=("footprint", "dma", "collectives"),
+                        help="run only this checker (repeatable)")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="suppress the per-target OK lines")
+    args = parser.parse_args(argv)
+
+    _setup_backend()
+
+    from . import run_targets
+    from .registry import default_targets, load_targets
+
+    try:
+        if args.fixtures:
+            targets = []
+            for path in args.fixtures:
+                targets.extend(load_targets(path))
+        else:
+            targets = default_targets()
+    except (ImportError, ValueError, OSError) as e:
+        print(f"stencil-lint: cannot load targets: {e}", file=sys.stderr)
+        return 2
+
+    report = run_targets(targets, checkers=args.checkers)
+
+    if not args.quiet:
+        flagged = {f.target.split(":", 1)[0] for f in report.findings}
+        for name in report.targets_checked:
+            if name not in flagged:
+                print(f"  OK   {name}")
+    for f in report.findings:
+        tag = "ERROR" if f.severity == "error" else "warn "
+        print(f"  {tag} {f}")
+    n_err, n_warn = len(report.errors), len(report.warnings)
+    print(f"stencil-lint: {len(report.targets_checked)} targets, "
+          f"{n_err} error(s), {n_warn} warning(s)")
+
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            fh.write(report.to_json())
+        print(f"stencil-lint: JSON report written to {args.json}")
+
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,122 @@
+"""Checker 3: collective sanity — ppermute permutations and axis names.
+
+``lax.ppermute`` is the exchange engine's transport: each halo shift is
+a (source, dest) pair list over one mesh axis. XLA only validates the
+permutation at compile time (and silently drops un-sourced
+destinations — receiving shards keep ZEROS, the exact silent-stale-halo
+failure mode). Statically, a shift is safe iff its permutation is a
+full bijection of the axis:
+
+* every pair index lies in ``[0, axis_size)``;
+* no duplicated source and no duplicated destination;
+* every device sends and receives exactly once (``len(perm) == n``) —
+  a partial permutation leaves some shard's halo unfilled.
+
+Additionally every collective's axis name (``ppermute``, ``all_gather``,
+``axis_index``, ``psum``...) must resolve against the mesh axes built
+by ``parallel/mesh.py`` — a typo'd axis name surfaces at runtime deep
+inside shard_map; here it is a one-line finding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+from .jaxprs import iter_eqns, trace
+from .report import ERROR, WARNING, Finding
+
+# primitives that carry an axis_name param worth validating
+_AXIS_PRIMS = ("ppermute", "all_gather", "axis_index", "psum",
+               "all_to_all", "reduce_scatter")
+
+
+@dataclasses.dataclass
+class CollectiveSpec:
+    """A traceable program (typically ``shard_map``-ped, possibly
+    jitted) plus the mesh axis sizes its collectives must respect."""
+
+    fn: Callable
+    args: Sequence[Any]
+    axis_sizes: Dict[str, int]
+    expect_ppermute: bool = False
+
+
+@dataclasses.dataclass
+class CollectiveTarget:
+    name: str
+    build: Callable[[], CollectiveSpec]
+
+    checker = "collectives"
+
+
+def _axis_names(params: dict) -> Tuple[str, ...]:
+    ax = params.get("axis_name", params.get("axes", ()))
+    if isinstance(ax, (tuple, list)):
+        return tuple(str(a) for a in ax)
+    return (str(ax),)
+
+
+def check_collectives(target: CollectiveTarget) -> List[Finding]:
+    try:
+        spec = target.build()
+    except Exception as e:  # noqa: BLE001
+        return [Finding("collectives", target.name,
+                        f"target build failed: {type(e).__name__}: {e}")]
+    try:
+        closed = trace(spec.fn, *spec.args)
+    except Exception as e:  # noqa: BLE001
+        return [Finding("collectives", target.name,
+                        f"trace failed: {type(e).__name__}: {e}")]
+
+    findings: List[Finding] = []
+    sizes = dict(spec.axis_sizes)
+    n_ppermute = 0
+
+    def err(msg: str, severity: str = ERROR) -> None:
+        findings.append(Finding("collectives", target.name, msg, severity))
+
+    for eqn in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        if name not in _AXIS_PRIMS:
+            continue
+        axes = _axis_names(eqn.params)
+        for ax in axes:
+            if ax not in sizes:
+                err(f"{name} over unknown mesh axis '{ax}' (mesh axes: "
+                    f"{sorted(sizes)})")
+        if name != "ppermute":
+            continue
+        n_ppermute += 1
+        if len(axes) != 1 or axes[0] not in sizes:
+            continue  # unknown axis already reported
+        n = sizes[axes[0]]
+        perm = [tuple(int(i) for i in pair)
+                for pair in eqn.params.get("perm", ())]
+        label = f"ppermute over '{axes[0]}' (size {n}) perm={perm}"
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        oob = [i for i in srcs + dsts if i < 0 or i >= n]
+        if oob:
+            err(f"{label}: indices {sorted(set(oob))} outside "
+                f"[0, {n})")
+            continue
+        if len(set(srcs)) != len(srcs):
+            dup = sorted({s for s in srcs if srcs.count(s) > 1})
+            err(f"{label}: duplicated source(s) {dup} — a shard sends "
+                f"twice, not a permutation")
+        if len(set(dsts)) != len(dsts):
+            dup = sorted({d for d in dsts if dsts.count(d) > 1})
+            err(f"{label}: duplicated destination(s) {dup} — conflicting "
+                f"writes to one shard's halo")
+        if (len(set(srcs)) == len(srcs) and len(set(dsts)) == len(dsts)
+                and (set(srcs) != set(range(n))
+                     or set(dsts) != set(range(n)))):
+            err(f"{label}: not a full bijection of the axis — "
+                f"unpaired shards keep ZEROS in their halos (silent "
+                f"stale data)")
+
+    if spec.expect_ppermute and n_ppermute == 0:
+        err("expected ppermute collectives but none traced — the "
+            "checker would be vacuous here", WARNING)
+    return findings

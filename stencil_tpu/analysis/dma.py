@@ -1,0 +1,366 @@
+"""Checker 2: Pallas remote-DMA / semaphore discipline.
+
+The static analog of the distributed TPU interpreter's vector-clock
+race detector (tests/test_sanitizer.py): instead of executing the
+kernels, trace them to jaxprs and verify the choreography invariants
+every remote write depends on. Analyzed per ``pallas_call`` kernel:
+
+* **start/wait pairing** — every ``make_async_remote_copy`` start puts
+  its send AND recv semaphores in flight; both must be waited
+  (``dma_wait``) before the kernel ends — "waited on both ends" (the
+  SPMD kernel body is the program of *every* device, so the local
+  send-wait and recv-wait cover both endpoints of each transfer);
+* **no reuse in flight** — a semaphore cell may not be re-armed by a
+  second start before its wait (the interpreter reports this as a
+  data race; statically it is a double-arm);
+* **barrier ordering** — a kernel issuing remote writes must rendezvous
+  first: ``get_barrier_semaphore`` + neighbor signals + a wait whose
+  value matches the number of signals, all BEFORE the first remote DMA
+  start (destination buffers quiescent — the "you may write" handshake
+  of tx_ipc.cpp:20-105);
+* **mesh axis hygiene** — every ``device_id`` axis in remote copies and
+  barrier signals must name a real mesh axis.
+
+Scope and approximations (deliberate, documented):
+
+* only REMOTE DMAs (a ``device_id``) are tracked — local double-buffer
+  pipelines (``make_async_copy`` in ``fori_loop``) arm semaphores
+  across iterations by design and are the interpreter's job to check;
+* ``cond`` branches (``pl.when`` grid phases) are inlined in order —
+  all phases execute on some grid step, so their starts/waits form one
+  program order;
+* remote in-flight state must be loop-invariant across ``scan`` /
+  ``while`` bodies: a remote start whose wait lives in a later
+  iteration cannot be proven single-armed and is flagged;
+* dynamic semaphore indices on remote DMAs are flagged as warnings
+  (identity cannot be established statically).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.tree_util as jtu
+
+from .jaxprs import (ClosedJaxpr, Jaxpr, Var, find_pallas_kernels,
+                     index_key, is_semaphore_ref, literal_int, trace)
+from .report import ERROR, WARNING, Finding
+
+
+@dataclasses.dataclass
+class PallasKernelSpec:
+    """A traceable entry point containing >= 1 ``pallas_call``.
+
+    ``fn(*args)`` is traced abstractly (typically a ``shard_map``-ped
+    wrapper over a concrete mesh so ``lax.axis_index`` resolves);
+    ``axis_names`` are the mesh axes remote ``device_id``s may target.
+    ``expect_remote_dma`` asserts at least one remote copy is found —
+    guarding the checker against vacuously passing a refactored kernel
+    that no longer traces any DMA.
+    """
+
+    fn: Callable
+    args: Sequence[Any]
+    axis_names: Tuple[str, ...] = ()
+    expect_remote_dma: bool = False
+
+
+@dataclasses.dataclass
+class PallasKernelTarget:
+    name: str
+    build: Callable[[], PallasKernelSpec]
+
+    checker = "dma"
+
+
+# ---------------------------------------------------------------------------
+# event extraction
+
+_START = "start"
+_WAIT = "wait"
+_BSIG = "barrier_signal"
+_BWAIT = "barrier_wait"
+_LOOP_BEGIN = "loop_begin"
+_LOOP_END = "loop_end"
+
+
+def _sem_key(var: Any, transforms: Any) -> Tuple:
+    return (id(var), index_key(transforms))
+
+
+def _device_axes(device_id: Any) -> Tuple[str, ...]:
+    if isinstance(device_id, dict):
+        return tuple(str(k) for k in device_id.keys())
+    return ()
+
+
+def _unflatten(eqn, tree_param: str, env: Optional[dict] = None):
+    tree = eqn.params.get(tree_param)
+    if tree is None:
+        return None
+    invars = list(eqn.invars)
+    if env:
+        invars = [env.get(v, v) if isinstance(v, Var) else v
+                  for v in invars]
+    try:
+        return jtu.tree_unflatten(tree, invars)
+    except Exception:  # noqa: BLE001 - layout drift on other jax versions
+        return None
+
+
+def _sub_env(sub_invars, outer_invars, env: dict) -> dict:
+    """Map a sub-jaxpr's invars to the CANONICAL (outermost) atoms of
+    the operands feeding them, so a scratch semaphore ref keeps one
+    identity across cond branches / loop bodies / nested calls."""
+    new = {}
+    for iv, ov in zip(sub_invars, outer_invars):
+        if isinstance(ov, Var):
+            new[iv] = env.get(ov, ov)
+        else:
+            new[iv] = ov
+    return new
+
+
+def _collect_events(jaxpr: Jaxpr, events: List[Tuple],
+                    notes: List[str], env: Optional[dict] = None) -> None:
+    env = env or {}
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dma_start":
+            un = _unflatten(eqn, "tree", env)
+            if un is None or len(un) != 9:
+                notes.append("unrecognized dma_start operand layout; "
+                             "DMA not analyzed")
+                continue
+            _src, _st, _dst, _dt, ssem, sst, rsem, rst, device_id = un
+            remote = isinstance(device_id, dict) and bool(device_id)
+            keys = []
+            for sem, tr in ((ssem, sst), (rsem, rst)):
+                if sem is not None and is_semaphore_ref(sem):
+                    keys.append(_sem_key(sem, tr))
+            events.append((_START, tuple(keys), remote,
+                           _device_axes(device_id)))
+        elif name == "dma_wait":
+            un = _unflatten(eqn, "tree", env)
+            if un is None or len(un) != 9:
+                notes.append("unrecognized dma_wait operand layout; "
+                             "wait not analyzed")
+                continue
+            # dma_wait waits on the dst_sem slot (wait_send swaps
+            # src/dst so the same slot holds the send semaphore)
+            _src, _st, _dst, _dt, _ssem, _sst, rsem, rst, _dev = un
+            if rsem is not None and is_semaphore_ref(rsem):
+                events.append((_WAIT, _sem_key(rsem, rst)))
+        elif name == "get_barrier_semaphore":
+            for ov in eqn.outvars:
+                events.append(("barrier_def", id(ov)))
+        elif name == "semaphore_signal":
+            un = _unflatten(eqn, "args_tree", env)
+            if un is None or len(un) < 4:
+                continue
+            sem, _tr, inc, device_id = un[0], un[1], un[2], un[3]
+            events.append((_BSIG, id(sem), literal_int(inc),
+                           _device_axes(device_id)))
+        elif name == "semaphore_wait":
+            un = _unflatten(eqn, "args_tree", env)
+            if un is None or len(un) < 3:
+                continue
+            sem, _tr, value = un[0], un[1], un[2]
+            events.append((_BWAIT, id(sem), literal_int(value)))
+        elif name == "cond":
+            # pl.when phases: all branches execute on some grid step —
+            # inline them in syntactic order (operands after the
+            # predicate feed every branch's invars)
+            for br in eqn.params.get("branches", ()):
+                bj = br.jaxpr if isinstance(br, ClosedJaxpr) else br
+                _collect_events(bj, events, notes,
+                                _sub_env(bj.invars, eqn.invars[1:], env))
+        elif name == "scan":
+            events.append((_LOOP_BEGIN,))
+            sub = eqn.params.get("jaxpr")
+            sj = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+            if isinstance(sj, Jaxpr):
+                # invars = consts + carry + xs, positionally aligned
+                # with the body's consts + carry + x-elements
+                _collect_events(sj, events, notes,
+                                _sub_env(sj.invars, eqn.invars, env))
+            events.append((_LOOP_END,))
+        elif name == "while":
+            events.append((_LOOP_BEGIN,))
+            cn = eqn.params.get("cond_nconsts", 0)
+            bn = eqn.params.get("body_nconsts", 0)
+            # eqn.invars = cond_consts + body_consts + carry; the cond
+            # jaxpr sees cond_consts + carry, the body body_consts +
+            # carry — slice the matching operand groups for each
+            carry = list(eqn.invars[cn + bn:])
+            for key, operands in (
+                    ("cond_jaxpr", list(eqn.invars[:cn]) + carry),
+                    ("body_jaxpr", list(eqn.invars[cn:cn + bn]) + carry)):
+                sub = eqn.params.get(key)
+                if sub is None:
+                    continue
+                sj = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+                if isinstance(sj, Jaxpr):
+                    _collect_events(sj, events, notes,
+                                    _sub_env(sj.invars, operands, env))
+            events.append((_LOOP_END,))
+        else:
+            sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+            if sub is not None:
+                sj = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+                if isinstance(sj, Jaxpr):
+                    _collect_events(sj, events, notes,
+                                    _sub_env(sj.invars, eqn.invars, env))
+
+
+# ---------------------------------------------------------------------------
+# discipline simulation
+
+
+def _fmt_key(key: Tuple) -> str:
+    _var, idx = key
+    return f"sem@{_var % 10000}[{','.join(map(str, idx))}]"
+
+
+def _simulate(kernel: str, events: List[Tuple],
+              axis_names: Tuple[str, ...]) -> Tuple[List[Finding], bool]:
+    """Run the discipline state machine over one kernel's events.
+    Returns (findings, saw_remote_dma)."""
+    findings: List[Finding] = []
+
+    def err(msg: str, severity: str = ERROR) -> None:
+        findings.append(Finding("dma", kernel, msg, severity))
+
+    # pass 1: which semaphore cells ever back a REMOTE transfer?
+    tracked: set = set()
+    saw_remote = False
+    for ev in events:
+        if ev[0] == _START and ev[2]:
+            saw_remote = True
+            tracked.update(ev[1])
+
+    # pass 2: ordering / pairing
+    inflight: Dict[Tuple, int] = {}
+    barrier_sems: set = set()
+    signals_before: Dict[int, int] = {}
+    barrier_passed: set = set()
+    remote_started = False
+    loop_stack: List[Dict[Tuple, int]] = []
+
+    for ev in events:
+        kind = ev[0]
+        if kind == "barrier_def":
+            barrier_sems.add(ev[1])
+        elif kind == _BSIG:
+            _k, sem, inc, axes = ev
+            for ax in axes:
+                if axis_names and ax not in axis_names:
+                    err(f"barrier signal targets unknown mesh axis "
+                        f"'{ax}' (mesh axes: {sorted(axis_names)})")
+            if sem in barrier_sems:
+                signals_before[sem] = (signals_before.get(sem, 0)
+                                       + (inc if inc is not None else 0))
+        elif kind == _BWAIT:
+            _k, sem, value = ev
+            if sem in barrier_sems:
+                sent = signals_before.get(sem, 0)
+                if value is not None and sent != value:
+                    err(f"barrier wait value {value} != {sent} signals "
+                        f"issued — the rendezvous can deadlock or pass "
+                        f"early")
+                barrier_passed.add(sem)
+        elif kind == _START:
+            _k, keys, remote, axes = ev
+            if not remote:
+                continue
+            for ax in axes:
+                if axis_names and ax not in axis_names:
+                    err(f"remote DMA targets unknown mesh axis '{ax}' "
+                        f"(mesh axes: {sorted(axis_names)})")
+            if not remote_started:
+                remote_started = True
+                if not barrier_passed:
+                    err("remote DMA started before any neighbor "
+                        "barrier wait — destination buffers are not "
+                        "known quiescent (unordered remote write)")
+            if not keys:
+                err("remote DMA start without identifiable "
+                    "send/recv semaphores", WARNING)
+            for key in keys:
+                if any(i == "?" for i in key[1]):
+                    err(f"remote DMA semaphore {_fmt_key(key)} has a "
+                        f"dynamic index; discipline not statically "
+                        f"checkable", WARNING)
+                    continue
+                if inflight.get(key, 0) > 0:
+                    err(f"semaphore {_fmt_key(key)} re-armed while its "
+                        f"previous DMA is still in flight")
+                inflight[key] = inflight.get(key, 0) + 1
+        elif kind == _WAIT:
+            key = ev[1]
+            if key not in tracked or any(i == "?" for i in key[1]):
+                continue
+            if inflight.get(key, 0) <= 0:
+                err(f"dma_wait on {_fmt_key(key)} with no DMA in "
+                    f"flight")
+            else:
+                inflight[key] -= 1
+        elif kind == _LOOP_BEGIN:
+            loop_stack.append(dict(inflight))
+        elif kind == _LOOP_END:
+            before = loop_stack.pop() if loop_stack else {}
+            if {k: v for k, v in inflight.items() if v} != \
+                    {k: v for k, v in before.items() if v}:
+                err("remote DMA in-flight state changes across a loop "
+                    "body — start/wait pairing cannot be proven "
+                    "(possible cross-iteration semaphore reuse)")
+                inflight = dict(before)
+
+    for key, n in sorted(inflight.items()):
+        if n > 0:
+            err(f"remote DMA on {_fmt_key(key)} started but never "
+                f"awaited ({n} outstanding at kernel end)")
+    return findings, saw_remote
+
+
+def check_pallas_kernels(target: PallasKernelTarget) -> List[Finding]:
+    """Verify DMA/semaphore discipline of every kernel the target
+    traces to."""
+    try:
+        spec = target.build()
+    except Exception as e:  # noqa: BLE001
+        return [Finding("dma", target.name,
+                        f"target build failed: {type(e).__name__}: {e}")]
+    try:
+        closed = trace(spec.fn, *spec.args)
+    except Exception as e:  # noqa: BLE001
+        return [Finding("dma", target.name,
+                        f"trace failed: {type(e).__name__}: {e}")]
+    kernels = find_pallas_kernels(closed.jaxpr)
+    if not kernels:
+        return [Finding("dma", target.name,
+                        "no pallas_call found in the traced program",
+                        WARNING)]
+    findings: List[Finding] = []
+    any_remote = False
+    for kname, kjaxpr in kernels:
+        events: List[Tuple] = []
+        notes: List[str] = []
+        _collect_events(kjaxpr, events, notes)
+        for n in sorted(set(notes)):
+            findings.append(Finding("dma", f"{target.name}:{kname}", n,
+                                    WARNING))
+        fs, saw_remote = _simulate(f"{target.name}:{kname}", events,
+                                   tuple(spec.axis_names))
+        # namespace the kernel into the target for the report
+        findings.extend(Finding("dma", f.target, f.message, f.severity)
+                        for f in fs)
+        any_remote = any_remote or saw_remote
+    if spec.expect_remote_dma and not any_remote:
+        findings.append(Finding(
+            "dma", target.name,
+            "expected remote DMA but none traced — the checker would "
+            "be vacuous here (did the kernel's transport change?)",
+            WARNING))
+    return findings

@@ -1,0 +1,244 @@
+"""Checker 1: static access footprint vs. declared ``geometry.Radius``.
+
+A stencil op computes interior values from a halo-padded (z,y,x) shard;
+the exchange plan ships exactly the halo the *declared* radius claims.
+If the op's true footprint reaches deeper than the declaration in ANY
+of the 26 directions, the exchange under-delivers and the kernel
+silently reads stale halo cells — the bug class TEMPI-style static
+layout validation moves from "flaky numerics on hardware" to "red CI".
+
+Method: trace the op to a jaxpr and collect every ``lax.slice`` whose
+operand is (an alias of) a padded input. For a slice with per-axis
+start/limit, the *reach* past the interior on side ``s`` of axis ``a``
+is how far the access extends into the halo there. One access can
+reach on several axes at once (cross-derivative pencils): for each
+direction ``d`` the access penetrates the direction-``d`` halo region
+to depth ``min over axes a with d_a != 0 of reach(a, d_a)``, and the
+declared per-direction radius must cover the max over all accesses:
+
+    radius.dir(d)  >=  max_access  min_{a: d_a != 0}  reach(a, d_a)
+
+For face directions this reduces to the per-axis max reach; for
+edge/corner directions it is exactly the reference's "edge radius
+gates whether diagonal-neighbor data is required" rule
+(src/stencil.cu:344): an access touching the (1,1,0) region at depth 3
+demands edge radius >= 3 even when both face radii already equal 3.
+
+Asymmetric radii are handled per side; allocation padding (``pad_lo`` /
+``pad_hi``) may be declared independently of the radius for targets
+whose buffers are sized by other layers — reaches are measured against
+the interior box, radii are judged against the declaration.
+
+Aliasing: the footprint follows the padded inputs through dtype casts
+and elementwise ops (positions preserved — a slice of ``padded * c``
+reads the same cells as a slice of ``padded``). Out of scope (reported
+as warnings, never silently passed): dynamic slices of a padded input
+(traced offsets), padded data flowing into ``scan``/``while`` bodies,
+and any other primitive consuming the padded array
+(position-scrambling ops like ``concatenate``/``roll``/``transpose``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..geometry import Dim3, Radius, all_directions
+from .jaxprs import ClosedJaxpr, Jaxpr, Var, trace
+from .report import ERROR, WARNING, Finding
+
+# grid axis (0=x, 1=y, 2=z) -> array dim of a (z,y,x) block
+_AXIS_TO_DIM = {0: 2, 1: 1, 2: 0}
+
+# primitives that forward their (single) operand unchanged for
+# footprint purposes
+_PASSTHROUGH = ("convert_element_type", "copy", "stop_gradient")
+
+# elementwise primitives preserve index positions: a slice of
+# ``padded * c`` reads exactly the cells a slice of ``padded`` would,
+# so the alias (and the footprint) propagates through them — provided
+# the output shape matches the aliased operand (no broadcasting of
+# the padded array itself)
+_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "rem", "max", "min", "pow",
+    "integer_pow", "neg", "sign", "abs", "exp", "log", "expm1",
+    "log1p", "sqrt", "rsqrt", "cbrt", "square", "sin", "cos", "tan",
+    "tanh", "logistic", "atan2", "select_n", "and", "or", "xor",
+    "not", "eq", "ne", "lt", "le", "gt", "ge", "is_finite",
+    "clamp", "nextafter",
+})
+
+
+@dataclasses.dataclass
+class StencilOpSpec:
+    """One traceable stencil op plus its declared halo contract.
+
+    ``fn(*args)`` is traced abstractly; ``padded_argnums`` selects the
+    positional args that are halo-padded (z,y,x) inputs. ``interior``
+    is the interior extent (x,y,z); ``pad_lo``/``pad_hi`` default to
+    the radius' allocation pads (``Radius.pad_lo/pad_hi``) and may be
+    overridden when the buffer is padded beyond the declaration.
+    """
+
+    fn: Callable
+    args: Sequence[Any]
+    radius: Radius
+    interior: Dim3
+    padded_argnums: Tuple[int, ...] = (0,)
+    pad_lo: Optional[Dim3] = None
+    pad_hi: Optional[Dim3] = None
+
+    def resolved_pads(self) -> Tuple[Dim3, Dim3]:
+        lo = self.pad_lo if self.pad_lo is not None else self.radius.pad_lo()
+        hi = self.pad_hi if self.pad_hi is not None else self.radius.pad_hi()
+        return lo, hi
+
+
+@dataclasses.dataclass
+class StencilOpTarget:
+    """Registry entry: a named, lazily-built :class:`StencilOpSpec`."""
+
+    name: str
+    build: Callable[[], StencilOpSpec]
+
+    checker = "footprint"
+
+
+# one access = per-(axis, side) halo reach depths
+_Reach = Dict[Tuple[int, int], int]
+
+
+def _slice_reach(start: Sequence[int], limit: Sequence[int],
+                 pad_lo: Dim3, interior: Dim3) -> _Reach:
+    reach: _Reach = {}
+    for a in range(3):
+        d = _AXIS_TO_DIM[a]
+        lo = max(0, pad_lo[a] - int(start[d]))
+        hi = max(0, int(limit[d]) - (pad_lo[a] + interior[a]))
+        reach[(a, -1)] = lo
+        reach[(a, 1)] = hi
+    return reach
+
+
+def _collect_accesses(jaxpr: Jaxpr, roots: set,
+                      pad_lo: Dim3, interior: Dim3,
+                      accesses: List[_Reach],
+                      issues: List[str]) -> None:
+    """Walk one jaxpr scope: record slice reaches on root-aliased vars,
+    follow pass-through ops, recurse into call-like sub-jaxprs with the
+    alias set translated, and note unverifiable flows."""
+    alias = set(roots)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        in_alias = [v for v in eqn.invars
+                    if isinstance(v, Var) and v in alias]
+        if name == "slice" and eqn.invars[0] in alias:
+            accesses.append(_slice_reach(eqn.params["start_indices"],
+                                         eqn.params["limit_indices"],
+                                         pad_lo, interior))
+            continue
+        if name in _PASSTHROUGH and in_alias:
+            for ov in eqn.outvars:
+                alias.add(ov)
+            continue
+        if name in _ELEMENTWISE and in_alias:
+            # positions preserved: propagate the alias when no
+            # broadcasting reshapes the aliased operand
+            shapes = {getattr(v.aval, "shape", None) for v in in_alias}
+            for ov in eqn.outvars:
+                if getattr(ov.aval, "shape", None) in shapes:
+                    alias.add(ov)
+            continue
+        if name in ("dynamic_slice", "gather") and eqn.invars[0] in alias:
+            issues.append(f"{name} of a padded input has traced offsets; "
+                          f"footprint not statically checkable")
+            continue
+        if name in ("scan", "while") and in_alias:
+            issues.append(f"padded input flows into a {name} loop; "
+                          f"footprint not statically checkable")
+            continue
+        # call-like eqns: map operands to sub-jaxpr invars and recurse
+        sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+        if sub is not None and in_alias:
+            sj = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+            if isinstance(sj, Jaxpr):
+                operands = eqn.invars[len(eqn.invars) - len(sj.invars):]
+                sub_roots = {iv for iv, ov in zip(sj.invars, operands)
+                             if isinstance(ov, Var) and ov in alias}
+                _collect_accesses(sj, sub_roots, pad_lo, interior,
+                                  accesses, issues)
+            continue
+        if name == "cond" and in_alias:
+            branches = eqn.params.get("branches", ())
+            operands = eqn.invars[1:]
+            for br in branches:
+                bj = br.jaxpr if isinstance(br, ClosedJaxpr) else br
+                sub_roots = {iv for iv, ov in zip(bj.invars, operands)
+                             if isinstance(ov, Var) and ov in alias}
+                _collect_accesses(bj, sub_roots, pad_lo, interior,
+                                  accesses, issues)
+            continue
+        if in_alias:
+            # anything else consuming the (aliased) padded array hides
+            # accesses from the checker — surface it rather than pass
+            # silently (position-scrambling ops like concatenate /
+            # roll / transpose land here by design)
+            issues.append(f"padded input consumed by unanalyzed "
+                          f"primitive '{name}'; accesses through its "
+                          f"result are not tracked")
+
+
+def required_radius(accesses: Sequence[_Reach]) -> Dict[Tuple[int, int, int], int]:
+    """Per-direction minimum radius implied by the access set."""
+    req: Dict[Tuple[int, int, int], int] = {}
+    for d in all_directions():
+        axes = [(a, d[a]) for a in range(3) if d[a] != 0]
+        best = 0
+        for reach in accesses:
+            depth = min(reach[k] for k in axes)
+            best = max(best, depth)
+        req[tuple(d)] = best
+    return req
+
+
+def check_stencil_op(target: StencilOpTarget) -> List[Finding]:
+    """Prove (or refute) that the target's declared Radius covers its
+    static access footprint in all 26 directions."""
+    try:
+        spec = target.build()
+    except Exception as e:  # noqa: BLE001 - any build error is a finding
+        return [Finding("footprint", target.name,
+                        f"target build failed: {type(e).__name__}: {e}")]
+    pad_lo, _pad_hi = spec.resolved_pads()
+    try:
+        closed = trace(spec.fn, *spec.args)
+    except Exception as e:  # noqa: BLE001 - OOB slices land here
+        return [Finding("footprint", target.name,
+                        f"trace failed (op reads outside its padded "
+                        f"allocation?): {type(e).__name__}: {e}")]
+    jaxpr = closed.jaxpr
+    roots = {jaxpr.invars[i] for i in spec.padded_argnums}
+    accesses: List[_Reach] = []
+    issues: List[str] = []
+    _collect_accesses(jaxpr, roots, pad_lo, spec.interior, accesses, issues)
+
+    findings = [Finding("footprint", target.name, msg, WARNING)
+                for msg in sorted(set(issues))]
+    if not accesses:
+        if not issues:
+            findings.append(Finding(
+                "footprint", target.name,
+                "no static slice accesses of the padded input found; "
+                "nothing to verify", WARNING))
+        return findings
+
+    req = required_radius(accesses)
+    for d, need in sorted(req.items()):
+        have = spec.radius.dir(d)
+        if have < need:
+            findings.append(Finding(
+                "footprint", target.name,
+                f"direction {d}: declared radius {have} < required "
+                f"{need} — the exchange plan under-delivers halo data "
+                f"the op reads", ERROR))
+    return findings

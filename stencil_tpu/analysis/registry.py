@@ -1,0 +1,405 @@
+"""The shipped-code target registry for stencil-lint.
+
+Every stencil op, Pallas DMA kernel, and collective exchange path the
+framework ships is registered here with its declared contract; the
+checkers in this package prove the contracts against the traced IR.
+Negative-control fixtures under ``tests/fixtures/lint/`` define the
+same target types with deliberately broken kernels (loaded via
+:func:`load_targets`) — each checker must flag them, proving the pass
+is not vacuously green.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from pathlib import Path
+from typing import List, Union
+
+from .collectives import CollectiveSpec, CollectiveTarget
+from .dma import PallasKernelSpec, PallasKernelTarget
+from .footprint import StencilOpSpec, StencilOpTarget
+
+Target = Union[StencilOpTarget, PallasKernelTarget, CollectiveTarget]
+
+
+def _f32(shape):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _mesh(shape):
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    n = shape[0] * shape[1] * shape[2]
+    return make_mesh(shape, jax.devices()[:n])
+
+
+# ---------------------------------------------------------------------------
+# footprint targets: registered stencil ops vs. their declared Radius
+
+
+def _jacobi7_spec() -> StencilOpSpec:
+    from ..geometry import Dim3, Radius
+    from ..ops.stencil_kernels import jacobi7
+
+    radius = Radius.constant(1)
+    interior = Dim3(8, 8, 8)
+    shape = tuple(interior[2 - i] + radius.pad_lo()[2 - i]
+                  + radius.pad_hi()[2 - i] for i in range(3))
+    return StencilOpSpec(fn=lambda p: jacobi7(p, radius, interior),
+                         args=(_f32(shape),), radius=radius,
+                         interior=interior)
+
+
+def _laplacian27_spec() -> StencilOpSpec:
+    from ..geometry import Dim3, Radius
+    from ..ops.stencil_kernels import laplacian27
+
+    radius = Radius.constant(1)
+    interior = Dim3(8, 8, 8)
+    return StencilOpSpec(fn=lambda p: laplacian27(p, radius, interior),
+                         args=(_f32((10, 10, 10)),), radius=radius,
+                         interior=interior)
+
+
+def _fd6_spec(kind: str, axes) -> StencilOpSpec:
+    from ..geometry import Dim3, Radius
+    from ..ops import fd6
+
+    radius = Radius.constant(fd6.RADIUS)
+    interior = Dim3(8, 8, 8)
+    pad_lo = radius.pad_lo()
+    shape = (14, 14, 14)  # 8 + 2 * RADIUS per dim
+
+    if kind == "der1":
+        fn = lambda p: fd6.der1(p, axes, 1.0, pad_lo, interior)  # noqa: E731
+    elif kind == "der2":
+        fn = lambda p: fd6.der2(p, axes, 1.0, pad_lo, interior)  # noqa: E731
+    else:
+        a, b = axes
+        fn = lambda p: fd6.der_cross(p, a, b, 1.0, 1.0, pad_lo,  # noqa: E731
+                                     interior)
+    return StencilOpSpec(fn=fn, args=(_f32(shape),), radius=radius,
+                         interior=interior)
+
+
+def _mhd_rates_spec() -> StencilOpSpec:
+    from ..geometry import Dim3, Radius
+    from ..models.astaroth import FIELDS, MhdParams, mhd_rates
+    from ..ops.fd6 import RADIUS, FieldData
+
+    import jax.numpy as jnp
+
+    radius = Radius.constant(RADIUS)
+    interior = Dim3(8, 8, 8)
+    pad_lo = radius.pad_lo()
+    prm = MhdParams()
+    inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
+
+    def fn(*padded):
+        data = {q: FieldData(p, inv_ds, pad_lo, interior)
+                for q, p in zip(FIELDS, padded)}
+        rates = mhd_rates(data, prm, jnp.float32)
+        return tuple(rates[q] for q in FIELDS)
+
+    nf = len(FIELDS)
+    return StencilOpSpec(fn=fn, args=tuple(_f32((14, 14, 14))
+                                           for _ in range(nf)),
+                         radius=radius, interior=interior,
+                         padded_argnums=tuple(range(nf)))
+
+
+# ---------------------------------------------------------------------------
+# DMA-discipline targets: every Pallas kernel issuing (remote) DMA
+
+
+def _rdma_exchange_spec() -> PallasKernelSpec:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Radius
+    from ..parallel.mesh import mesh_dim
+    from ..parallel.pallas_exchange import exchange_shard_pallas
+
+    mesh = _mesh((2, 2, 2))
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+
+    def shard(p):
+        return exchange_shard_pallas(p, radius, counts, interpret=False)
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    return PallasKernelSpec(fn=sm, args=(_f32((16, 16, 16)),),
+                            axis_names=("x", "y", "z"),
+                            expect_remote_dma=True)
+
+
+def _jacobi_overlap_spec() -> PallasKernelSpec:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3
+    from ..ops.pallas_overlap import jacobi7_overlap_pallas
+
+    mesh = _mesh((1, 2, 2))
+    counts = Dim3(1, 2, 2)
+
+    def shard(q):
+        iz = jax.lax.axis_index("z")
+        iy = jax.lax.axis_index("y")
+        org = jnp.stack([iz * 8, iy * 8, jnp.int32(0)]).astype(jnp.int32)
+        return jacobi7_overlap_pallas(q, org, (2, 4, 4), (5, 4, 4), 1,
+                                      counts, block_z=4, interpret=False)
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    return PallasKernelSpec(fn=sm, args=(_f32((16, 16, 8)),),
+                            axis_names=("x", "y", "z"),
+                            expect_remote_dma=True)
+
+
+def _mhd_overlap_spec(pair: bool) -> PallasKernelSpec:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3
+    from ..models.astaroth import FIELDS, MhdParams
+    from ..ops.pallas_mhd_overlap import mhd_substep_overlap
+
+    mesh = _mesh((1, 2, 2))
+    counts = Dim3(1, 2, 2)
+    prm = MhdParams()
+
+    def shard(fields, w):
+        f, wk = mhd_substep_overlap(fields, None if pair else w, 0, prm,
+                                    prm.dt, counts, pair=pair,
+                                    interpret=False)
+        return f, (wk if wk is not None else f)
+
+    spec = P("z", "y", "x")
+    fspec = {q: spec for q in FIELDS}
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(fspec, fspec),
+                       out_specs=(fspec, fspec), check_vma=False)
+    fields = {q: _f32((16, 16, 8)) for q in FIELDS}
+    w = {q: _f32((16, 16, 8)) for q in FIELDS}
+    return PallasKernelSpec(fn=sm, args=(fields, w),
+                            axis_names=("x", "y", "z"),
+                            expect_remote_dma=True)
+
+
+def _jacobi_halo_kernel_spec() -> PallasKernelSpec:
+    """The fused halo kernel: no DMA at all — the checker proves its
+    discipline vacuously and (more importantly) that it never gained a
+    stray semaphore/DMA without review."""
+    import jax.numpy as jnp
+
+    from ..ops.pallas_halo import jacobi7_halo_pallas
+
+    Z = Y = X = 8
+    slabs = {"zlo": _f32((1, Y, X)), "zhi": _f32((1, Y, X)),
+             "ylo": _f32((Z, 8, X)), "yhi": _f32((Z, 8, X))}
+
+    def fn(interior, zlo, zhi, ylo, yhi, org):
+        return jacobi7_halo_pallas(
+            interior, {"zlo": zlo, "zhi": zhi, "ylo": ylo, "yhi": yhi},
+            org, (2, 4, 4), (5, 4, 4), 1, interpret=False)
+
+    import jax
+    org = jax.ShapeDtypeStruct((3,), jnp.int32)
+    return PallasKernelSpec(
+        fn=fn, args=(_f32((Z, Y, X)), slabs["zlo"], slabs["zhi"],
+                     slabs["ylo"], slabs["yhi"], org),
+        axis_names=(), expect_remote_dma=False)
+
+
+# ---------------------------------------------------------------------------
+# collective targets: ppermute bijections + axis-name hygiene
+
+
+def _exchange_spec(radius_kind: str) -> CollectiveSpec:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Radius
+    from ..parallel.exchange import exchange_shard
+    from ..parallel.mesh import mesh_dim
+
+    mesh = _mesh((2, 2, 2))
+    counts = mesh_dim(mesh)
+    if radius_kind == "r1":
+        radius = Radius.constant(1)
+    elif radius_kind == "r3":
+        radius = Radius.constant(3)
+    else:  # asymmetric, zero on some sides
+        radius = Radius.constant(0)
+        radius.set_dir((1, 0, 0), 2)
+        radius.set_dir((-1, 0, 0), 1)
+        radius.set_dir((0, 1, 0), 1)
+
+    def shard(p):
+        return exchange_shard(p, radius, counts)
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    return CollectiveSpec(fn=sm, args=(_f32((28, 28, 28)),),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _exchange_packed_uneven_spec() -> CollectiveSpec:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3, Radius
+    from ..parallel.exchange import exchange_shard_packed
+    from ..parallel.mesh import mesh_dim
+
+    mesh = _mesh((2, 2, 2))
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+    rem = Dim3(1, 1, 1)
+
+    def shard(fields):
+        return exchange_shard_packed(fields, radius, counts, rem=rem)
+
+    spec = {"a": P("z", "y", "x"), "b": P("z", "y", "x")}
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(spec,),
+                       out_specs=spec, check_vma=False)
+    fields = {"a": _f32((20, 20, 20)),
+              "b": jax.ShapeDtypeStruct((20, 20, 20), jnp.bfloat16)}
+    return CollectiveSpec(fn=sm, args=(fields,),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _exchange_allgather_spec() -> CollectiveSpec:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Radius
+    from ..parallel.exchange import exchange_shard_allgather
+    from ..parallel.mesh import mesh_dim
+
+    mesh = _mesh((2, 2, 2))
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+
+    def shard(p):
+        return exchange_shard_allgather(p, radius, counts)
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    return CollectiveSpec(fn=sm, args=(_f32((16, 16, 16)),),
+                          axis_sizes=dict(mesh.shape))
+
+
+def _interior_slabs_spec(yzext: bool) -> CollectiveSpec:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3
+    from ..parallel.exchange import exchange_interior_slabs
+
+    mesh = _mesh((1, 2, 2))
+    counts = Dim3(1, 2, 2)
+
+    def shard(p):
+        s = exchange_interior_slabs(p, counts, rz=8, ry=8, radius_rows=3,
+                                    y_z_extended=yzext)
+        return (s["zlo"], s["zhi"], s["ylo"], s["yhi"])
+
+    spec = P("z", "y", "x")
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=spec,
+                       out_specs=(spec,) * 4, check_vma=False)
+    return CollectiveSpec(fn=sm, args=(_f32((16, 16, 8)),),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _make_exchange_jit_spec() -> CollectiveSpec:
+    from ..geometry import Radius
+    from ..parallel.exchange import make_exchange
+    from ..parallel.methods import Method
+
+    mesh = _mesh((2, 2, 2))
+    radius = Radius.constant(1)
+    ex = make_exchange(mesh, radius, Method.PpermutePacked)
+    return CollectiveSpec(fn=ex, args=({"q": _f32((20, 20, 20))},),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+# ---------------------------------------------------------------------------
+
+
+def default_targets() -> List[Target]:
+    """Every shipped contract stencil-lint proves on each run."""
+    targets: List[Target] = [
+        StencilOpTarget("ops.stencil_kernels.jacobi7", _jacobi7_spec),
+        StencilOpTarget("ops.stencil_kernels.laplacian27",
+                        _laplacian27_spec),
+        StencilOpTarget("models.astaroth.mhd_rates", _mhd_rates_spec),
+    ]
+    for axis, ax_name in enumerate("xyz"):
+        targets.append(StencilOpTarget(
+            f"ops.fd6.der1[{ax_name}]",
+            lambda a=axis: _fd6_spec("der1", a)))
+        targets.append(StencilOpTarget(
+            f"ops.fd6.der2[{ax_name}]",
+            lambda a=axis: _fd6_spec("der2", a)))
+    for a, b in ((0, 1), (0, 2), (1, 2)):
+        targets.append(StencilOpTarget(
+            f"ops.fd6.der_cross[{'xyz'[a]}{'xyz'[b]}]",
+            lambda p=(a, b): _fd6_spec("cross", p)))
+    targets += [
+        PallasKernelTarget("parallel.pallas_exchange.exchange_shard_pallas",
+                           _rdma_exchange_spec),
+        PallasKernelTarget("ops.pallas_overlap.jacobi7_overlap_pallas",
+                           _jacobi_overlap_spec),
+        PallasKernelTarget("ops.pallas_mhd_overlap.mhd_substep_overlap",
+                           lambda: _mhd_overlap_spec(pair=False)),
+        PallasKernelTarget("ops.pallas_mhd_overlap.mhd_substep_overlap[pair]",
+                           lambda: _mhd_overlap_spec(pair=True)),
+        PallasKernelTarget("ops.pallas_halo.jacobi7_halo_pallas",
+                           _jacobi_halo_kernel_spec),
+        CollectiveTarget("parallel.exchange.exchange_shard[r1]",
+                         lambda: _exchange_spec("r1")),
+        CollectiveTarget("parallel.exchange.exchange_shard[r3]",
+                         lambda: _exchange_spec("r3")),
+        CollectiveTarget("parallel.exchange.exchange_shard[asym]",
+                         lambda: _exchange_spec("asym")),
+        CollectiveTarget("parallel.exchange.exchange_shard_packed[uneven]",
+                         _exchange_packed_uneven_spec),
+        CollectiveTarget("parallel.exchange.exchange_shard_allgather",
+                         _exchange_allgather_spec),
+        CollectiveTarget("parallel.exchange.exchange_interior_slabs[yzext]",
+                         lambda: _interior_slabs_spec(True)),
+        CollectiveTarget("parallel.exchange.exchange_interior_slabs",
+                         lambda: _interior_slabs_spec(False)),
+        CollectiveTarget("parallel.exchange.make_exchange[jit,packed]",
+                         _make_exchange_jit_spec),
+    ]
+    return targets
+
+
+def load_targets(path: Union[str, Path]) -> List[Target]:
+    """Load a fixture module (a .py file defining ``TARGETS``) and
+    return its targets — the negative-control entry point."""
+    path = Path(path)
+    spec = importlib.util.spec_from_file_location(
+        f"stencil_lint_fixture_{path.stem}", path)
+    if spec is None or spec.loader is None:
+        raise ImportError(f"cannot load fixture module {path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    targets = getattr(mod, "TARGETS", None)
+    if not targets:
+        raise ValueError(f"fixture {path} defines no TARGETS")
+    return list(targets)

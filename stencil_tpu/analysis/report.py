@@ -1,0 +1,88 @@
+"""Finding and report types for stencil-lint.
+
+A checker emits :class:`Finding`s; a :class:`Report` aggregates them
+across targets and serializes to the ``--json`` CI artifact. Severity
+``error`` fails the run (nonzero exit); ``warning`` marks constructs
+the checkers cannot statically verify (dynamic semaphore indices, data
+flowing into loops) without claiming a bug.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Sequence
+
+SCHEMA_VERSION = 1
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violated (or unverifiable) invariant.
+
+    ``checker``  -- "footprint" | "dma" | "collectives"
+    ``target``   -- registry name of the checked entity
+    ``message``  -- human-readable description of the violation
+    ``severity`` -- ERROR (fails CI) or WARNING (reported only)
+    """
+
+    checker: str
+    target: str
+    message: str
+    severity: str = ERROR
+
+    def to_dict(self) -> Dict[str, str]:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"[{self.checker}] {self.target}: {self.message}"
+
+
+@dataclasses.dataclass
+class Report:
+    """All findings of one stencil-lint run plus run metadata."""
+
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    targets_checked: List[str] = dataclasses.field(default_factory=list)
+
+    def extend(self, findings: Sequence[Finding]) -> None:
+        self.findings.extend(findings)
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == WARNING]
+
+    @property
+    def ok(self) -> bool:
+        """True when no *error*-severity findings exist."""
+        return not self.errors
+
+    def to_dict(self) -> Dict:
+        import jax
+
+        by_checker: Dict[str, int] = {}
+        for f in self.errors:
+            by_checker[f.checker] = by_checker.get(f.checker, 0) + 1
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "tool": "stencil-lint",
+            "jax_version": jax.__version__,
+            "targets_checked": list(self.targets_checked),
+            "counts": {
+                "targets": len(self.targets_checked),
+                "errors": len(self.errors),
+                "warnings": len(self.warnings),
+                "errors_by_checker": by_checker,
+            },
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)

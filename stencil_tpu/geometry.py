@@ -20,28 +20,57 @@ Conventions
 
 from __future__ import annotations
 
+import operator
 from typing import Dict, Iterator, List, NamedTuple, Tuple, Union
 
 Dim3Like = Union["Dim3", Tuple[int, int, int]]
 
 
-class Dim3(NamedTuple):
+def _as_component(name: str, v) -> int:
+    """Validate one Dim3/Radius component: exact integers only. A
+    float slipping in (e.g. ``gsize.x / 2`` instead of ``// 2``) used
+    to truncate silently and flow into slab-width math; now it is a
+    loud ``ValueError`` at construction."""
+    try:
+        return operator.index(v)
+    except TypeError:
+        raise ValueError(
+            f"Dim3/Radius component {name}={v!r} is not an integer "
+            f"(got {type(v).__name__}; use // for integer division)"
+        ) from None
+
+
+class _Dim3Base(NamedTuple):
+    x: int
+    y: int
+    z: int
+
+
+class Dim3(_Dim3Base):
     """Immutable int64 3-vector (reference: include/stencil/dim3.hpp).
+
+    Components must be exact integers (validated at construction);
+    negative values are legal — direction vectors and differences need
+    them. Non-negativity of *sizes* is the caller's contract; radii are
+    validated in :class:`Radius`.
 
     Note: the reference's ``operator!=``/``max`` have latent bugs
     (dim3.hpp:195, 57-63); this class implements the intended semantics.
     """
 
-    x: int
-    y: int
-    z: int
+    __slots__ = ()
+
+    def __new__(cls, x: int, y: int, z: int) -> "Dim3":
+        return super().__new__(cls, _as_component("x", x),
+                               _as_component("y", y),
+                               _as_component("z", z))
 
     # -- constructors -------------------------------------------------
     @staticmethod
     def of(v: Dim3Like) -> "Dim3":
         if isinstance(v, Dim3):
             return v
-        return Dim3(int(v[0]), int(v[1]), int(v[2]))
+        return Dim3(v[0], v[1], v[2])
 
     @staticmethod
     def filled(v: int) -> "Dim3":
@@ -214,13 +243,23 @@ class Radius:
     def __init__(self) -> None:
         self._m = DirectionMap(0)
 
+    @staticmethod
+    def _value(v) -> int:
+        """Radii are non-negative exact integers: a negative (or
+        truncated-float) radius would flow silently into allocation
+        pads and slab widths — reject it loudly at the constructor."""
+        r = _as_component("radius", v)
+        if r < 0:
+            raise ValueError(f"radius must be >= 0, got {r}")
+        return r
+
     # -- indexing -----------------------------------------------------
     def dir(self, d: Dim3Like) -> int:
         return self._m[Dim3.of(d)]
 
     def set_dir(self, d: Dim3Like, v: int) -> None:
         d = Dim3.of(d)
-        self._m[d] = int(v)
+        self._m[d] = self._value(v)
 
     def x(self, d: int) -> int:
         """Face radius along x on side ``d`` in {-1, 0, 1}."""
@@ -243,26 +282,30 @@ class Radius:
 
     # -- setters ------------------------------------------------------
     def set_face(self, r: int) -> None:
+        r = self._value(r)
         for d in all_directions():
             if direction_kind(d) == "face":
-                self._m[d] = int(r)
+                self._m[d] = r
 
     def set_edge(self, r: int) -> None:
+        r = self._value(r)
         for d in all_directions():
             if direction_kind(d) == "edge":
-                self._m[d] = int(r)
+                self._m[d] = r
 
     def set_corner(self, r: int) -> None:
+        r = self._value(r)
         for d in all_directions():
             if direction_kind(d) == "corner":
-                self._m[d] = int(r)
+                self._m[d] = r
 
     # -- constructors -------------------------------------------------
     @staticmethod
     def constant(r: int) -> "Radius":
         out = Radius()
+        r = Radius._value(r)
         for d in all_directions(include_zero=True):
-            out._m[d] = int(r)
+            out._m[d] = r
         return out
 
     @staticmethod

@@ -20,12 +20,12 @@ convert at the array boundary.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
-from .geometry import Dim3, Dim3Like, Radius, Rect3, all_directions
+from .geometry import Dim3, Dim3Like, Radius, Rect3
 
 
 def zyx_shape(sz: Dim3Like) -> Tuple[int, int, int]:

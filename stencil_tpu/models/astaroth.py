@@ -38,8 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import math
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -49,7 +48,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..distributed import DistributedDomain
 from ..geometry import Dim3, Dim3Like, Radius
-from ..local_domain import raw_size, zyx_shape
+from ..local_domain import zyx_shape
 from ..ops.fd6 import RADIUS, FieldData
 from ..parallel.exchange import dispatch_exchange
 from ..parallel.mesh import mesh_dim

@@ -23,11 +23,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..distributed import DistributedDomain
 from ..geometry import Dim3, Dim3Like, Radius
-from ..local_domain import raw_size, zyx_shape
+from ..local_domain import zyx_shape
 from ..ops.stencil_kernels import global_coords, jacobi7, write_interior
 from ..parallel.exchange import dispatch_exchange
 from ..parallel.mesh import mesh_dim

@@ -49,7 +49,8 @@ def div_ceil(n: int, d: int) -> int:
 
 
 def next_align_of(x: int, align: int) -> int:
-    """Round ``x`` up to a multiple of ``align`` (reference: include/stencil/align.cuh:7-9)."""
+    """Round ``x`` up to a multiple of ``align``
+    (reference: include/stencil/align.cuh:7-9)."""
     return div_ceil(x, align) * align
 
 

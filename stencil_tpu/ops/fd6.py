@@ -24,7 +24,7 @@ from typing import Tuple
 import jax.numpy as jnp
 from jax import lax
 
-from ..geometry import Dim3, Radius
+from ..geometry import Dim3
 
 # 6th-order coefficient tables
 _D1 = (3.0 / 4.0, -3.0 / 20.0, 1.0 / 60.0)

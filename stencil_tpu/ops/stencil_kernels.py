@@ -50,7 +50,8 @@ def laplacian27(padded: jnp.ndarray, radius: Radius, interior: Dim3,
     lo = radius.pad_lo()
     if weights is None:
         # face 6/26? use canonical 27-pt laplacian weights
-        w_center, w_face, w_edge, w_corner = -88.0 / 26.0, 6.0 / 26.0, 3.0 / 26.0, 2.0 / 26.0
+        w_center, w_face = -88.0 / 26.0, 6.0 / 26.0
+        w_edge, w_corner = 3.0 / 26.0, 2.0 / 26.0
     else:
         w_center, w_face, w_edge, w_corner = weights
     out = w_center * shifted(padded, (0, 0, 0), lo, interior)

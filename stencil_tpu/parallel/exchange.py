@@ -31,8 +31,7 @@ PeerAccessSender, tx_cuda.cuh:41-113).
 
 from __future__ import annotations
 
-import functools
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import jax
 import numpy as np

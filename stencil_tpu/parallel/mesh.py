@@ -14,7 +14,7 @@ are (z,y,x)-ordered so a padded field's PartitionSpec is
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -71,9 +71,11 @@ def make_mesh(mesh_shape: Optional[Dim3Like] = None,
         mesh_shape = default_mesh_shape(n)
     shape = Dim3.of(mesh_shape)
     if shape.flatten() != n:
-        raise ValueError(f"mesh shape {shape} needs {shape.flatten()} devices, have {n}")
+        raise ValueError(f"mesh shape {shape} needs {shape.flatten()} "
+                         f"devices, have {n}")
     # device axis order (x fastest) matches _torus_sorted key order
-    arr = np.array(devices, dtype=object).reshape((shape.z, shape.y, shape.x)).transpose(2, 1, 0)
+    arr = np.array(devices, dtype=object).reshape(
+        (shape.z, shape.y, shape.x)).transpose(2, 1, 0)
     return Mesh(arr, AXIS_NAMES)
 
 

@@ -23,7 +23,6 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 import jax
-import numpy as np
 
 from ..geometry import Dim3, Dim3Like
 from .mesh import _torus_sorted, make_mesh

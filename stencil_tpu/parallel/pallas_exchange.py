@@ -27,7 +27,7 @@ analog of the reference exercising IPC transports on one node.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

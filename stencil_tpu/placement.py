@@ -18,7 +18,7 @@ distance matrix (torus hop count), then solves the quadratic assignment.
 from __future__ import annotations
 
 import enum
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 

@@ -13,7 +13,6 @@ compatibility on resume.
 
 from __future__ import annotations
 
-import json
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 

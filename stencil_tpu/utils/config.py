@@ -18,7 +18,19 @@ def apply_fake_cpu(n: int) -> None:
     if n:
         import jax
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n)
+        try:
+            jax.config.update("jax_num_cpu_devices", n)
+        except AttributeError:
+            # older JAX has no jax_num_cpu_devices: the host device
+            # count can only come from XLA_FLAGS (read at backend
+            # init, which this function predates by contract)
+            import os
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count"
+                    f"={n}").strip()
 
 
 def enable_compile_cache(path: str = "") -> None:

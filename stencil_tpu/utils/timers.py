@@ -11,7 +11,7 @@ device-to-host transfer instead — the only reliable fence.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict
 
 import jax
 import numpy as np

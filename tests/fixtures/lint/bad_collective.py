@@ -1,0 +1,55 @@
+"""Negative controls for the COLLECTIVES checker.
+
+Each target traces a ``lax.ppermute`` whose permutation violates the
+full-bijection contract — all of these trace cleanly (JAX defers
+validation to compile time, and un-sourced destinations silently keep
+zeros), which is precisely why the static pass exists.
+``python -m stencil_tpu.analysis tests/fixtures/lint/bad_collective.py``
+MUST exit nonzero.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from stencil_tpu.analysis import CollectiveSpec, CollectiveTarget
+from stencil_tpu.parallel.mesh import make_mesh
+
+
+def _spec(perm, axis="z") -> CollectiveSpec:
+    mesh = make_mesh((1, 1, 2), jax.devices()[:2])
+
+    def shard(x):
+        return lax.ppermute(x, axis, perm)
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", None, None),
+                       out_specs=P("z", None, None), check_vma=False)
+    return CollectiveSpec(
+        fn=sm, args=(jax.ShapeDtypeStruct((4, 4, 4), jnp.float32),),
+        axis_sizes=dict(mesh.shape), expect_ppermute=True)
+
+
+def _duplicate_dest() -> CollectiveSpec:
+    # both shards send to shard 1: shard 0's halo is never filled and
+    # shard 1 receives conflicting writes
+    return _spec([(0, 1), (1, 1)])
+
+
+def _out_of_range() -> CollectiveSpec:
+    # a 4-device ring permutation issued on a 2-device axis
+    return _spec([(i, (i + 1) % 4) for i in range(4)])
+
+
+def _partial_perm() -> CollectiveSpec:
+    # half the ring: shard 0 never receives — its halo keeps zeros
+    return _spec([(0, 1)])
+
+
+TARGETS = [
+    CollectiveTarget("fixture.ppermute_duplicate_destination",
+                     _duplicate_dest),
+    CollectiveTarget("fixture.ppermute_index_out_of_range",
+                     _out_of_range),
+    CollectiveTarget("fixture.ppermute_partial_ring", _partial_perm),
+]

@@ -1,0 +1,138 @@
+"""Negative controls for the DMA-DISCIPLINE checker.
+
+Each target is a Pallas kernel violating one remote-DMA invariant the
+shipped kernels uphold. ``python -m stencil_tpu.analysis
+tests/fixtures/lint/bad_dma.py`` MUST exit nonzero.
+
+These kernels are TRACED, never executed, so they lint identically on
+images without the distributed interpreter.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from stencil_tpu.analysis import PallasKernelSpec, PallasKernelTarget
+from stencil_tpu.parallel.mesh import make_mesh
+
+from jax.sharding import PartitionSpec as P
+
+
+def _mesh2():
+    return make_mesh((1, 1, 2), jax.devices()[:2])
+
+
+def _spec(kern, n_sems: int = 2) -> PallasKernelSpec:
+    def shard(p):
+        return pl.pallas_call(
+            kern,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_shape=jax.ShapeDtypeStruct(p.shape, p.dtype),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((n_sems,)),
+                            pltpu.SemaphoreType.DMA((n_sems,))],
+            compiler_params=pltpu.CompilerParams(
+                collective_id=13, has_side_effects=True),
+            interpret=False,
+        )(p)
+
+    mesh = _mesh2()
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    return PallasKernelSpec(
+        fn=sm, args=(jax.ShapeDtypeStruct((8, 8, 8), jnp.float32),),
+        axis_names=("x", "y", "z"), expect_remote_dma=True)
+
+
+def _other(n=2):
+    me = lax.axis_index("z")
+    return {"z": lax.rem(me + 1, jnp.int32(n))}
+
+
+def _missing_wait() -> PallasKernelSpec:
+    """Remote copy started, barrier correct, NEVER awaited: the kernel
+    can retire (and its buffers be reused) with the DMA in flight."""
+
+    def kern(in_ref, out_ref, send, recv):
+        bsem = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bsem, inc=1, device_id=_other())
+        pltpu.semaphore_wait(bsem, 1)
+        pltpu.make_async_remote_copy(
+            src_ref=in_ref.at[0:1], dst_ref=out_ref.at[0:1],
+            send_sem=send.at[0], recv_sem=recv.at[0],
+            device_id=_other()).start()
+        # BUG: no .wait()
+
+    return _spec(kern)
+
+
+def _missing_barrier() -> PallasKernelSpec:
+    """Remote write with start/wait paired but NO neighbor rendezvous:
+    the destination buffer is not known quiescent (unordered write —
+    the race the sanitizer's negative control exhibits dynamically)."""
+
+    def kern(in_ref, out_ref, send, recv):
+        rc = pltpu.make_async_remote_copy(
+            src_ref=in_ref.at[0:1], dst_ref=out_ref.at[0:1],
+            send_sem=send.at[0], recv_sem=recv.at[0],
+            device_id=_other())
+        rc.start()
+        rc.wait()
+
+    return _spec(kern)
+
+
+def _reused_in_flight() -> PallasKernelSpec:
+    """The same semaphore cells re-armed by a second remote copy while
+    the first is still in flight."""
+
+    def kern(in_ref, out_ref, send, recv):
+        bsem = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bsem, inc=1, device_id=_other())
+        pltpu.semaphore_wait(bsem, 1)
+
+        def copy(rows):
+            return pltpu.make_async_remote_copy(
+                src_ref=in_ref.at[rows], dst_ref=out_ref.at[rows],
+                send_sem=send.at[0], recv_sem=recv.at[0],
+                device_id=_other())
+
+        a = copy(slice(0, 1))
+        b = copy(slice(1, 2))   # BUG: same sems, first still flying
+        a.start()
+        b.start()
+        a.wait()
+        b.wait()
+
+    return _spec(kern)
+
+
+def _barrier_miscounted() -> PallasKernelSpec:
+    """Rendezvous waits for 2 signals but only 1 is sent: the barrier
+    can deadlock (or, reordered, pass before the neighbor arrived)."""
+
+    def kern(in_ref, out_ref, send, recv):
+        bsem = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bsem, inc=1, device_id=_other())
+        pltpu.semaphore_wait(bsem, 2)   # BUG: one signal, waits two
+        rc = pltpu.make_async_remote_copy(
+            src_ref=in_ref.at[0:1], dst_ref=out_ref.at[0:1],
+            send_sem=send.at[0], recv_sem=recv.at[0],
+            device_id=_other())
+        rc.start()
+        rc.wait()
+
+    return _spec(kern)
+
+
+TARGETS = [
+    PallasKernelTarget("fixture.remote_dma_missing_wait", _missing_wait),
+    PallasKernelTarget("fixture.remote_dma_missing_barrier",
+                       _missing_barrier),
+    PallasKernelTarget("fixture.semaphore_reused_in_flight",
+                       _reused_in_flight),
+    PallasKernelTarget("fixture.barrier_signal_wait_mismatch",
+                       _barrier_miscounted),
+]

@@ -1,0 +1,106 @@
+"""Negative controls for the FOOTPRINT checker.
+
+Each target is a deliberately broken stencil op whose true access
+footprint exceeds its declared ``Radius`` — the "kernel silently reads
+stale halo data" bug class. ``python -m stencil_tpu.analysis
+tests/fixtures/lint/bad_footprint.py`` MUST exit nonzero, and
+tests/test_lint.py asserts the specific findings.
+
+The allocations are padded BEYOND the declaration (``pad_lo``/
+``pad_hi`` overrides) so the broken reads trace cleanly — exactly the
+production shape of the bug, where the buffer comes from a wider
+allocator while the exchange plan ships only the declared radius.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from stencil_tpu.analysis import StencilOpSpec, StencilOpTarget
+from stencil_tpu.geometry import Dim3, Radius
+
+
+def _wide5_z_understated() -> StencilOpSpec:
+    """5-point z stencil reaching +-2, declared ``Radius.constant(1)``:
+    the exchange would fill one halo plane, the second plane is stale."""
+    interior = Dim3(8, 8, 8)
+    radius = Radius.constant(1)
+    pad = Dim3(2, 2, 2)
+
+    def fn(p):
+        c = lax.slice(p, (2, 2, 2), (10, 10, 10))
+        zm2 = lax.slice(p, (0, 2, 2), (8, 10, 10))
+        zp2 = lax.slice(p, (4, 2, 2), (12, 10, 10))
+        return (c + zm2 + zp2) * (1.0 / 3.0)
+
+    return StencilOpSpec(
+        fn=fn, args=(jax.ShapeDtypeStruct((12, 12, 12), jnp.float32),),
+        radius=radius, interior=interior, pad_lo=pad, pad_hi=pad)
+
+
+def _cross_zero_edge() -> StencilOpSpec:
+    """Cross-derivative-style diagonal access (+x, +y) with face radius
+    1 but edge radius 0: the per-axis slabs are delivered, the xy edge
+    exchange is skipped, the corner cell is stale."""
+    interior = Dim3(8, 8, 8)
+    radius = Radius.face_edge_corner(1, 0, 0)
+
+    def fn(p):
+        c = lax.slice(p, (1, 1, 1), (9, 9, 9))
+        diag = lax.slice(p, (1, 2, 2), (9, 10, 10))
+        return c - diag
+
+    return StencilOpSpec(
+        fn=fn, args=(jax.ShapeDtypeStruct((10, 10, 10), jnp.float32),),
+        radius=radius, interior=interior,
+        pad_lo=Dim3(1, 1, 1), pad_hi=Dim3(1, 1, 1))
+
+
+def _asymmetric_understated() -> StencilOpSpec:
+    """Uncentered op reading 2 deep on -x but declaring only 1 there
+    (asymmetric radii must be honored per side)."""
+    interior = Dim3(8, 8, 8)
+    radius = Radius.constant(0)
+    radius.set_dir((1, 0, 0), 1)
+    radius.set_dir((-1, 0, 0), 1)   # true reach is 2
+
+    def fn(p):
+        c = lax.slice(p, (0, 0, 2), (8, 8, 10))
+        xm2 = lax.slice(p, (0, 0, 0), (8, 8, 8))
+        return c + xm2
+
+    return StencilOpSpec(
+        fn=fn, args=(jax.ShapeDtypeStruct((8, 8, 12), jnp.float32),),
+        radius=radius, interior=interior,
+        pad_lo=Dim3(2, 0, 0), pad_hi=Dim3(2, 0, 0))
+
+
+def _laundered_through_mul() -> StencilOpSpec:
+    """The deep access happens on ``padded * 0.5``, not on the input
+    directly — the alias must propagate through elementwise ops or
+    this understated radius slips through."""
+    interior = Dim3(8, 8, 8)
+    radius = Radius.constant(1)
+    pad = Dim3(2, 2, 2)
+
+    def fn(p):
+        q = p * 0.5
+        c = lax.slice(q, (2, 2, 2), (10, 10, 10))
+        yp2 = lax.slice(q, (2, 4, 2), (10, 12, 10))
+        return c + yp2
+
+    return StencilOpSpec(
+        fn=fn, args=(jax.ShapeDtypeStruct((12, 12, 12), jnp.float32),),
+        radius=radius, interior=interior, pad_lo=pad, pad_hi=pad)
+
+
+TARGETS = [
+    StencilOpTarget("fixture.wide5_z_radius_understated",
+                    _wide5_z_understated),
+    StencilOpTarget("fixture.cross_with_zero_edge_radius",
+                    _cross_zero_edge),
+    StencilOpTarget("fixture.asymmetric_minus_x_understated",
+                    _asymmetric_understated),
+    StencilOpTarget("fixture.laundered_through_elementwise",
+                    _laundered_through_mul),
+]

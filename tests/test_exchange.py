@@ -15,9 +15,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from stencil_tpu._compat import remote_dma_runnable
 from stencil_tpu.geometry import Dim3, Radius
 from stencil_tpu.local_domain import raw_size, zyx_shape
-from stencil_tpu.parallel.exchange import (exchange_shard, make_exchange,
+from stencil_tpu.parallel.exchange import (make_exchange,
                                            exchanged_bytes_per_sweep)
 from stencil_tpu.parallel.mesh import make_mesh, mesh_dim
 from stencil_tpu.parallel.methods import Method
@@ -95,11 +96,21 @@ def mesh222():
     return make_mesh((2, 2, 2))
 
 
+# executing (not just tracing) explicit remote DMA needs a TPU or the
+# distributed mosaic interpreter; the static analysis pass (stencil-lint)
+# still checks these paths on every image
+needs_rdma = pytest.mark.skipif(
+    not remote_dma_runnable(),
+    reason="Pallas remote DMA needs a TPU backend or the distributed "
+           "(mosaic) TPU interpreter")
+
+
 class TestExchangeOracle:
     @pytest.mark.parametrize("method", [Method.PpermuteSlab,
                                         Method.PpermutePacked,
                                         Method.AllGather,
-                                        Method.PallasDMA])
+                                        pytest.param(Method.PallasDMA,
+                                                     marks=needs_rdma)])
     def test_radius1_2x2x2(self, mesh222, method):
         gsize = Dim3(8, 8, 8)
         radius = Radius.constant(1)
@@ -129,6 +140,7 @@ class TestExchangeOracle:
         # only face halos on padded sides exist; check full padded region
         check_halos(np.asarray(out), gsize, mesh222, radius)
 
+    @needs_rdma
     def test_pallas_dma_radius2(self, mesh222):
         gsize = Dim3(8, 8, 8)
         radius = Radius.constant(2)
@@ -137,6 +149,7 @@ class TestExchangeOracle:
         out = ex({"q": arr})["q"]
         check_halos(np.asarray(out), gsize, mesh222, radius)
 
+    @needs_rdma
     def test_pallas_dma_asymmetric_1d(self):
         # uncentered kernel over a deep 1D ring: +x 2, -x 1
         mesh = make_mesh((8, 1, 1))

@@ -126,3 +126,60 @@ class TestRadius:
         for axis in range(3):
             for side in (-1, 1):
                 assert r.max_side(axis, side) == 3
+
+
+class TestConstructorValidation:
+    """Hardened constructors: bad values fail LOUDLY instead of
+    truncating into slab-width math."""
+
+    def test_dim3_rejects_floats(self):
+        with pytest.raises(ValueError, match="not an integer"):
+            Dim3(2.5, 1, 1)
+        with pytest.raises(ValueError, match="use // for integer"):
+            Dim3(1, 4.0, 1)   # even integral floats: / vs // bugs
+        with pytest.raises(ValueError):
+            Dim3.of((1, 2, 3.5))
+        with pytest.raises(ValueError):
+            Dim3.filled(1.0)
+
+    def test_dim3_accepts_numpy_integers(self):
+        import numpy as np
+        d = Dim3(np.int32(2), np.int64(3), np.uint8(4))
+        assert d == Dim3(2, 3, 4)
+        assert all(isinstance(c, int) for c in d)
+
+    def test_dim3_negative_components_stay_legal(self):
+        # direction vectors and differences NEED negatives
+        assert -Dim3(1, 2, 3) == Dim3(-1, -2, -3)
+        assert Dim3(0, 0, 0) - Dim3(1, 1, 1) == Dim3(-1, -1, -1)
+
+    def test_dim3_arithmetic_still_validated(self):
+        d = Dim3(4, 4, 4) + (1, 1, 1)
+        assert d == Dim3(5, 5, 5)
+        with pytest.raises(ValueError):
+            Dim3(4, 4, 4) + (0.5, 0, 0)
+
+    def test_radius_rejects_negative(self):
+        with pytest.raises(ValueError, match=">= 0"):
+            Radius.constant(-1)
+        r = Radius.constant(1)
+        with pytest.raises(ValueError, match=">= 0"):
+            r.set_dir((1, 0, 0), -2)
+        with pytest.raises(ValueError):
+            Radius.face_edge_corner(3, -1, 0)
+        with pytest.raises(ValueError):
+            r.set_face(-3)
+
+    def test_radius_rejects_floats(self):
+        with pytest.raises(ValueError, match="not an integer"):
+            Radius.constant(1.5)
+        r = Radius.constant(0)
+        with pytest.raises(ValueError):
+            r.set_edge(2.0)
+
+    def test_radius_valid_values_unchanged(self):
+        import numpy as np
+        r = Radius.constant(np.int64(3))
+        assert r.dir((1, 1, 1)) == 3
+        r.set_dir((0, 0, 1), np.int32(5))
+        assert r.z(1) == 5

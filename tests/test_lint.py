@@ -1,0 +1,163 @@
+"""stencil-lint: each checker proven positive AND negative.
+
+Positive: the shipped registry is clean (the same property CI's lint
+stage gates on). Negative: every fixture under tests/fixtures/lint/
+is flagged by its checker — the pass is not vacuously green. Plus CLI
+exit codes and the JSON artifact schema. Everything here is pure
+tracing: no kernel executes, so this runs identically with or without
+a TPU/interpreter.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from stencil_tpu.analysis import Finding, Report, run_targets
+from stencil_tpu.analysis.footprint import required_radius
+from stencil_tpu.analysis.registry import default_targets, load_targets
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "lint"
+
+
+# ---------------------------------------------------------------------------
+# positive: shipped code is clean
+
+
+def test_shipped_registry_is_clean():
+    """The acceptance property: every registered op, DMA kernel and
+    exchange path upholds its contract — zero errors, zero warnings
+    (a warning would mean a shipped path went statically unverifiable
+    without anyone deciding that)."""
+    report = run_targets(default_targets())
+    assert report.findings == [], [str(f) for f in report.findings]
+    assert len(report.targets_checked) >= 20
+    assert report.ok
+
+
+def test_checker_filter():
+    report = run_targets(default_targets(), checkers=["collectives"])
+    assert report.ok
+    assert all(t.startswith("parallel.exchange")
+               for t in report.targets_checked)
+    with pytest.raises(ValueError):
+        run_targets([], checkers=["nope"])
+
+
+# ---------------------------------------------------------------------------
+# negative controls: one per checker, with the finding shape pinned
+
+
+def test_footprint_fixture_flagged():
+    report = run_targets(load_targets(FIXTURES / "bad_footprint.py"))
+    assert not report.ok
+    msgs = {f.target: f.message for f in report.errors}
+    # the understated 5-point z stencil: both z faces under-declared
+    assert any("(0, 0, 1)" in m and "declared radius 1 < required 2" in m
+               for t, m in msgs.items()
+               if t == "fixture.wide5_z_radius_understated"), msgs
+    # diagonal access with zero edge radius: flagged in (1,1,0) ONLY
+    edge = [f for f in report.errors
+            if f.target == "fixture.cross_with_zero_edge_radius"]
+    assert len(edge) == 1 and "(1, 1, 0)" in edge[0].message, edge
+    # asymmetric: the -x side specifically
+    assert any("(-1, 0, 0)" in f.message for f in report.errors
+               if f.target == "fixture.asymmetric_minus_x_understated")
+    # alias propagation: the access slices `padded * 0.5`, not padded
+    assert any("(0, 1, 0)" in f.message and "required 2" in f.message
+               for f in report.errors
+               if f.target == "fixture.laundered_through_elementwise")
+
+
+def test_dma_fixture_flagged():
+    report = run_targets(load_targets(FIXTURES / "bad_dma.py"))
+    assert not report.ok
+    by_target = {}
+    for f in report.errors:
+        by_target.setdefault(f.target.split(":")[0], []).append(f.message)
+    assert any("never awaited" in m
+               for m in by_target["fixture.remote_dma_missing_wait"])
+    assert any("before any neighbor barrier" in m
+               for m in by_target["fixture.remote_dma_missing_barrier"])
+    assert any("re-armed while" in m
+               for m in by_target["fixture.semaphore_reused_in_flight"])
+    assert any("barrier wait value 2 != 1" in m
+               for m in by_target["fixture.barrier_signal_wait_mismatch"])
+
+
+def test_collectives_fixture_flagged():
+    report = run_targets(load_targets(FIXTURES / "bad_collective.py"))
+    assert not report.ok
+    msgs = {f.target: f.message for f in report.errors}
+    assert "duplicated destination" in \
+        msgs["fixture.ppermute_duplicate_destination"]
+    assert "outside [0, 2)" in msgs["fixture.ppermute_index_out_of_range"]
+    assert "not a full bijection" in \
+        msgs["fixture.ppermute_partial_ring"]
+
+
+# ---------------------------------------------------------------------------
+# unit: the 26-direction requirement formula
+
+
+def test_required_radius_formula():
+    # an access reaching (+3 x, +3 y): edge (1,1,0) needs 3, faces too,
+    # and any direction involving z needs nothing
+    access = {(0, -1): 0, (0, 1): 3, (1, -1): 0, (1, 1): 3,
+              (2, -1): 0, (2, 1): 0}
+    req = required_radius([access])
+    assert req[(1, 0, 0)] == 3
+    assert req[(0, 1, 0)] == 3
+    assert req[(1, 1, 0)] == 3
+    assert req[(1, 1, 1)] == 0
+    assert req[(0, 0, 1)] == 0
+    assert req[(-1, 0, 0)] == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI + JSON artifact
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    from stencil_tpu.analysis.__main__ import main
+
+    out = tmp_path / "report.json"
+    # fixtures -> nonzero, and the artifact records the errors
+    rc = main(["-q", "--json", str(out),
+               str(FIXTURES / "bad_collective.py")])
+    assert rc == 1
+    data = json.loads(out.read_text())
+    assert data["schema_version"] == 1
+    assert data["tool"] == "stencil-lint"
+    assert data["counts"]["errors"] >= 3
+    assert data["counts"]["errors_by_checker"] == {
+        "collectives": data["counts"]["errors"]}
+    assert {f["severity"] for f in data["findings"]} == {"error"}
+    assert all(set(f) == {"checker", "target", "message", "severity"}
+               for f in data["findings"])
+
+
+@pytest.mark.parametrize("fixture", ["bad_footprint.py", "bad_dma.py",
+                                     "bad_collective.py"])
+def test_cli_nonzero_on_every_fixture(fixture):
+    """The acceptance criterion verbatim: the CLI exits nonzero on
+    EVERY negative-control fixture."""
+    from stencil_tpu.analysis.__main__ import main
+
+    assert main(["-q", str(FIXTURES / fixture)]) == 1
+
+
+def test_cli_usage_error_on_missing_fixture(tmp_path):
+    from stencil_tpu.analysis.__main__ import main
+
+    assert main(["-q", str(tmp_path / "nope.py")]) == 2
+
+
+def test_report_json_roundtrip():
+    r = Report()
+    r.targets_checked.append("t")
+    r.findings.append(Finding("dma", "t", "boom"))
+    d = json.loads(r.to_json())
+    assert d["counts"] == {"targets": 1, "errors": 1, "warnings": 0,
+                           "errors_by_checker": {"dma": 1}}
+    assert not r.ok
