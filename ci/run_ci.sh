@@ -24,10 +24,28 @@ cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
 echo "== 1/5 lint (stencil-lint + ruff; tier=$TIER) =="
-# stencil-lint: static halo-radius / DMA-discipline / ppermute checks
+# stencil-lint: all six static checkers — halo-radius footprint, DMA
+# discipline, ppermute sanity, HLO collective-permute-only lowering,
+# analytic-vs-HLO byte cross-check, and the Pallas VMEM/tiling audit
 # (python -m stencil_tpu.analysis, see README "Static analysis").
-# Exits nonzero on findings; the JSON report is the CI artifact.
-python -m stencil_tpu.analysis --json stencil_lint_report.json
+# The hlo/costmodel byte checks capability-gate themselves on the
+# image's JAX (StableHLO lowering support is probed; Pallas targets
+# skip off-TPU with a note in the report) — no env detection needed
+# here. Exits nonzero on findings; the JSON report is the CI artifact
+# (archived to $CI_ARTIFACT_DIR when a trigger provides one).
+# capture the exit code so the report is archived even (especially)
+# when the lint stage fails — red CI with no artifact helps no one
+lint_rc=0
+python -m stencil_tpu.analysis --json stencil_lint_report.json \
+  || lint_rc=$?
+if [ -n "${CI_ARTIFACT_DIR:-}" ] && [ -f stencil_lint_report.json ]; then
+  mkdir -p "$CI_ARTIFACT_DIR"
+  cp stencil_lint_report.json "$CI_ARTIFACT_DIR/"
+fi
+if [ "$lint_rc" -ne 0 ]; then
+  echo "stencil-lint failed (exit $lint_rc)"
+  exit "$lint_rc"
+fi
 if python -c "import ruff" 2>/dev/null; then
   python -m ruff check stencil_tpu/
 elif command -v ruff >/dev/null; then

@@ -1,8 +1,9 @@
-"""stencil-lint: static invariant checking for the stencil framework.
+"""stencil-lint / stencil-audit: static invariant checking for the
+stencil framework.
 
-Three checkers prove, WITHOUT executing anything (pure jaxpr tracing —
-seconds on any CPU box, no TPU, no interpreter), the invariants the
-whole framework hangs on:
+Six checkers prove, WITHOUT executing anything (jaxpr tracing plus
+lower-only StableHLO inspection — seconds on any CPU box, no TPU, no
+interpreter), the invariants the whole framework hangs on:
 
 * :mod:`.footprint`   — every registered stencil op's true access
   footprint is covered by its declared ``geometry.Radius`` in all 26
@@ -11,43 +12,78 @@ whole framework hangs on:
   ordered, started exactly once per semaphore arm, and waited on both
   ends (the static analog of the interpreter's race detector);
 * :mod:`.collectives` — every ``lax.ppermute`` permutation is a full
-  bijection of its mesh axis and all collective axis names resolve.
+  bijection of its mesh axis and all collective axis names resolve;
+* :mod:`.hlo`         — every exchange method LOWERS to
+  ``collective-permute`` only (no accidental all-gather/all-reduce/
+  all-to-all), with per-collective byte counts extracted;
+* :mod:`.costmodel`   — HLO-observed wire bytes match the analytic
+  per-direction halo byte model from ``geometry``/``partition``
+  (uneven remainders included), plus jaxpr FLOPs / arithmetic
+  intensity metrics;
+* :mod:`.vmem`        — every Pallas kernel's VMEM footprint fits the
+  budget and its blocks respect (8, 128) tiling and grid divisibility.
 
 Run ``python -m stencil_tpu.analysis`` (exit nonzero on findings,
-``--json`` for the CI artifact), or use :func:`run_targets` /
+``--json`` for the CI artifact, ``--only``/``--list`` to select
+checkers), or use :func:`run_targets` /
 :func:`stencil_tpu.analysis.registry.default_targets` from pytest.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Iterable, Optional, Sequence
 
 from .collectives import (CollectiveSpec, CollectiveTarget,
                           check_collectives)
+from .costmodel import CostModelSpec, CostModelTarget, check_costmodel
 from .dma import PallasKernelSpec, PallasKernelTarget, check_pallas_kernels
 from .footprint import StencilOpSpec, StencilOpTarget, check_stencil_op
+from .hlo import HloSpec, HloTarget, check_hlo
 from .report import ERROR, WARNING, Finding, Report
+from .vmem import VmemSpec, VmemTarget, check_vmem
 
-CHECKERS = ("footprint", "dma", "collectives")
+CHECKERS = ("footprint", "dma", "collectives", "hlo", "costmodel",
+            "vmem")
+
+CHECKER_DOC = {
+    "footprint": "26-direction access footprint vs declared Radius",
+    "dma": "Pallas remote-DMA barrier/start/wait discipline",
+    "collectives": "ppermute bijections + collective axis names",
+    "hlo": "collective-permute-only lowering (StableHLO audit)",
+    "costmodel": "HLO bytes vs analytic halo model + FLOPs/AI",
+    "vmem": "Pallas VMEM footprint, (8,128) tiling, grid divisibility",
+}
 
 __all__ = [
-    "CHECKERS", "ERROR", "WARNING", "Finding", "Report",
-    "CollectiveSpec", "CollectiveTarget", "PallasKernelSpec",
+    "CHECKERS", "CHECKER_DOC", "ERROR", "WARNING", "Finding", "Report",
+    "CollectiveSpec", "CollectiveTarget", "CostModelSpec",
+    "CostModelTarget", "HloSpec", "HloTarget", "PallasKernelSpec",
     "PallasKernelTarget", "StencilOpSpec", "StencilOpTarget",
-    "check_collectives", "check_pallas_kernels", "check_stencil_op",
-    "run_targets",
+    "VmemSpec", "VmemTarget", "check_collectives", "check_costmodel",
+    "check_hlo", "check_pallas_kernels", "check_stencil_op",
+    "check_vmem", "run_targets",
 ]
 
 _DISPATCH = {
     "footprint": check_stencil_op,
     "dma": check_pallas_kernels,
     "collectives": check_collectives,
+    "hlo": check_hlo,
+    "costmodel": check_costmodel,
+    "vmem": check_vmem,
 }
 
 
 def run_targets(targets: Iterable,
                 checkers: Optional[Sequence[str]] = None) -> Report:
-    """Run each target through its checker; aggregate into a Report."""
+    """Run each target through its checker; aggregate into a Report.
+
+    A checker returns either ``findings`` or ``(findings, metrics)``;
+    metrics land in ``report.metrics["<checker>:<target>"]`` and the
+    JSON artifact. Per-checker wall time accumulates in
+    ``report.checker_seconds``.
+    """
     enabled = set(checkers) if checkers else set(CHECKERS)
     unknown = enabled - set(CHECKERS)
     if unknown:
@@ -64,5 +100,16 @@ def run_targets(targets: Iterable,
         if kind not in enabled:
             continue
         report.targets_checked.append(target.name)
-        report.extend(_DISPATCH[kind](target))
+        t0 = time.perf_counter()
+        result = _DISPATCH[kind](target)
+        report.checker_seconds[kind] = (
+            report.checker_seconds.get(kind, 0.0)
+            + time.perf_counter() - t0)
+        if isinstance(result, tuple):
+            findings, metrics = result
+            if metrics:
+                report.metrics[f"{kind}:{target.name}"] = metrics
+        else:
+            findings = result
+        report.extend(findings)
     return report

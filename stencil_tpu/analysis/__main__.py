@@ -3,9 +3,11 @@
 Exit status: 0 when every checked invariant holds, 1 when any
 error-severity finding exists, 2 on usage errors. ``--json PATH``
 writes the machine-readable report (schema in ``report.py``) for CI
-artifacts. Positional arguments are fixture module paths (files
-defining ``TARGETS``) checked INSTEAD of the shipped registry — the
-negative-control hook: the CLI must exit nonzero on every fixture
+artifacts. ``--only NAME`` (or the legacy spelling ``--checker``)
+restricts the run to one checker (repeatable); ``--list`` enumerates
+the checkers and exits. Positional arguments are fixture module paths
+(files defining ``TARGETS``) checked INSTEAD of the shipped registry —
+the negative-control hook: the CLI must exit nonzero on every fixture
 under ``tests/fixtures/lint/``.
 """
 
@@ -17,10 +19,10 @@ from typing import List, Optional
 
 
 def _setup_backend() -> None:
-    """Analysis is pure tracing: force a small virtual-CPU mesh so the
-    shard_map targets resolve their axes without touching accelerators
-    (mirrors tests/conftest.py; shared old-JAX fallback lives in
-    apply_fake_cpu)."""
+    """Analysis is pure tracing/lowering: force a small virtual-CPU
+    mesh so the shard_map targets resolve their axes without touching
+    accelerators (mirrors tests/conftest.py; shared old-JAX fallback
+    lives in apply_fake_cpu)."""
     try:
         from stencil_tpu.utils.config import apply_fake_cpu
 
@@ -30,22 +32,32 @@ def _setup_backend() -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    from . import CHECKER_DOC, CHECKERS
+
     parser = argparse.ArgumentParser(
         prog="python -m stencil_tpu.analysis",
         description="stencil-lint: static halo-radius / DMA-discipline "
-                    "/ collective-permutation checks (no execution)")
+                    "/ collective-permutation / HLO-lowering / "
+                    "cost-model / VMEM checks (no execution)")
     parser.add_argument("fixtures", nargs="*",
                         help="fixture module paths (files defining "
                              "TARGETS) to check instead of the shipped "
                              "registry")
     parser.add_argument("--json", metavar="PATH",
                         help="write the JSON report here")
-    parser.add_argument("--checker", action="append", dest="checkers",
-                        choices=("footprint", "dma", "collectives"),
+    parser.add_argument("--only", "--checker", action="append",
+                        dest="checkers", choices=CHECKERS,
                         help="run only this checker (repeatable)")
+    parser.add_argument("--list", action="store_true", dest="list_",
+                        help="list the available checkers and exit")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the per-target OK lines")
     args = parser.parse_args(argv)
+
+    if args.list_:
+        for name in CHECKERS:
+            print(f"  {name:<12} {CHECKER_DOC[name]}")
+        return 0
 
     _setup_backend()
 
@@ -74,8 +86,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         tag = "ERROR" if f.severity == "error" else "warn "
         print(f"  {tag} {f}")
     n_err, n_warn = len(report.errors), len(report.warnings)
+    timing = " ".join(f"{k}={v:.2f}s"
+                      for k, v in sorted(report.checker_seconds.items()))
     print(f"stencil-lint: {len(report.targets_checked)} targets, "
-          f"{n_err} error(s), {n_warn} warning(s)")
+          f"{n_err} error(s), {n_warn} warning(s)"
+          + (f" [{timing}]" if timing else ""))
 
     if args.json:
         with open(args.json, "w", encoding="utf-8") as fh:

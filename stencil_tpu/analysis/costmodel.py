@@ -1,0 +1,183 @@
+"""Checker 5: analytic cost model vs. HLO-observed communication.
+
+The reference library's placement model prices every message from
+geometry alone: face/edge/corner interface area x radius x element
+size (reference: include/stencil/partition.hpp:167-208 split rule,
+local_domain.cuh halo_bytes). This checker computes the same analytic
+per-shard wire-byte model from ``geometry``/``partition`` (uneven +-1
+remainders included — capacity-sized slabs ride the wire even for
+short shards) and cross-checks it against what the *lowered HLO
+actually moves* (:mod:`.hlo` byte extraction). A mismatch is a
+lowering regression — an exchange shipping more than the halo, or
+dropping part of it — caught statically, with no benchmark hardware.
+
+It also derives per-op FLOP counts and arithmetic intensity from the
+jaxpr (flops / top-level HBM bytes), reported as metrics in the JSON
+artifact: the roofline inputs the bench harnesses otherwise measure on
+hardware.
+
+Byte-count convention: "observed bytes" is the sum of wire-collective
+*operand* bytes per shard — what each shard contributes to every op.
+For ``collective_permute`` that is exactly the wire traffic; for the
+``all_gather`` control strategy it is the per-shard contribution (ring
+wire cost is (n-1)x that), which keeps one convention across kinds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+from .hlo import (_PALLAS_SKIP_NOTE, collect_collectives,
+                  lowering_supported, pallas_unlowerable, summarize)
+from .jaxprs import iter_eqns, trace
+from .report import ERROR, WARNING, Finding
+
+# per-element FLOP weights for the jaxpr walk. Elementwise arithmetic
+# counts 1; transcendentals use the conventional ~10-op estimate. This
+# is a roofline-grade estimate, not a cycle count.
+_FLOP_1 = frozenset({
+    "add", "sub", "mul", "max", "min", "neg", "abs", "sign",
+    "and", "or", "xor", "not", "select_n", "clamp", "square",
+})
+_FLOP_5 = frozenset({"div", "rem", "sqrt", "rsqrt", "cbrt",
+                     "integer_pow", "pow"})
+_FLOP_10 = frozenset({"exp", "expm1", "log", "log1p", "sin", "cos",
+                      "tan", "tanh", "logistic", "atan2", "erf",
+                      "erf_inv", "erfc"})
+
+
+def jaxpr_flops(closed) -> int:
+    """Estimated FLOPs of one evaluation: sum over arithmetic eqns of
+    output element count x op weight (dot_general: 2 x out x K)."""
+    flops = 0
+    for eqn in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        out = eqn.outvars[0] if eqn.outvars else None
+        shape = getattr(getattr(out, "aval", None), "shape", None)
+        if shape is None:
+            continue
+        n = 1
+        for d in shape:
+            n *= int(d)
+        if name in _FLOP_1:
+            flops += n
+        elif name in _FLOP_5:
+            flops += 5 * n
+        elif name in _FLOP_10:
+            flops += 10 * n
+        elif name == "dot_general":
+            dims = eqn.params.get("dimension_numbers")
+            k = 1
+            if dims:
+                (lhs_c, _), _ = dims
+                lhs_shape = getattr(eqn.invars[0].aval, "shape", ())
+                for ax in lhs_c:
+                    k *= int(lhs_shape[ax])
+            flops += 2 * n * k
+    return flops
+
+
+def io_bytes(closed) -> int:
+    """Top-level input + output bytes — the HBM-traffic floor the
+    arithmetic-intensity estimate divides by."""
+    import numpy as np
+
+    total = 0
+    for v in list(closed.jaxpr.invars) + list(closed.jaxpr.outvars):
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", None)
+        dtype = getattr(aval, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * np.dtype(dtype).itemsize
+    return total
+
+
+@dataclasses.dataclass
+class CostModelSpec:
+    """A jittable exchange program plus its analytic byte expectation.
+
+    ``expected_bytes_per_shard`` comes from the geometry/partition
+    model (``parallel.exchange.exchanged_bytes_per_sweep`` /
+    ``interior_slab_bytes`` — the one source of truth the runtime
+    byte counters use). ``rel_tol`` absorbs representation noise only;
+    the registered targets match exactly.
+    """
+
+    fn: Callable
+    args: Sequence[Any]
+    expected_bytes_per_shard: int
+    rel_tol: float = 0.02
+    count_kinds: Tuple[str, ...] = ("collective_permute", "all_gather")
+
+
+@dataclasses.dataclass
+class CostModelTarget:
+    name: str
+    build: Callable[[], CostModelSpec]
+
+    checker = "costmodel"
+
+
+def check_costmodel(target: CostModelTarget) -> Tuple[List[Finding], Dict]:
+    try:
+        spec = target.build()
+    except Exception as e:  # noqa: BLE001
+        return [Finding("costmodel", target.name,
+                        f"target build failed: {type(e).__name__}: {e}")], {}
+
+    metrics: Dict = {}
+    try:
+        closed = trace(spec.fn, *spec.args)
+        flops = jaxpr_flops(closed)
+        io = io_bytes(closed)
+        metrics["flops"] = flops
+        metrics["io_bytes"] = io
+        metrics["arithmetic_intensity"] = (round(flops / io, 4) if io
+                                           else None)
+    except Exception as e:  # noqa: BLE001
+        return [Finding("costmodel", target.name,
+                        f"trace failed: {type(e).__name__}: {e}")], metrics
+
+    if not lowering_supported():
+        metrics["skipped"] = ("byte cross-check skipped: StableHLO "
+                              "lowering unavailable in this JAX/backend")
+        return [], metrics
+    if pallas_unlowerable(spec.fn, spec.args, closed=closed):
+        metrics["skipped"] = f"byte cross-check skipped: {_PALLAS_SKIP_NOTE}"
+        return [], metrics
+    try:
+        ops = collect_collectives(spec.fn, spec.args)
+    except Exception as e:  # noqa: BLE001
+        return [Finding("costmodel", target.name,
+                        f"lowering failed: {type(e).__name__}: {e}")], metrics
+
+    observed = sum(op.bytes_per_shard for op in ops
+                   if op.kind in spec.count_kinds)
+    expected = int(spec.expected_bytes_per_shard)
+    metrics["collectives"] = summarize(ops)
+    metrics["observed_bytes_per_shard"] = observed
+    metrics["expected_bytes_per_shard"] = expected
+
+    findings: List[Finding] = []
+    tol = max(1, int(spec.rel_tol * expected)) if expected else 0
+    if abs(observed - expected) > tol:
+        pct = (f"{100.0 * (observed - expected) / expected:+.1f}%"
+               if expected else "n/a")
+        findings.append(Finding(
+            "costmodel", target.name,
+            f"HLO moves {observed} B/shard but the analytic halo "
+            f"model expects {expected} B/shard ({pct}) — the lowered "
+            f"exchange no longer matches its geometry (lowering "
+            f"regression or model drift)", ERROR))
+    if expected and not ops:
+        findings.append(Finding(
+            "costmodel", target.name,
+            "analytic model expects wire traffic but the lowered "
+            "module has no collectives — exchange traced away?",
+            WARNING))
+    return findings, metrics

@@ -1,0 +1,275 @@
+"""Checker 4: HLO-level collective audit — what XLA actually lowers to.
+
+The jaxpr checkers prove the *program we wrote* (ppermute bijections,
+DMA discipline); this checker proves the *program XLA sees*. A halo
+exchange must lower to ``stablehlo.collective_permute`` only — the
+point-to-point neighbor shift that moves exactly the halo bytes. Any
+``all_gather`` / ``all_reduce`` / ``all_to_all`` / ``reduce_scatter``
+in a step function means the exchange fell off the fast path (an
+accidental gather from a mis-specced shard_map, a psum smuggled into a
+hot loop) and the wire cost jumps from O(halo) to O(domain) — the XLA
+analog of TEMPI's silent fallback from the fast MPI data path
+(PAPERS.md). Catching it here costs seconds on a backendless CPU box,
+not a TPU-hour.
+
+Method: ``jax.jit(fn).lower(*args)`` under the fake multi-device CPU
+mesh — lowering only, nothing compiles or executes — then walk the
+StableHLO module and collect every collective op with its operand
+shape, element type, and per-shard byte count. The byte counts feed
+the :mod:`.costmodel` cross-check against the analytic halo model.
+
+Capability gates (recorded as metrics, never silent):
+
+* Pallas kernels with ``interpret=False`` cannot lower off-TPU
+  ("Only interpret mode is supported on CPU backend") — targets whose
+  jaxpr contains a ``pallas_call`` are skipped off-TPU with a note;
+  the dma/vmem checkers still cover them statically.
+* images whose JAX cannot produce StableHLO for a shard_map program
+  at all skip the checker with a note (probed once per process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .jaxprs import iter_eqns, trace
+from .report import ERROR, WARNING, Finding
+
+# the wire collectives worth auditing, by StableHLO op name
+WIRE_COLLECTIVES = ("collective_permute", "all_gather", "all_reduce",
+                    "all_to_all", "reduce_scatter", "collective_broadcast")
+
+# StableHLO element type -> bytes (the types the framework can emit)
+_MLIR_ELEM_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "i32": 4, "i16": 2, "i8": 1, "i1": 1,
+    "ui64": 8, "ui32": 4, "ui16": 2, "ui8": 1,
+    "c64": 8, "c128": 16,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One wire collective in the lowered module."""
+
+    kind: str                 # StableHLO op name, e.g. "collective_permute"
+    shape: Tuple[int, ...]    # operand (per-shard) shape
+    elem_type: str            # StableHLO element type, e.g. "f32"
+    bytes_per_shard: int      # operand bytes each shard puts on the wire
+
+
+@dataclasses.dataclass
+class HloSpec:
+    """A jittable program plus its allowed collective vocabulary.
+
+    ``allow`` names the StableHLO collectives the program may lower to
+    (default: collective-permute only — the halo-exchange contract).
+    The all-gather *control* strategy registers itself with
+    ``allow=("all_gather",)`` — deliberately O(domain), benchmarked as
+    such. ``expect_collective`` guards against the checker passing
+    vacuously on a refactor that traced away the exchange.
+    """
+
+    fn: Callable
+    args: Sequence[Any]
+    allow: Tuple[str, ...] = ("collective_permute",)
+    expect_collective: bool = True
+
+
+@dataclasses.dataclass
+class HloTarget:
+    name: str
+    build: Callable[[], HloSpec]
+
+    checker = "hlo"
+
+
+_lowering_supported: Optional[bool] = None
+
+
+def lowering_supported() -> bool:
+    """Probe (once) whether this JAX can lower a SHARD_MAP program to
+    StableHLO on the current backend — the capability gate CI uses.
+    The probe is a real (1-device) shard_map with a collective, so a
+    compat-shimmed jax whose shard_map only traces fails the probe and
+    the checkers record skips instead of erroring every target."""
+    global _lowering_supported
+    if _lowering_supported is None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        try:
+            mesh = Mesh(jax.devices()[:1], ("_probe",))
+            sm = jax.shard_map(
+                lambda x: jax.lax.psum(x, "_probe"), mesh=mesh,
+                in_specs=P(), out_specs=P(), check_vma=False)
+            lowered = jax.jit(sm).lower(
+                jax.ShapeDtypeStruct((2,), jnp.float32))
+            lowered.compiler_ir(dialect="stablehlo")
+            _lowering_supported = True
+        except Exception:  # noqa: BLE001 - any failure means "cannot"
+            _lowering_supported = False
+    return _lowering_supported
+
+
+def _elem_bytes(elem: str) -> int:
+    return _MLIR_ELEM_BYTES.get(elem, 4)
+
+
+def _walk_module(module) -> List[CollectiveOp]:
+    """Collect wire collectives by walking the MLIR module's regions."""
+    out: List[CollectiveOp] = []
+    names = {f"stablehlo.{k}": k for k in WIRE_COLLECTIVES}
+
+    def visit(op) -> None:
+        for region in op.regions:
+            for block in region.blocks:
+                for o in block.operations:
+                    kind = names.get(o.operation.name)
+                    if kind is not None and len(o.operands):
+                        t = o.operands[0].type
+                        shape = tuple(int(d) for d in t.shape)
+                        elem = str(t.element_type)
+                        n = 1
+                        for d in shape:
+                            n *= d
+                        out.append(CollectiveOp(
+                            kind, shape, elem, n * _elem_bytes(elem)))
+                    visit(o)
+
+    visit(module.operation)
+    return out
+
+
+_TEXT_RE = None
+
+
+def _walk_text(text: str) -> List[CollectiveOp]:
+    """Regex fallback over ``lower(...).as_text()`` for images whose
+    MLIR python bindings cannot walk the module. Collectives with a
+    reduction region (all_reduce) keep their type signature on the
+    op's closing line, so a line-oriented scan with a pending-kind
+    state machine sees every op exactly once."""
+    import re
+
+    global _TEXT_RE
+    if _TEXT_RE is None:
+        _TEXT_RE = {
+            "op": re.compile(r'stablehlo\.(%s)\b'
+                             % "|".join(WIRE_COLLECTIVES)),
+            "sig": re.compile(r':\s*\(tensor<([0-9x]*)([a-z][a-z0-9]*)>'),
+        }
+    out: List[CollectiveOp] = []
+    pending: Optional[str] = None
+    for line in text.splitlines():
+        m = _TEXT_RE["op"].search(line)
+        if m:
+            pending = m.group(1)
+        if pending is None:
+            continue
+        sig = _TEXT_RE["sig"].search(line)
+        if sig is None:
+            continue
+        dims, elem = sig.group(1), sig.group(2)
+        shape = tuple(int(d) for d in dims.split("x") if d)
+        n = 1
+        for d in shape:
+            n *= d
+        out.append(CollectiveOp(pending, shape, elem, n * _elem_bytes(elem)))
+        pending = None
+    return out
+
+
+def collect_collectives(fn: Callable, args: Sequence[Any]
+                        ) -> List[CollectiveOp]:
+    """Lower ``fn`` (lowering only — nothing compiles or runs) and
+    return every wire collective in the StableHLO module."""
+    import jax
+
+    lowered = jax.jit(fn).lower(*args)
+    try:
+        return _walk_module(lowered.compiler_ir(dialect="stablehlo"))
+    except Exception:  # noqa: BLE001 - binding quirks -> text fallback
+        return _walk_text(lowered.as_text())
+
+
+def contains_pallas(fn: Callable, args: Sequence[Any],
+                    closed=None) -> bool:
+    """True when the traced program contains a ``pallas_call`` (which
+    cannot lower off-TPU with ``interpret=False``). Pass an already-
+    traced ``closed`` jaxpr to skip the (shard_map-dominated) re-trace."""
+    if closed is None:
+        closed = trace(fn, *args)
+    return any(eqn.primitive.name == "pallas_call"
+               for eqn in iter_eqns(closed.jaxpr))
+
+
+_PALLAS_SKIP_NOTE = ("contains pallas_call; lowering needs a TPU "
+                     "backend (dma/vmem checkers cover it statically)")
+
+
+def pallas_unlowerable(fn: Callable, args: Sequence[Any],
+                       closed=None) -> bool:
+    """The shared capability gate for the lowering-based checkers:
+    True when the program contains a ``pallas_call`` AND the backend
+    is not a TPU (the only place Mosaic can lower it). On a TPU the
+    gate opens and pallas targets lower like everything else."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return False
+    return contains_pallas(fn, args, closed=closed)
+
+
+def summarize(ops: Sequence[CollectiveOp]) -> Dict[str, Dict[str, int]]:
+    """Per-kind {count, bytes_per_shard} — the report metric."""
+    out: Dict[str, Dict[str, int]] = {}
+    for op in ops:
+        e = out.setdefault(op.kind, {"count": 0, "bytes_per_shard": 0})
+        e["count"] += 1
+        e["bytes_per_shard"] += op.bytes_per_shard
+    return out
+
+
+def check_hlo(target: HloTarget) -> Tuple[List[Finding], Dict]:
+    """Prove the target lowers to its allowed collective vocabulary
+    only; collect per-collective byte counts as metrics."""
+    try:
+        spec = target.build()
+    except Exception as e:  # noqa: BLE001
+        return [Finding("hlo", target.name,
+                        f"target build failed: {type(e).__name__}: {e}")], {}
+    if not lowering_supported():
+        return [], {"skipped": "StableHLO lowering unavailable in this "
+                               "JAX/backend"}
+    try:
+        if pallas_unlowerable(spec.fn, spec.args):
+            return [], {"skipped": _PALLAS_SKIP_NOTE}
+    except Exception as e:  # noqa: BLE001
+        return [Finding("hlo", target.name,
+                        f"trace failed: {type(e).__name__}: {e}")], {}
+    try:
+        ops = collect_collectives(spec.fn, spec.args)
+    except Exception as e:  # noqa: BLE001
+        return [Finding("hlo", target.name,
+                        f"lowering failed: {type(e).__name__}: {e}")], {}
+
+    metrics = {"collectives": summarize(ops)}
+    findings: List[Finding] = []
+    for kind, entry in sorted(metrics["collectives"].items()):
+        if kind not in spec.allow:
+            findings.append(Finding(
+                "hlo", target.name,
+                f"lowers to stablehlo.{kind} x{entry['count']} "
+                f"({entry['bytes_per_shard']} B/shard) — a halo "
+                f"exchange must be {'/'.join(spec.allow)} only; this "
+                f"collective moves O(domain), not O(halo), bytes",
+                ERROR))
+    if spec.expect_collective and not ops:
+        findings.append(Finding(
+            "hlo", target.name,
+            "expected wire collectives but the lowered module has "
+            "none — the checker would be vacuous here", WARNING))
+    return findings, metrics

@@ -1,12 +1,19 @@
 """The shipped-code target registry for stencil-lint.
 
-Every stencil op, Pallas DMA kernel, and collective exchange path the
+Every stencil op, Pallas kernel, and collective exchange path the
 framework ships is registered here with its declared contract; the
-checkers in this package prove the contracts against the traced IR.
-Negative-control fixtures under ``tests/fixtures/lint/`` define the
-same target types with deliberately broken kernels (loaded via
-:func:`load_targets`) — each checker must flag them, proving the pass
-is not vacuously green.
+checkers in this package prove the contracts against the traced IR
+(footprint/dma/collectives/vmem) or the lowered StableHLO
+(hlo/costmodel). Negative-control fixtures under
+``tests/fixtures/lint/`` define the same target types with
+deliberately broken kernels (loaded via :func:`load_targets`) — each
+checker must flag them, proving the pass is not vacuously green.
+
+Coverage is drift-guarded: ``stencil_tpu.ops.PUBLIC_OPS`` and
+``stencil_tpu.parallel.EXCHANGE_METHOD_TARGETS`` list every public op
+and exchange method with the target name (prefix) here that covers it,
+and ``tests/test_lint.py`` cross-checks both manifests against
+:func:`default_targets` — new code cannot silently escape the gate.
 """
 
 from __future__ import annotations
@@ -16,10 +23,14 @@ from pathlib import Path
 from typing import List, Union
 
 from .collectives import CollectiveSpec, CollectiveTarget
+from .costmodel import CostModelSpec, CostModelTarget
 from .dma import PallasKernelSpec, PallasKernelTarget
 from .footprint import StencilOpSpec, StencilOpTarget
+from .hlo import HloSpec, HloTarget
+from .vmem import VmemSpec, VmemTarget
 
-Target = Union[StencilOpTarget, PallasKernelTarget, CollectiveTarget]
+Target = Union[StencilOpTarget, PallasKernelTarget, CollectiveTarget,
+               HloTarget, CostModelTarget, VmemTarget]
 
 
 def _f32(shape):
@@ -222,32 +233,51 @@ def _jacobi_halo_kernel_spec() -> PallasKernelSpec:
 # collective targets: ppermute bijections + axis-name hygiene
 
 
-def _exchange_spec(radius_kind: str) -> CollectiveSpec:
-    import jax
-    from jax.sharding import PartitionSpec as P
+# the exchange_shard targets' geometry, shared by the collective spec
+# builder AND the cost-model expectation so the two cannot drift: a
+# (28,28,28) padded global over the 2x2x2 mesh -> (14,14,14) shards
+_EXCHANGE_GLOBAL = (28, 28, 28)
+_EXCHANGE_MESH = (2, 2, 2)
 
+
+def _exchange_shard_shape():
+    return tuple(g // m for g, m in zip(_EXCHANGE_GLOBAL,
+                                        _EXCHANGE_MESH))
+
+
+def _exchange_radius(radius_kind: str):
     from ..geometry import Radius
-    from ..parallel.exchange import exchange_shard
-    from ..parallel.mesh import mesh_dim
 
-    mesh = _mesh((2, 2, 2))
-    counts = mesh_dim(mesh)
     if radius_kind == "r1":
-        radius = Radius.constant(1)
-    elif radius_kind == "r3":
-        radius = Radius.constant(3)
-    else:  # asymmetric, zero on some sides
+        return Radius.constant(1)
+    if radius_kind == "r3":
+        return Radius.constant(3)
+    if radius_kind == "asym":  # asymmetric, zero on some sides
         radius = Radius.constant(0)
         radius.set_dir((1, 0, 0), 2)
         radius.set_dir((-1, 0, 0), 1)
         radius.set_dir((0, 1, 0), 1)
+        return radius
+    raise ValueError(f"unknown exchange radius kind {radius_kind!r}")
+
+
+def _exchange_spec(radius_kind: str) -> CollectiveSpec:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.exchange import exchange_shard
+    from ..parallel.mesh import mesh_dim
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = _exchange_radius(radius_kind)
 
     def shard(p):
         return exchange_shard(p, radius, counts)
 
     sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
                        out_specs=P("z", "y", "x"), check_vma=False)
-    return CollectiveSpec(fn=sm, args=(_f32((28, 28, 28)),),
+    return CollectiveSpec(fn=sm, args=(_f32(_EXCHANGE_GLOBAL),),
                           axis_sizes=dict(mesh.shape),
                           expect_ppermute=True)
 
@@ -337,6 +367,237 @@ def _make_exchange_jit_spec() -> CollectiveSpec:
 
 
 # ---------------------------------------------------------------------------
+# HLO / cost-model targets: every exchange METHOD, audited at the
+# StableHLO level (collective-permute-only lowering) and cross-checked
+# against the analytic halo byte model. The builders reuse the
+# collective specs above and attach the geometry-derived expectation
+# from parallel.exchange's byte counters — the same source of truth
+# the runtime observability (utils/profiling.exchange_stats_report)
+# prints.
+
+
+def _sweep_bytes(shard_padded_zyx, radius, counts, elem_size) -> int:
+    from ..parallel.exchange import exchanged_bytes_per_sweep
+
+    return sum(exchanged_bytes_per_sweep(shard_padded_zyx, radius,
+                                         counts, elem_size).values())
+
+
+def _exchange_cost(radius_kind: str) -> CostModelSpec:
+    from ..geometry import Dim3
+
+    cs = _exchange_spec(radius_kind)
+    expected = _sweep_bytes(_exchange_shard_shape(),
+                            _exchange_radius(radius_kind),
+                            Dim3(*_EXCHANGE_MESH), 4)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _packed_uneven_cost() -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+
+    cs = _exchange_packed_uneven_spec()
+    r = Radius.constant(1)
+    counts = Dim3(2, 2, 2)
+    # capacity shard (10,10,10) per field; bf16 packs in its own group
+    expected = (_sweep_bytes((10, 10, 10), r, counts, 4)
+                + _sweep_bytes((10, 10, 10), r, counts, 2))
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _allgather_cost() -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+
+    cs = _exchange_allgather_spec()
+    expected = _sweep_bytes((8, 8, 8), Radius.constant(1),
+                            Dim3(2, 2, 2), 4)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _interior_slabs_cost(yzext: bool) -> CostModelSpec:
+    from ..geometry import Dim3
+    from ..parallel.exchange import interior_slab_bytes
+
+    cs = _interior_slabs_spec(yzext)
+    expected = interior_slab_bytes((8, 8, 8), Dim3(1, 2, 2), 3, 4,
+                                   y_z_extended=yzext)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _make_exchange_jit_cost() -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+
+    cs = _make_exchange_jit_spec()
+    expected = _sweep_bytes((10, 10, 10), Radius.constant(1),
+                            Dim3(2, 2, 2), 4)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _hlo_from_collective(build, allow=("collective_permute",)) -> HloSpec:
+    cs = build()
+    return HloSpec(fn=cs.fn, args=cs.args, allow=tuple(allow))
+
+
+def _rdma_hlo_spec() -> HloSpec:
+    """The PallasDMA exchange method: off-TPU the checker records a
+    capability-gate skip (pallas_call cannot lower there); on a TPU
+    backend it proves the kernel adds no XLA-level collectives around
+    its explicit RDMA."""
+    cs = _rdma_exchange_spec()
+    return HloSpec(fn=cs.fn, args=cs.args, allow=(),
+                   expect_collective=False)
+
+
+# ---------------------------------------------------------------------------
+# VMEM targets: every shipped Pallas kernel's static memory/tiling
+# audit. The overlap/RDMA builders are shared with the dma targets;
+# the single-chip wrap/halo fast-path kernels (previously outside the
+# registry) enter here.
+
+
+def _vmem_from_kernel(build) -> VmemSpec:
+    ks = build()
+    return VmemSpec(fn=ks.fn, args=ks.args)
+
+
+def _jacobi7_plane_vmem_spec() -> VmemSpec:
+    from ..geometry import Dim3, Radius
+    from ..ops.pallas_stencil import jacobi7_pallas
+
+    radius = Radius.constant(1)
+    interior = Dim3(8, 8, 8)
+
+    def fn(p):
+        return jacobi7_pallas(p, radius, interior, interpret=False)
+
+    return VmemSpec(fn=fn, args=(_f32((10, 10, 10)),))
+
+
+def _laplace6_vmem_spec() -> VmemSpec:
+    from ..geometry import Dim3, Radius
+    from ..ops.pallas_stencil import laplace6_pallas
+
+    radius = Radius.constant(3)
+    interior = Dim3(8, 8, 8)
+
+    def fn(p):
+        return laplace6_pallas(p, radius, interior, interpret=False)
+
+    return VmemSpec(fn=fn, args=(_f32((14, 14, 14)),))
+
+
+def _jacobi_wrap_vmem_spec(steps: int) -> VmemSpec:
+    from ..ops.pallas_stencil import (jacobi7_wrap_pallas,
+                                      jacobi7_wrapn_pallas)
+
+    hot, cold, r = (4, 8, 8), (12, 8, 8), 2
+
+    def fn(q):
+        if steps == 1:
+            return jacobi7_wrap_pallas(q, hot, cold, r, interpret=False)
+        return jacobi7_wrapn_pallas(q, hot, cold, r, steps=steps,
+                                    interpret=False)
+
+    return VmemSpec(fn=fn, args=(_f32((16, 16, 16)),))
+
+
+def _mhd_wrap_vmem_spec(pair: bool) -> VmemSpec:
+    from ..models.astaroth import FIELDS, MhdParams
+    from ..ops.pallas_mhd import (mhd_substep01_wrap_pallas,
+                                  mhd_substep_wrap_pallas)
+
+    prm = MhdParams()
+
+    def fn(*fs):
+        fields = dict(zip(FIELDS, fs))
+        if pair:
+            f, w = mhd_substep01_wrap_pallas(fields, prm, prm.dt,
+                                             interpret=False)
+        else:
+            f, w = mhd_substep_wrap_pallas(fields, None, 0, prm, prm.dt,
+                                           interpret=False)
+        return tuple(f[q] for q in FIELDS) + tuple(w[q] for q in FIELDS)
+
+    return VmemSpec(fn=fn, args=tuple(_f32((16, 16, 16))
+                                      for _ in FIELDS))
+
+
+def _jacobi_halon_vmem_spec() -> VmemSpec:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3
+    from ..ops.pallas_halo import jacobi7_halon_pallas
+    from ..parallel.exchange import exchange_interior_slabs, shard_origin
+
+    mesh = _mesh((1, 2, 2))
+    counts = Dim3(1, 2, 2)
+    local = Dim3(16, 8, 8)
+    bz, steps = 4, 2
+
+    def shard(p):
+        ox, oy, oz = shard_origin(local, Dim3(0, 0, 0))
+        org = jnp.stack([oz, oy, ox]).astype(jnp.int32)
+        slabs = exchange_interior_slabs(p, counts, rz=bz, ry=8,
+                                        radius_rows=steps,
+                                        y_z_extended=True)
+        return jacobi7_halon_pallas(p, slabs, org, (16, 16, 16),
+                                    (5, 8, 8), (11, 8, 8), 1,
+                                    steps=steps, block_z=bz, block_y=8,
+                                    interpret=False)
+
+    spec = P("z", "y", "x")
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=spec, out_specs=spec,
+                       check_vma=False)
+    return VmemSpec(fn=sm, args=(_f32((16, 16, 16)),))
+
+
+def _mhd_halo_vmem_spec(pair: bool) -> VmemSpec:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3
+    from ..models.astaroth import FIELDS, MhdParams
+    from ..ops.pallas_halo import (mhd_halo_blocks,
+                                   mhd_substep01_halo_pallas,
+                                   mhd_substep_halo_pallas)
+    from ..parallel.exchange import exchange_interior_slabs
+
+    mesh = _mesh((1, 2, 2))
+    counts = Dim3(1, 2, 2)
+    prm = MhdParams()
+    Z = Y = X = 8
+    bz, _by = mhd_halo_blocks(Z, Y)
+    rr = 6 if pair else 3
+
+    def shard(fields):
+        slabs = {q: exchange_interior_slabs(fields[q], counts, rz=bz,
+                                            ry=8, radius_rows=rr,
+                                            y_z_extended=True)
+                 for q in FIELDS}
+        if pair:
+            f, w = mhd_substep01_halo_pallas(fields, slabs, prm, prm.dt,
+                                             interpret=False)
+        else:
+            f, w = mhd_substep_halo_pallas(fields, None, slabs, 0, prm,
+                                           prm.dt, interpret=False)
+        return f, w
+
+    spec = P("z", "y", "x")
+    fspec = {q: spec for q in FIELDS}
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(fspec,),
+                       out_specs=(fspec, fspec), check_vma=False)
+    fields = {q: _f32((2 * Z, 2 * Y, X)) for q in FIELDS}
+    return VmemSpec(fn=sm, args=(fields,))
+
+
+# ---------------------------------------------------------------------------
 
 
 def default_targets() -> List[Target]:
@@ -385,6 +646,81 @@ def default_targets() -> List[Target]:
                          lambda: _interior_slabs_spec(False)),
         CollectiveTarget("parallel.exchange.make_exchange[jit,packed]",
                          _make_exchange_jit_spec),
+    ]
+    # HLO-lowering audit: one target per exchange METHOD (+ the jitted
+    # orchestrator), collective-permute-only unless the method is the
+    # deliberate all-gather control
+    targets += [
+        HloTarget("parallel.exchange.exchange_shard[r1,hlo]",
+                  lambda: _hlo_from_collective(
+                      lambda: _exchange_spec("r1"))),
+        HloTarget("parallel.exchange.exchange_shard[asym,hlo]",
+                  lambda: _hlo_from_collective(
+                      lambda: _exchange_spec("asym"))),
+        HloTarget("parallel.exchange.exchange_shard_packed[uneven,hlo]",
+                  lambda: _hlo_from_collective(
+                      _exchange_packed_uneven_spec)),
+        HloTarget("parallel.exchange.exchange_shard_allgather[hlo]",
+                  lambda: _hlo_from_collective(
+                      _exchange_allgather_spec, allow=("all_gather",))),
+        HloTarget("parallel.exchange.exchange_interior_slabs[yzext,hlo]",
+                  lambda: _hlo_from_collective(
+                      lambda: _interior_slabs_spec(True))),
+        HloTarget("parallel.exchange.make_exchange[jit,packed,hlo]",
+                  lambda: _hlo_from_collective(_make_exchange_jit_spec)),
+        HloTarget("parallel.pallas_exchange.exchange_shard_pallas[hlo]",
+                  _rdma_hlo_spec),
+    ]
+    # analytic-vs-HLO byte cross-check for the same methods
+    targets += [
+        CostModelTarget("parallel.exchange.exchange_shard[r1,cost]",
+                        lambda: _exchange_cost("r1")),
+        CostModelTarget("parallel.exchange.exchange_shard[r3,cost]",
+                        lambda: _exchange_cost("r3")),
+        CostModelTarget("parallel.exchange.exchange_shard[asym,cost]",
+                        lambda: _exchange_cost("asym")),
+        CostModelTarget(
+            "parallel.exchange.exchange_shard_packed[uneven,cost]",
+            _packed_uneven_cost),
+        CostModelTarget("parallel.exchange.exchange_shard_allgather[cost]",
+                        _allgather_cost),
+        CostModelTarget(
+            "parallel.exchange.exchange_interior_slabs[yzext,cost]",
+            lambda: _interior_slabs_cost(True)),
+        CostModelTarget("parallel.exchange.exchange_interior_slabs[cost]",
+                        lambda: _interior_slabs_cost(False)),
+        CostModelTarget("parallel.exchange.make_exchange[jit,packed,cost]",
+                        _make_exchange_jit_cost),
+    ]
+    # static VMEM/tiling audit: every shipped Pallas kernel
+    targets += [
+        VmemTarget("parallel.pallas_exchange.exchange_shard_pallas[vmem]",
+                   lambda: _vmem_from_kernel(_rdma_exchange_spec)),
+        VmemTarget("ops.pallas_overlap.jacobi7_overlap_pallas[vmem]",
+                   lambda: _vmem_from_kernel(_jacobi_overlap_spec)),
+        VmemTarget("ops.pallas_mhd_overlap.mhd_substep_overlap[vmem]",
+                   lambda: _vmem_from_kernel(
+                       lambda: _mhd_overlap_spec(pair=False))),
+        VmemTarget("ops.pallas_halo.jacobi7_halo_pallas[vmem]",
+                   lambda: _vmem_from_kernel(_jacobi_halo_kernel_spec)),
+        VmemTarget("ops.pallas_stencil.jacobi7_pallas",
+                   _jacobi7_plane_vmem_spec),
+        VmemTarget("ops.pallas_stencil.laplace6_pallas",
+                   _laplace6_vmem_spec),
+        VmemTarget("ops.pallas_stencil.jacobi7_wrap_pallas",
+                   lambda: _jacobi_wrap_vmem_spec(1)),
+        VmemTarget("ops.pallas_stencil.jacobi7_wrapn_pallas[n=2]",
+                   lambda: _jacobi_wrap_vmem_spec(2)),
+        VmemTarget("ops.pallas_mhd.mhd_substep_wrap_pallas",
+                   lambda: _mhd_wrap_vmem_spec(pair=False)),
+        VmemTarget("ops.pallas_mhd.mhd_substep01_wrap_pallas",
+                   lambda: _mhd_wrap_vmem_spec(pair=True)),
+        VmemTarget("ops.pallas_halo.jacobi7_halon_pallas[n=2]",
+                   _jacobi_halon_vmem_spec),
+        VmemTarget("ops.pallas_halo.mhd_substep_halo_pallas",
+                   lambda: _mhd_halo_vmem_spec(pair=False)),
+        VmemTarget("ops.pallas_halo.mhd_substep01_halo_pallas",
+                   lambda: _mhd_halo_vmem_spec(pair=True)),
     ]
     return targets
 

@@ -13,7 +13,13 @@ import dataclasses
 import json
 from typing import Dict, List, Sequence
 
-SCHEMA_VERSION = 1
+# v2: adds tool_version, per-checker wall time (checker_seconds), and
+# per-target metrics (hlo collective byte counts, costmodel
+# expected/observed bytes + flops/arithmetic intensity, vmem footprint
+# estimates, capability-gate skip notes)
+SCHEMA_VERSION = 2
+
+TOOL_VERSION = "0.2.0"
 
 ERROR = "error"
 WARNING = "warning"
@@ -23,8 +29,10 @@ WARNING = "warning"
 class Finding:
     """One violated (or unverifiable) invariant.
 
-    ``checker``  -- "footprint" | "dma" | "collectives"
-    ``target``   -- registry name of the checked entity
+    ``checker``  -- "footprint" | "dma" | "collectives" | "hlo" |
+                    "costmodel" | "vmem"
+    ``target``   -- registry name of the checked entity (or
+                    "name:kernel" for per-kernel dma/vmem findings)
     ``message``  -- human-readable description of the violation
     ``severity`` -- ERROR (fails CI) or WARNING (reported only)
     """
@@ -47,6 +55,12 @@ class Report:
 
     findings: List[Finding] = dataclasses.field(default_factory=list)
     targets_checked: List[str] = dataclasses.field(default_factory=list)
+    # per-checker wall time (seconds), e.g. {"hlo": 1.2}
+    checker_seconds: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    # per-target metrics keyed "<checker>:<target>" (byte counts, VMEM
+    # estimates, capability-gate skip notes, ...)
+    metrics: Dict[str, Dict] = dataclasses.field(default_factory=dict)
 
     def extend(self, findings: Sequence[Finding]) -> None:
         self.findings.extend(findings)
@@ -73,6 +87,7 @@ class Report:
         return {
             "schema_version": SCHEMA_VERSION,
             "tool": "stencil-lint",
+            "tool_version": TOOL_VERSION,
             "jax_version": jax.__version__,
             "targets_checked": list(self.targets_checked),
             "counts": {
@@ -81,6 +96,9 @@ class Report:
                 "warnings": len(self.warnings),
                 "errors_by_checker": by_checker,
             },
+            "checker_seconds": {k: round(v, 3)
+                                for k, v in self.checker_seconds.items()},
+            "metrics": self.metrics,
             "findings": [f.to_dict() for f in self.findings],
         }
 

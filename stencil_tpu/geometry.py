@@ -326,6 +326,14 @@ class Radius:
     def pad_hi(self) -> Dim3:
         return Dim3(self.x(1), self.y(1), self.z(1))
 
+    def wire_rows(self, axis: int) -> int:
+        """Rows of axis ``axis`` a sequential-sweep exchange ships per
+        shard (both sides): lo face radius + hi face radius. The
+        per-axis factor of the analytic byte model
+        (``partition.sweep_wire_bytes``,
+        ``parallel.exchange.exchanged_bytes_per_sweep``)."""
+        return self.face(axis, -1) + self.face(axis, 1)
+
     def max_side(self, axis: int, side: int) -> int:
         """Max radius over all directions whose ``axis`` component equals
         ``side`` — the amount the interior shrinks on that side

@@ -1,0 +1,36 @@
+"""Mesh construction and the halo-exchange engine.
+
+``EXCHANGE_METHOD_TARGETS`` is the lint-coverage manifest — the
+registry metadata hook the static analyzer's drift guard checks
+(tests/test_lint.py): every ``methods.Method`` exchange strategy maps
+to the ``analysis/registry.default_targets()`` name (prefix) covering
+its data path. A new Method flag without a registered analysis target
+fails the guard, so no exchange strategy ships un-audited.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+EXCHANGE_METHOD_TARGETS: Dict[str, str] = {
+    "PpermuteSlab": "parallel.exchange.exchange_shard",
+    "PpermutePacked": "parallel.exchange.exchange_shard_packed",
+    "PallasDMA": "parallel.pallas_exchange.exchange_shard_pallas",
+    "AllGather": "parallel.exchange.exchange_shard_allgather",
+}
+
+
+def exchange_method_targets() -> Dict[str, str]:
+    """The manifest, validated against the live ``Method`` enum: every
+    single-bit strategy flag must have an entry (aliases like
+    ``Default`` and the empty ``NONE`` excluded)."""
+    from .methods import Method
+
+    flags = {m.name for m in Method
+             if m.name is not None and m.value and not (m.value & (m.value - 1))}
+    missing = flags - set(EXCHANGE_METHOD_TARGETS)
+    if missing:
+        raise RuntimeError(
+            f"exchange Method flags {sorted(missing)} have no analysis "
+            f"coverage entry in EXCHANGE_METHOD_TARGETS")
+    return dict(EXCHANGE_METHOD_TARGETS)

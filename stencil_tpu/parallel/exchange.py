@@ -525,8 +525,6 @@ def exchanged_bytes_per_sweep(shard_padded_shape_zyx: Sequence[int],
     out = {"x": 0, "y": 0, "z": 0}
     shape = list(shard_padded_shape_zyx)
     for a in axis_order:
-        r_lo = radius.face(a, -1)
-        r_hi = radius.face(a, 1)
         dim = AXIS_TO_DIM[a]
         if mesh_counts[a] <= 1:
             continue
@@ -534,5 +532,5 @@ def exchanged_bytes_per_sweep(shard_padded_shape_zyx: Sequence[int],
         for d in range(3):
             if d != dim:
                 other *= shape[d]
-        out[AXIS_NAME[a]] = (r_lo + r_hi) * other * elem_size
+        out[AXIS_NAME[a]] = radius.wire_rows(a) * other * elem_size
     return out

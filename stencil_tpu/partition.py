@@ -175,6 +175,75 @@ class NodePartition:
         return self._dimensionize(i, self._node_dim)
 
 
+def sweep_wire_bytes(part: RankPartition, radius: Radius,
+                     elem_size: int) -> dict:
+    """Whole-mesh wire bytes per exchange under the sequential-sweep
+    engine, derived from the PARTITION alone — the planning-side
+    statement of the same analytic model whose per-shard form
+    (``parallel.exchange.exchanged_bytes_per_sweep``) feeds the
+    static analyzer's HLO cross-check (``analysis/costmodel.py``) and
+    the runtime byte counters; ``tests/test_lint.py`` pins the two
+    derivations equal so they cannot fork.
+
+    Every shard ships capacity-sized slabs: allocations are sized to
+    the ceil subdomain (uneven +-1 remainders included — a short
+    shard's slack rows ride the wire as filler, exactly what the
+    static-shape ppermute program moves), and each axis sweep's slab
+    spans the full padded extents of the other two axes (edge/corner
+    ride-along). Axes with one subdomain are in-core wraps and cost
+    nothing. Returns ``{"x": .., "y": .., "z": .., "total": ..}``
+    (bytes over the whole mesh, the ``exchange_bytes_total``
+    convention).
+    """
+    dim = part.dim()
+    cap = Dim3(div_ceil(part.global_size.x, dim.x),
+               div_ceil(part.global_size.y, dim.y),
+               div_ceil(part.global_size.z, dim.z))
+    padded = cap + radius.pad_lo() + radius.pad_hi()
+    out = {"x": 0, "y": 0, "z": 0}
+    for a, name in enumerate(("x", "y", "z")):
+        if dim[a] <= 1:
+            continue
+        other = 1
+        for b in range(3):
+            if b != a:
+                other *= padded[b]
+        out[name] = radius.wire_rows(a) * other * elem_size * dim.flatten()
+    out["total"] = out["x"] + out["y"] + out["z"]
+    return out
+
+
+def halo_byte_model(part: RankPartition, radius: Radius,
+                    elem_size: int) -> dict:
+    """The reference's per-message byte-placement model: for every
+    subdomain and every direction with a nonzero radius, the halo
+    region is (face/edge/corner area) x radius x element size
+    (reference: local_domain.cuh halo_bytes over src/stencil.cu:331-344
+    message planning), with the ACTUAL +-1-remainder subdomain sizes.
+    Returns bytes per direction kind plus the total — the geometric
+    lower bound a 26-message exchange would move (the sweep engine
+    moves ``sweep_wire_bytes`` instead: fewer, fatter messages).
+    """
+    from .geometry import all_directions, direction_kind
+    from .local_domain import halo_bytes
+
+    dim = part.dim()
+    out = {"face": 0, "edge": 0, "corner": 0}
+    for iz in range(dim.z):
+        for iy in range(dim.y):
+            for ix in range(dim.x):
+                sz = part.subdomain_size(Dim3(ix, iy, iz))
+                for d in all_directions():
+                    if radius.dir(d) == 0:
+                        continue
+                    if any(dim[a] <= 1 and d[a] != 0 for a in range(3)):
+                        continue  # in-core wrap, no wire traffic
+                    out[direction_kind(d)] += halo_bytes(
+                        d, sz, radius, elem_size)
+    out["total"] = out["face"] + out["edge"] + out["corner"]
+    return out
+
+
 def partition_dims_even(size: Dim3Like, n: int) -> Dim3:
     """Choose a subdomain grid ``dim`` with ``dim.flatten() == n`` that
     divides ``size`` exactly, preferring the RankPartition's greedy shape.

@@ -77,10 +77,29 @@ def setup_stats_report(dd) -> str:
 
 def exchange_stats_report(dd) -> str:
     """Exchange-time report (STENCIL_EXCHANGE_STATS analog; requires
-    ``dd.enable_timing(True)``)."""
+    ``dd.enable_timing(True)``).
+
+    Reports the ANALYTIC expected wire bytes next to the measured
+    times: ``dd.exchange_bytes_total()`` comes from
+    ``parallel.exchange.exchanged_bytes_per_sweep`` — the same byte
+    model the static analyzer cross-checks against lowered HLO
+    (``analysis/costmodel.py``), so runtime observability and the
+    static cost model share one source of truth. ``eff`` is the
+    implied whole-mesh wire rate at the trimean; a gap against the
+    fabric's nominal bandwidth localizes exchange-time regressions
+    without re-deriving byte counts by hand."""
     if not dd.exchange_seconds:
         return "exchange: no samples (enable_timing first)"
     from ..numerics import trimean
     xs = dd.exchange_seconds
-    return (f"exchange: n={len(xs)} min={min(xs):.6e}s "
+    line = (f"exchange: n={len(xs)} min={min(xs):.6e}s "
             f"trimean={trimean(xs):.6e}s")
+    try:
+        expected = int(dd.exchange_bytes_total())
+    except (AttributeError, TypeError):
+        return line
+    tm = trimean(xs)
+    if expected and tm > 0:
+        line += (f" expected={expected}B/exchange (analytic)"
+                 f" eff={expected / tm / 1e9:.2f}GB/s")
+    return line

@@ -1,0 +1,90 @@
+"""Negative controls for the HLO and COSTMODEL checkers.
+
+Each target is a step/exchange program that traces cleanly and passes
+the jaxpr-level checkers, but whose LOWERED form betrays it: the halo
+exchange has fallen off the collective-permute fast path (an
+accidental all-gather "fix" for mismatched out_specs, a psum smuggled
+into the hot step), or it moves more bytes than its declared halo
+geometry. All of these run happily on hardware — just at O(domain)
+wire cost instead of O(halo) — which is precisely why the static pass
+exists. ``python -m stencil_tpu.analysis tests/fixtures/lint/bad_hlo.py``
+MUST exit nonzero.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from stencil_tpu.analysis import (CostModelSpec, CostModelTarget,
+                                  HloSpec, HloTarget)
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.parallel.exchange import (exchange_shard,
+                                           exchanged_bytes_per_sweep)
+from stencil_tpu.parallel.mesh import make_mesh
+
+
+def _mismatched_out_specs() -> HloSpec:
+    """The classic accident: the author wants the step's output
+    replicated (out_specs drops the 'z' axis), "fixes" the shape
+    mismatch by gathering the whole sharded field, and the halo
+    exchange silently becomes an O(domain) all-gather."""
+    mesh = make_mesh((1, 1, 2), jax.devices()[:2])
+
+    def step(x):
+        gathered = lax.all_gather(x, "z", axis=0, tiled=True)
+        return gathered * 0.5
+
+    sm = jax.shard_map(step, mesh=mesh, in_specs=P("z", None, None),
+                       out_specs=P(None, None, None), check_vma=False)
+    return HloSpec(fn=sm,
+                   args=(jax.ShapeDtypeStruct((8, 8, 8), jnp.float32),))
+
+
+def _psum_in_step() -> HloSpec:
+    """A convergence check (global residual psum) left inside the hot
+    step function: lowers to an all-reduce every iteration."""
+    mesh = make_mesh((1, 1, 2), jax.devices()[:2])
+    counts = Dim3(1, 1, 2)
+    radius = Radius.constant(1)
+
+    def step(x):
+        x = exchange_shard(x, radius, counts)
+        resid = lax.psum(jnp.sum(x * x), "z")
+        return x * (1.0 / (1.0 + resid))
+
+    sm = jax.shard_map(step, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    return HloSpec(fn=sm,
+                   args=(jax.ShapeDtypeStruct((10, 10, 10),
+                                              jnp.float32),))
+
+
+def _moves_more_than_model() -> CostModelSpec:
+    """A lowering/geometry drift: the program exchanges radius-2 slabs
+    while the declared halo model says radius 1 — double the wire
+    bytes of the contract. The analytic cross-check must flag it."""
+    mesh = make_mesh((1, 1, 2), jax.devices()[:2])
+    counts = Dim3(1, 1, 2)
+    declared = Radius.constant(1)
+    actually = Radius.constant(2)
+
+    def step(x):
+        return exchange_shard(x, actually, counts)
+
+    sm = jax.shard_map(step, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    expected = sum(exchanged_bytes_per_sweep(
+        (12, 12, 12), declared, counts, 4).values())
+    return CostModelSpec(
+        fn=sm, args=(jax.ShapeDtypeStruct((24, 12, 12), jnp.float32),),
+        expected_bytes_per_shard=expected)
+
+
+TARGETS = [
+    HloTarget("fixture.allgather_via_mismatched_out_specs",
+              _mismatched_out_specs),
+    HloTarget("fixture.psum_in_step", _psum_in_step),
+    CostModelTarget("fixture.exchange_moves_more_than_model",
+                    _moves_more_than_model),
+]

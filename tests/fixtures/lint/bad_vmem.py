@@ -1,0 +1,83 @@
+"""Negative controls for the VMEM checker.
+
+Each target's ``pallas_call`` traces cleanly (the generic interpreter
+would even run it), but its BlockSpec geometry is hostile to the TPU
+memory system: a working set over the VMEM budget, a lane-misaligned
+trailing tile, or a ragged grid tiling. These fail (or crawl) only
+when Mosaic meets real hardware — the static audit turns them into
+red CI instead.
+``python -m stencil_tpu.analysis tests/fixtures/lint/bad_vmem.py``
+MUST exit nonzero.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from stencil_tpu.analysis import VmemSpec, VmemTarget
+
+
+def _copy_kernel(x, o):
+    o[...] = x[...]
+
+
+def _over_budget() -> VmemSpec:
+    """(128, 128, 128) f32 blocks: 8 MiB per block, in + out doubled
+    by the pipeline = 32 MiB against the 16 MiB budget."""
+    def fn(x):
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=(8,),
+            in_specs=[pl.BlockSpec((128, 128, 128),
+                                   lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((128, 128, 128),
+                                   lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1024, 128, 128),
+                                           jnp.float32),
+            interpret=False,
+        )(x)
+
+    return VmemSpec(
+        fn=fn, args=(jax.ShapeDtypeStruct((1024, 128, 128),
+                                          jnp.float32),))
+
+
+def _misaligned_lane() -> VmemSpec:
+    """Trailing (lane) block dim 96: neither a multiple of 128 nor the
+    full array extent 192 — every grid step pays a partial-lane tile."""
+    def fn(x):
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((8, 8, 96), lambda i: (0, 0, i))],
+            out_specs=pl.BlockSpec((8, 8, 96), lambda i: (0, 0, i)),
+            out_shape=jax.ShapeDtypeStruct((8, 8, 192), jnp.float32),
+            interpret=False,
+        )(x)
+
+    return VmemSpec(
+        fn=fn, args=(jax.ShapeDtypeStruct((8, 8, 192), jnp.float32),))
+
+
+def _ragged_grid() -> VmemSpec:
+    """Sublane block dim 8 against array extent 20: 20 % 8 != 0, so
+    the last tile is ragged (masked partial blocks on the hot path)."""
+    def fn(x):
+        return pl.pallas_call(
+            _copy_kernel,
+            grid=(3,),
+            in_specs=[pl.BlockSpec((8, 8, 128), lambda i: (0, i, 0))],
+            out_specs=pl.BlockSpec((8, 8, 128), lambda i: (0, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((8, 20, 128), jnp.float32),
+            interpret=False,
+        )(x)
+
+    return VmemSpec(
+        fn=fn, args=(jax.ShapeDtypeStruct((8, 20, 128), jnp.float32),))
+
+
+TARGETS = [
+    VmemTarget("fixture.block_over_vmem_budget", _over_budget),
+    VmemTarget("fixture.misaligned_trailing_tile", _misaligned_lane),
+    VmemTarget("fixture.ragged_grid_tiling", _ragged_grid),
+]
