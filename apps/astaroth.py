@@ -26,6 +26,12 @@ def main() -> None:
     ap.add_argument("--paraview-init", action="store_true")
     ap.add_argument("--paraview-final", action="store_true")
     ap.add_argument("--prefix", default="")
+    ap.add_argument("--exchange-every", type=int, default=0, metavar="S",
+                    help="communication-avoiding temporal blocking: one "
+                         "depth-(S*R) exchange per S RK substeps "
+                         "(multiples of 3 keep the w accumulator off "
+                         "the wire; S=2 maps to the fused substep-0+1 "
+                         "kernel on the Pallas halo path)")
     ap.add_argument("--overlap", action="store_true",
                     help="interior/exterior comm-compute overlap per substep")
     ap.add_argument("--kernel", default="auto",
@@ -73,7 +79,9 @@ def main() -> None:
     m = Astaroth(gx, gy, gz, params=prm, mesh_shape=mesh_shape,
                  dtype=dtype,
                  methods=methods_from_args(args), overlap=args.overlap,
-                 kernel=args.kernel, **dcn_from_args(args))
+                 kernel=args.kernel,
+                 exchange_every=args.exchange_every or None,
+                 **dcn_from_args(args))
     m.init()
     start_iter = 0
     if args.checkpoint_dir and args.resume:

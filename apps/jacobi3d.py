@@ -41,6 +41,12 @@ def main() -> None:
                          "periodic; halo: multi-chip slab layout), XLA "
                          "slicing (xla), padded-layout Pallas (pallas), "
                          "or pick by hardware (auto)")
+    ap.add_argument("--exchange-every", type=int, default=0, metavar="S",
+                    help="communication-avoiding temporal blocking: one "
+                         "depth-S halo exchange per S iterations (the "
+                         "XLA path fuses S sub-steps on shrinking "
+                         "windows; the wrap/halo fast paths set their "
+                         "in-kernel step count to S)")
     add_method_flags(ap)
     add_placement_flags(ap)
     add_dcn_flags(ap)
@@ -75,6 +81,7 @@ def main() -> None:
                  methods=methods,
                  placement=placement_from_args(args),
                  output_prefix=args.prefix, kernel=args.kernel,
+                 exchange_every=args.exchange_every or None,
                  **dcn_from_args(args))
     j.init()
     if args.paraview:

@@ -23,7 +23,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
-echo "== 1/5 lint (stencil-lint + ruff; tier=$TIER) =="
+echo "== 1/6 lint (stencil-lint + ruff; tier=$TIER) =="
 # stencil-lint: all six static checkers — halo-radius footprint, DMA
 # discipline, ppermute sanity, HLO collective-permute-only lowering,
 # analytic-vs-HLO byte cross-check, and the Pallas VMEM/tiling audit
@@ -63,10 +63,10 @@ if [ "$TIER" = "full" ]; then
   fi
 fi
 
-echo "== 2/5 native build =="
+echo "== 2/6 native build =="
 bash ci/build.sh
 
-echo "== 3/5 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+echo "== 3/6 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
 # The full tier is dominated by interpret-mode Pallas parity tests
 # (CPU-bound, independent): fan them out with pytest-xdist when the
 # machine has cores to spare. Each worker process builds its own
@@ -82,7 +82,7 @@ else
   python -m pytest tests/ -q --maxfail=1 -m "not slow"
 fi
 
-echo "== 4/5 app smoke runs =="
+echo "== 4/6 app smoke runs =="
 # overlap app smokes execute remote DMA: possible only on a TPU or
 # with the distributed (mosaic) interpreter — probe, don't assume
 RDMA_OK=$(python -c "from stencil_tpu._compat import remote_dma_runnable
@@ -107,7 +107,39 @@ smoke() { echo "-- $*"; python "$@" > /dev/null; }
   smoke bench_qap.py --sizes 4 6
 )
 
-echo "== 5/5 multi-chip certification sweep =="
+echo "== 5/6 bench smoke: temporal blocking (exchange_every 1 vs 4) =="
+# communication-avoiding temporal blocking must not regress steps/s of
+# the REAL blocked hot path (Jacobi3D's fused run loop, redundant ring
+# compute included) on the fake CPU mesh; the amortized byte model
+# (cross-checked against HLO by stencil-lint's costmodel checker) is
+# archived next to the measured numbers. The JSON pins the exchange-
+# rounds-per-step 4x cut and the steps/s comparison; it is written to
+# a scratch path (the committed BENCH_pr3.json records the PR-time
+# numbers and must not churn on every CI run) and archived to
+# $CI_ARTIFACT_DIR when a trigger provides one.
+BENCH_JSON="$(mktemp -t BENCH_pr3.XXXXXX.json)"
+( cd apps
+  python bench_exchange.py --x 8 --y 8 --z 8 --iters 20 --fake-cpu 8 \
+        --exchange-every 1,4 --json-out "$BENCH_JSON" )
+BENCH_JSON="$BENCH_JSON" python - <<'EOF'
+import json
+import os
+d = json.load(open(os.environ["BENCH_JSON"]))
+rounds = d["rounds_per_step_ratio"]
+speed = d["steps_per_s_ratio"]
+assert abs(rounds["4"] - 0.25) < 1e-9, rounds
+# steps/s of the blocked loop must not regress beyond run-to-run noise
+assert speed["4"] > 0.8, speed
+print(f"bench smoke OK: rounds/step x{1/rounds['4']:.0f} fewer, "
+      f"steps/s ratio {speed['4']:.2f}")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+  mkdir -p "$CI_ARTIFACT_DIR"
+  cp "$BENCH_JSON" "$CI_ARTIFACT_DIR/BENCH_pr3.json"
+fi
+rm -f "$BENCH_JSON"
+
+echo "== 6/6 multi-chip certification sweep =="
 python __graft_entry__.py 8 | tail -1
 
 echo "CI PASSED"

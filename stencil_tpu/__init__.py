@@ -18,7 +18,8 @@ from . import _compat
 
 _compat.install()
 
-from .geometry import Dim3, Rect3, Radius, all_directions, direction_kind
+from .geometry import (Dim3, Rect3, Radius, all_directions, deepened,
+                       direction_kind)
 from .numerics import Statistics, div_ceil, next_align_of, prime_factors, trimean
 from .partition import NodePartition, RankPartition, partition_dims_even
 from .topology import Boundary, Topology
@@ -26,7 +27,8 @@ from .topology import Boundary, Topology
 __version__ = "0.1.0"
 
 __all__ = [
-    "Dim3", "Rect3", "Radius", "all_directions", "direction_kind",
+    "Dim3", "Rect3", "Radius", "all_directions", "deepened",
+    "direction_kind",
     "Statistics", "div_ceil", "next_align_of", "prime_factors", "trimean",
     "NodePartition", "RankPartition", "partition_dims_even",
     "Boundary", "Topology",

@@ -97,6 +97,79 @@ def io_bytes(closed) -> int:
     return total
 
 
+def deep_exchange_bytes_per_shard(shard_interior_zyx: Sequence[int],
+                                  radius, counts, elem_size: int,
+                                  steps: int) -> int:
+    """Wire bytes ONE shard puts on the ICI per ``steps``-deep exchange
+    (temporal blocking): the deepened radius' rows over the DEEPENED
+    padded cross-sections — the same ``exchanged_bytes_per_sweep``
+    source of truth the runtime counters and the HLO cross-check use,
+    evaluated on the deep allocation."""
+    from ..parallel.exchange import exchanged_bytes_per_sweep
+
+    deep = radius.deepened(steps)
+    lo, hi = deep.pad_lo(), deep.pad_hi()
+    z, y, x = shard_interior_zyx
+    padded = (z + lo.z + hi.z, y + lo.y + hi.y, x + lo.x + hi.x)
+    return sum(exchanged_bytes_per_sweep(padded, deep, counts,
+                                         elem_size).values())
+
+
+def amortized_step_wire_bytes(shard_interior_zyx: Sequence[int],
+                              radius, counts, elem_size: int,
+                              steps: int) -> float:
+    """Per-shard wire bytes charged to each STEP under ``steps``-deep
+    blocking: the deep exchange's bytes spread over the ``steps`` steps
+    it feeds. Rows amortize back to the base count but the slab
+    cross-sections carry the ``2*steps*r`` allocation growth — bytes
+    stay ~flat while exchange ROUNDS drop ``steps``x, which is the
+    entire temporal-blocking trade."""
+    return deep_exchange_bytes_per_shard(shard_interior_zyx, radius,
+                                         counts, elem_size, steps) / steps
+
+
+def temporal_step_exchange_seconds(shard_interior_zyx: Sequence[int],
+                                   radius, counts, elem_size: int,
+                                   steps: int, round_latency_s: float,
+                                   wire_bytes_per_s: float) -> float:
+    """Alpha-beta exchange cost per STEP at blocking depth ``steps``:
+    ``latency / steps + amortized_bytes / bandwidth``. The latency term
+    is per exchange ROUND (3 sequential axis sweeps of ppermutes plus
+    launch overhead); the bandwidth term prices the deep slabs."""
+    amort = amortized_step_wire_bytes(shard_interior_zyx, radius, counts,
+                                      elem_size, steps)
+    return round_latency_s / steps + amort / wire_bytes_per_s
+
+
+def predict_exchange_every(shard_interior_zyx: Sequence[int], radius,
+                           counts, elem_size: int,
+                           round_latency_s: float,
+                           wire_bytes_per_s: float,
+                           candidates: Sequence[int] = (1, 2, 3, 4, 6, 8)
+                           ) -> Tuple[int, Dict[int, float]]:
+    """Predict the crossover: the ``exchange_every`` minimizing the
+    alpha-beta per-step exchange time. Small shards / high round
+    latency push the optimum up (round amortization wins); large shards
+    / scarce bandwidth push it back toward 1 (deep-slab cross-section
+    growth dominates). Depths the geometry cannot host (a shard must
+    supply ``steps * r`` rows per side) are skipped. Returns
+    ``(best_s, {s: seconds_per_step})``."""
+    z, y, x = shard_interior_zyx
+    interior_xyz = (x, y, z)
+    costs: Dict[int, float] = {}
+    for s in candidates:
+        if any(s * max(radius.face(a, -1), radius.face(a, 1))
+               > interior_xyz[a] for a in range(3)):
+            continue
+        costs[s] = temporal_step_exchange_seconds(
+            shard_interior_zyx, radius, counts, elem_size, s,
+            round_latency_s, wire_bytes_per_s)
+    if not costs:
+        raise ValueError(f"no candidate depth fits shards "
+                         f"{shard_interior_zyx} with radius {radius}")
+    return min(costs, key=costs.get), costs
+
+
 @dataclasses.dataclass
 class CostModelSpec:
     """A jittable exchange program plus its analytic byte expectation.

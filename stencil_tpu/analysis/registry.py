@@ -353,6 +353,88 @@ def _interior_slabs_spec(yzext: bool) -> CollectiveSpec:
                           expect_ppermute=True)
 
 
+def _temporal_group_spec(s: int = 2) -> CollectiveSpec:
+    """The temporal-blocking fused group (parallel/temporal.py): one
+    depth-s exchange + s jacobi sub-steps on shrinking windows. Audited
+    like any exchange method — ppermute bijections, collective-permute-
+    only lowering, and the deep-slab byte model must match the HLO."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Radius
+    from ..ops.stencil_kernels import jacobi7
+    from ..parallel.mesh import mesh_dim
+    from ..parallel.methods import Method
+    from ..parallel.temporal import temporal_shard_steps
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+
+    def upd(blocks, dims, off, k):
+        return {"q": jacobi7(blocks["q"], radius, dims)}
+
+    def shard(p):
+        return temporal_shard_steps({"q": p}, radius, counts,
+                                    Method.PpermuteSlab, upd, s)["q"]
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    side = (8 + 2 * s)  # 8^3 interiors + deep pads, per shard
+    g = tuple(side * m for m in _EXCHANGE_MESH)
+    return CollectiveSpec(fn=sm, args=(_f32(g),),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _temporal_group_cost(s: int = 2) -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+    from .costmodel import deep_exchange_bytes_per_shard
+
+    cs = _temporal_group_spec(s)
+    expected = deep_exchange_bytes_per_shard(
+        (8, 8, 8), Radius.constant(1), Dim3(*_EXCHANGE_MESH), 4, s)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _deep_tail_exchange_spec() -> CollectiveSpec:
+    """The partial-depth exchange on a deep-carry allocation (the tail
+    steps of a blocked loop): wire depth r on s*r pads."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Radius
+    from ..parallel.exchange import exchange_shard
+    from ..parallel.mesh import mesh_dim
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+
+    def shard(p):
+        return exchange_shard(p, radius, counts,
+                              alloc_radius=radius.deepened(2))
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    g = tuple(12 * m for m in _EXCHANGE_MESH)  # 8^3 interiors, pads 2
+    return CollectiveSpec(fn=sm, args=(_f32(g),),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _deep_tail_exchange_cost() -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+
+    cs = _deep_tail_exchange_spec()
+    # base-radius rows ride on the DEEP allocation's cross-sections
+    expected = _sweep_bytes((12, 12, 12), Radius.constant(1),
+                            Dim3(*_EXCHANGE_MESH), 4)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
 def _make_exchange_jit_spec() -> CollectiveSpec:
     from ..geometry import Radius
     from ..parallel.exchange import make_exchange
@@ -646,6 +728,14 @@ def default_targets() -> List[Target]:
                          lambda: _interior_slabs_spec(False)),
         CollectiveTarget("parallel.exchange.make_exchange[jit,packed]",
                          _make_exchange_jit_spec),
+        # temporal blocking: the fused s-step group and the partial-
+        # depth tail exchange on a deep-carry allocation
+        CollectiveTarget("parallel.temporal.temporal_shard_steps[s=2]",
+                         lambda: _temporal_group_spec(2)),
+        CollectiveTarget("parallel.temporal.temporal_shard_steps[s=4]",
+                         lambda: _temporal_group_spec(4)),
+        CollectiveTarget("parallel.exchange.exchange_shard[deep-tail]",
+                         _deep_tail_exchange_spec),
     ]
     # HLO-lowering audit: one target per exchange METHOD (+ the jitted
     # orchestrator), collective-permute-only unless the method is the
@@ -670,6 +760,11 @@ def default_targets() -> List[Target]:
                   lambda: _hlo_from_collective(_make_exchange_jit_spec)),
         HloTarget("parallel.pallas_exchange.exchange_shard_pallas[hlo]",
                   _rdma_hlo_spec),
+        HloTarget("parallel.temporal.temporal_shard_steps[s=2,hlo]",
+                  lambda: _hlo_from_collective(
+                      lambda: _temporal_group_spec(2))),
+        HloTarget("parallel.exchange.exchange_shard[deep-tail,hlo]",
+                  lambda: _hlo_from_collective(_deep_tail_exchange_spec)),
     ]
     # analytic-vs-HLO byte cross-check for the same methods
     targets += [
@@ -691,6 +786,15 @@ def default_targets() -> List[Target]:
                         lambda: _interior_slabs_cost(False)),
         CostModelTarget("parallel.exchange.make_exchange[jit,packed,cost]",
                         _make_exchange_jit_cost),
+        # the amortized temporal-blocking byte model: one deep exchange
+        # per fused group, priced on the deepened allocation — the HLO
+        # must move exactly these bytes, at both registered depths
+        CostModelTarget("parallel.temporal.temporal_shard_steps[s=2,cost]",
+                        lambda: _temporal_group_cost(2)),
+        CostModelTarget("parallel.temporal.temporal_shard_steps[s=4,cost]",
+                        lambda: _temporal_group_cost(4)),
+        CostModelTarget("parallel.exchange.exchange_shard[deep-tail,cost]",
+                        _deep_tail_exchange_cost),
     ]
     # static VMEM/tiling audit: every shipped Pallas kernel
     targets += [
@@ -711,6 +815,8 @@ def default_targets() -> List[Target]:
                    lambda: _jacobi_wrap_vmem_spec(1)),
         VmemTarget("ops.pallas_stencil.jacobi7_wrapn_pallas[n=2]",
                    lambda: _jacobi_wrap_vmem_spec(2)),
+        VmemTarget("ops.pallas_stencil.jacobi7_wrapn_pallas[n=4]",
+                   lambda: _jacobi_wrap_vmem_spec(4)),
         VmemTarget("ops.pallas_mhd.mhd_substep_wrap_pallas",
                    lambda: _mhd_wrap_vmem_spec(pair=False)),
         VmemTarget("ops.pallas_mhd.mhd_substep01_wrap_pallas",

@@ -60,6 +60,11 @@ class DistributedDomain:
         self._mesh_shape: Optional[Dim3] = None
         self._output_prefix = os.environ.get("STENCIL_OUTPUT_PREFIX", "")
         self.boundary = Boundary.PERIODIC
+        # temporal blocking: one depth-(s*r) exchange per s steps
+        # (communication avoidance; parallel/temporal.py). The
+        # allocation pads deepen to s*r so the deep slabs have a home.
+        self.exchange_every = 1
+        self.alloc_radius = self.radius
         # hierarchical DCN tier (set_dcn_axis); populated by realize()
         self._dcn_requested = False
         self._dcn_axis_req: Optional[int] = None
@@ -117,6 +122,28 @@ class DistributedDomain:
     def set_boundary(self, b: Boundary) -> None:
         self.boundary = b
 
+    def set_exchange_every(self, s: int) -> None:
+        """Temporal blocking depth: ``exchange()`` ships a depth-
+        ``s * r`` halo once per ``s`` steps instead of a depth-``r``
+        halo every step (communication avoidance — ``s``x fewer
+        exchange rounds for deeper slabs; see parallel/temporal.py and
+        the amortized byte model in analysis/costmodel.py). Allocations
+        pad to the deepened radius. The step loop (model layer or
+        application) owns calling ``exchange()`` every ``s``-th step
+        and consuming one radius ring per sub-step.
+
+        Note: allocations deepen (and the min-shard feasibility check
+        tightens) even if a Pallas fast path later takes the blocking
+        depth in-kernel and never runs this deep exchange — the cost
+        is ``2*(s-1)*r`` extra halo rows per field per axis."""
+        if self.mesh is not None:
+            raise RuntimeError("set_exchange_every before realize() — "
+                               "the allocation pads and the exchange "
+                               "program are already built")
+        if int(s) < 1:
+            raise ValueError(f"exchange_every must be >= 1, got {s}")
+        self.exchange_every = int(s)
+
     def set_dcn_axis(self, axis: Union[int, str, None] = None,
                      groups=None) -> None:
         """Enable the hierarchical node/slice tier (the NodePartition
@@ -146,9 +173,13 @@ class DistributedDomain:
     # ------------------------------------------------------------------
     def realize(self) -> None:
         assert self._names, "add_data at least one quantity before realize()"
-        if self.boundary != Boundary.PERIODIC:
-            raise NotImplementedError("only PERIODIC boundaries for now "
-                                      "(the reference hardcodes PERIODIC too)")
+        if self.boundary not in (Boundary.PERIODIC, Boundary.NONE):
+            raise NotImplementedError(f"unsupported boundary {self.boundary}")
+        if self.boundary == Boundary.NONE and pick_method(self.methods) not \
+                in (Method.PpermuteSlab, Method.PpermutePacked):
+            raise NotImplementedError(
+                "Boundary.NONE (zero-Dirichlet exterior) is supported by "
+                "the PpermuteSlab and PpermutePacked methods only")
         n = len(self._devices)
 
         t0 = time.perf_counter()
@@ -199,16 +230,26 @@ class DistributedDomain:
                 f"grid {self.size} over mesh {dim} has uneven (+-1) "
                 f"subdomains, supported only by the PpermuteSlab and "
                 f"PpermutePacked methods")
+        # temporal blocking: allocations and the exchange depth come
+        # from the DEEPENED radius (one depth-(s*r) exchange feeds s
+        # steps); s == 1 collapses to the base radius
+        self.alloc_radius = self.radius.deepened(self.exchange_every)
+        if self.exchange_every > 1 and pick_method(self.methods) not in \
+                (Method.PpermuteSlab, Method.PpermutePacked):
+            raise NotImplementedError(
+                f"exchange_every > 1 is supported by the PpermuteSlab "
+                f"and PpermutePacked methods, not "
+                f"{pick_method(self.methods)}")
         min_local = [self.local_size[a] - (1 if self.rem[a] else 0)
                      for a in range(3)]
         if any(m < 1 for m in min_local):
             raise ValueError(f"zero-extent subdomains: grid {self.size} "
                              f"over mesh {dim}")
-        if any(min_local[a] < self.radius.face(a, 1) or
-               min_local[a] < self.radius.face(a, -1)
+        if any(min_local[a] < self.alloc_radius.face(a, 1) or
+               min_local[a] < self.alloc_radius.face(a, -1)
                for a in range(3)):
             raise ValueError(f"subdomain {min_local} smaller than "
-                             f"radius {self.radius}")
+                             f"(deepened) radius {self.alloc_radius}")
         self.setup_seconds["partition"] = time.perf_counter() - t0
 
         # --- placement (reference: src/stencil.cu:201-239) -------------
@@ -238,7 +279,7 @@ class DistributedDomain:
         # --- mesh + allocation (reference: src/stencil.cu:249-272) -----
         t0 = time.perf_counter()
         self.mesh = make_mesh(dim, self.placement.device_order_for_mesh())
-        padded_local = raw_size(self.local_size, self.radius)
+        padded_local = raw_size(self.local_size, self.alloc_radius)
         global_padded = padded_local * dim
         sharding = NamedSharding(self.mesh, P("z", "y", "x"))
         self._padded_global = global_padded
@@ -253,14 +294,19 @@ class DistributedDomain:
         self.setup_seconds["realize"] = time.perf_counter() - t0
 
         # --- plan: build the exchange program --------------------------
+        # the DEEP exchange: wire depth s*r, once per s steps (s == 1 is
+        # the ordinary per-step exchange). Byte counters price the deep
+        # slabs; exchange_bytes_amortized_per_step() divides by s.
         t0 = time.perf_counter()
-        self._exchange_fn = make_exchange(self.mesh, self.radius, self.methods,
-                                          rem=self.rem)
+        self._exchange_fn = make_exchange(
+            self.mesh, self.alloc_radius, self.methods, rem=self.rem,
+            nonperiodic=self.boundary == Boundary.NONE)
         counts = mesh_dim(self.mesh)
         self._bytes_per_axis = {"x": 0, "y": 0, "z": 0}
         for q in self._names:
-            b = exchanged_bytes_per_sweep(zyx_shape(padded_local), self.radius,
-                                          counts, self._dtypes[q].itemsize)
+            b = exchanged_bytes_per_sweep(zyx_shape(padded_local),
+                                          self.alloc_radius, counts,
+                                          self._dtypes[q].itemsize)
             for k in b:
                 self._bytes_per_axis[k] += b[k]
         self.setup_seconds["plan"] = time.perf_counter() - t0
@@ -365,9 +411,17 @@ class DistributedDomain:
         return dict(self._bytes_per_axis)
 
     def exchange_bytes_total(self) -> int:
-        """Total cross-device bytes per exchange over the whole mesh."""
+        """Total cross-device bytes per exchange over the whole mesh
+        (the DEEP exchange when ``exchange_every > 1``)."""
         counts = mesh_dim(self.mesh)
         return sum(v * counts.flatten() for v in self._bytes_per_axis.values())
+
+    def exchange_bytes_amortized_per_step(self) -> float:
+        """Whole-mesh wire bytes per STEP under temporal blocking: the
+        deep exchange's bytes spread over the ``exchange_every`` steps
+        it feeds (== ``exchange_bytes_total()`` when s == 1). The
+        runtime face of the amortized model in analysis/costmodel.py."""
+        return self.exchange_bytes_total() / self.exchange_every
 
     def exchange_bytes_dcn(self) -> int:
         """Bytes per exchange crossing the DCN tier, whole mesh: along
@@ -409,8 +463,11 @@ class DistributedDomain:
             # planned message)
             from .placement import iter_messages
             elem = [self._dtypes[q].itemsize for q in self._names]
+            # per-message bytes price what the wire actually moves: the
+            # deepened slabs under temporal blocking (== radius at s=1),
+            # consistent with the per-axis counters above
             for i, j, d, nbytes in iter_messages(
-                    self.placement.part, self.radius, elem,
+                    self.placement.part, self.alloc_radius, elem,
                     self.topology):
                 f.write(f"message {i} -> {j} dir "
                         f"({d.x},{d.y},{d.z}): {nbytes} B\n")
@@ -422,7 +479,7 @@ class DistributedDomain:
                 f.write(f"bytes per exchange over ICI (whole mesh): "
                         f"{self.exchange_bytes_ici()}\n")
         from .placement import comm_bytes_matrix
-        w = comm_bytes_matrix(self.placement.part, self.radius,
+        w = comm_bytes_matrix(self.placement.part, self.alloc_radius,
                               [self._dtypes[q].itemsize
                                for q in self._names], self.topology)
         np.savetxt(f"{prefix}comm_matrix.txt", w, fmt="%d")
@@ -434,8 +491,8 @@ class DistributedDomain:
         """Assemble the full global interior (z,y,x-ordered) on host by
         stripping per-shard halo padding."""
         dim = self.placement.dim()
-        pr = raw_size(self.local_size, self.radius)
-        lo = self.radius.pad_lo()
+        pr = raw_size(self.local_size, self.alloc_radius)
+        lo = self.alloc_radius.pad_lo()
         host = np.asarray(self.curr[name])
         out = np.empty(zyx_shape(self.size), dtype=host.dtype)
         for bz in range(dim.z):
@@ -465,8 +522,8 @@ class DistributedDomain:
             cb(name)
         assert tuple(values.shape) == zyx_shape(self.size)
         dim = self.placement.dim()
-        pr = raw_size(self.local_size, self.radius)
-        lo = self.radius.pad_lo()
+        pr = raw_size(self.local_size, self.alloc_radius)
+        lo = self.alloc_radius.pad_lo()
         host = np.zeros(zyx_shape(pr * dim), dtype=self._dtypes[name])
         for bz in range(dim.z):
             for by in range(dim.y):

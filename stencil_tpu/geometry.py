@@ -334,6 +334,22 @@ class Radius:
         ``parallel.exchange.exchanged_bytes_per_sweep``)."""
         return self.face(axis, -1) + self.face(axis, 1)
 
+    def deepened(self, steps: int) -> "Radius":
+        """Halo geometry for ``steps``-step temporal blocking
+        (communication avoidance): every per-direction radius scaled by
+        ``steps``, so ONE exchange delivers enough halo depth to run
+        ``steps`` stencil applications locally — each sub-step consumes
+        one base-radius ring. ``steps == 1`` returns an equal copy.
+        Asymmetric and edge/corner radii deepen independently, keeping
+        the per-direction contract the exchange plan prices."""
+        steps = _as_component("steps", steps)
+        if steps < 1:
+            raise ValueError(f"temporal depth must be >= 1, got {steps}")
+        out = Radius()
+        for d in all_directions(include_zero=True):
+            out._m[d] = self._m[d] * steps
+        return out
+
     def max_side(self, axis: int, side: int) -> int:
         """Max radius over all directions whose ``axis`` component equals
         ``side`` — the amount the interior shrinks on that side
@@ -350,3 +366,10 @@ class Radius:
     def __repr__(self) -> str:
         return (f"Radius(face=[{self.x(-1)},{self.x(1)},{self.y(-1)},{self.y(1)},"
                 f"{self.z(-1)},{self.z(1)}])")
+
+
+def deepened(radius: Radius, steps: int) -> Radius:
+    """Module-level spelling of :meth:`Radius.deepened` — the deep-halo
+    geometry one exchange ships to cover ``steps`` fused stencil steps
+    (see ``parallel/temporal.py``)."""
+    return radius.deepened(steps)

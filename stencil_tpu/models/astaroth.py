@@ -225,11 +225,27 @@ class Astaroth:
                  devices: Optional[Sequence] = None,
                  methods: Method = Method.PpermutePacked,
                  overlap: bool = False, kernel: str = "auto",
-                 dcn_axis=None, dcn_groups=None) -> None:
+                 dcn_axis=None, dcn_groups=None,
+                 exchange_every: Optional[int] = None,
+                 boundary=None) -> None:
         self.prm = params or MhdParams()
         self.dd = DistributedDomain(nx, ny, nz, devices=devices)
         self.dd.set_radius(Radius.constant(RADIUS))
         self.dd.set_methods(methods)
+        # temporal blocking: one depth-(s*R) exchange per s RK SUBSTEPS
+        # (a substep is one stencil application; 3 substeps = 1
+        # iteration). s that is a multiple of 3 keeps every blocked
+        # group starting at RK substep 0 (alpha_0 == 0), so the w
+        # accumulator never rides the wire; other depths exchange w too
+        # when a group starts mid-iteration. Pallas fast paths map
+        # s == 2 onto the fused substep-0+1 kernel; deeper blocking
+        # runs the XLA temporal path (parallel/temporal.py).
+        self._exchange_every = 0 if exchange_every is None \
+            else max(int(exchange_every), 1)
+        if self._exchange_every > 1:
+            self.dd.set_exchange_every(self._exchange_every)
+        if boundary is not None:
+            self.dd.set_boundary(boundary)
         if dcn_axis is not None or dcn_groups is not None:
             self.dd.set_dcn_axis(dcn_axis, dcn_groups)
         if mesh_shape is not None:
@@ -279,8 +295,10 @@ class Astaroth:
             raise ValueError(
                 f"kernel must be auto|wrap|halo|xla, got {kernel!r}")
         self._kernel = kernel
-        # RK3 accumulators (interior-shaped, no halos)
+        # RK3 accumulators (interior-shaped, no halos; the XLA temporal
+        # path stores them PADDED so the deep exchange can carry them)
         self._w: Optional[Dict[str, jnp.ndarray]] = None
+        self._w_padded = False
         # interior-resident fast-path state (wrap/halo kernels); any
         # external write to dd.curr must go through sync_domain() — the
         # set_interior hook below keeps it coherent automatically
@@ -328,9 +346,13 @@ class Astaroth:
         comp = compute_dtype(self._dtype)
         store = jnp.dtype(self._dtype)
 
+        from ..topology import Boundary
+        nonper = dd.boundary == Boundary.NONE
+        s_every = dd.exchange_every
+
         def substep_fused(fields, w, s):
             fields = dispatch_exchange(fields, radius, counts, method,
-                                       rem=rem)
+                                       rem=rem, nonperiodic=nonper)
             data = {q: FieldData(fields[q].astype(comp), inv_ds,
                                  pad_lo, local)
                     for q in FIELDS}
@@ -376,7 +398,8 @@ class Astaroth:
                 return out
 
             fields_ex, parts = overlapped_update(fields, radius, counts,
-                                                 method, upd)
+                                                 method, upd,
+                                                 nonperiodic=nonper)
             new_f = {q: lax.dynamic_update_slice(
                 fields_ex[q], parts[f"f:{q}"],
                 (pad_lo.z, pad_lo.y, pad_lo.x)) for q in FIELDS}
@@ -394,10 +417,14 @@ class Astaroth:
         aligned_t = (rem == Dim3(0, 0, 0)
                      and local.z % tile == 0 and local.y % tile == 0)
         aligned = aligned_t and not self._overlap
-        wrap_ok = counts == Dim3(1, 1, 1) and aligned
+        # the Pallas paths assume periodic wrap; Boundary.NONE and
+        # blocking depths beyond the fused substep-0+1 pair (s == 2)
+        # run the XLA temporal path
+        pallas_s_ok = s_every in (1, 2) and not nonper
+        wrap_ok = counts == Dim3(1, 1, 1) and aligned and not nonper
         # multi-device fast path: interior-resident shards + slab
         # exchange + fused halo megakernel (ops/pallas_halo.py)
-        halo_ok = counts.x == 1 and aligned
+        halo_ok = counts.x == 1 and aligned and pallas_s_ok
         kernel = self._kernel
         # overlapped multi-device fast path: in-kernel RDMA slab
         # exchange hidden behind the fused interior compute
@@ -405,7 +432,7 @@ class Astaroth:
         # overlap opts in anywhere (tests run it interpreted); 'auto'
         # takes it on real TPU hardware with f32 fields
         rdma_overlap_ok = (self._overlap and counts.x == 1
-                           and aligned_t)
+                           and aligned_t and pallas_s_ok)
         if rdma_overlap_ok:
             from ..ops.pallas_stencil import on_tpu
             if (kernel == "halo"
@@ -452,9 +479,17 @@ class Astaroth:
                 raise ValueError(
                     "kernel='halo' needs an x-unsharded mesh, even grid, "
                     f"local z/y multiples of the dtype sublane tile "
-                    f"({tile}), overlap off")
+                    f"({tile}), overlap off, periodic boundaries, "
+                    "exchange_every <= 2")
             self.kernel_path = "halo"
             self._build_halo_step()
+            return
+        if s_every > 1:
+            self.kernel_path = (f"xla-temporal[s={s_every}]"
+                                + ("-overlap" if self._overlap else ""))
+            self._build_temporal_xla_step(comp, store, nonper)
+            from ..utils.logging import LOG_INFO
+            LOG_INFO(f"astaroth kernel path: {self.kernel_path}")
             return
         self.kernel_path = "xla-overlap" if self._overlap else "xla"
         substep = substep_overlap if self._overlap else substep_fused
@@ -479,6 +514,128 @@ class Astaroth:
                              out_specs=(spec, spec), check_vma=False)
         self._iter_n = jax.jit(sm_n, donate_argnums=(0, 1))
 
+    def _build_temporal_xla_step(self, comp, store, nonper: bool) -> None:
+        """Communication-avoiding XLA iteration: RK substeps run in
+        groups of ``s = exchange_every`` through
+        ``parallel/temporal.py`` — ONE depth-``s*R`` exchange per group,
+        then ``s`` fused substeps on the shrinking window. When ``s``
+        does not divide 3, groups straddle iteration boundaries, so the
+        loop body covers ``lcm(3, s) / 3`` iterations (every group's RK
+        phase is then static) and a group whose first substep has
+        ``alpha != 0`` ships the ``w`` accumulator in the same deep
+        exchange (pointwise reads only — the ring depth ``(s-1)*R``
+        is covered by the uniform ``s*R`` slabs). ``w`` lives PADDED on
+        this path so its halo ring has a home."""
+        import math
+
+        from ..parallel.exchange import shard_origin
+        from ..parallel.temporal import temporal_shard_steps, validate_temporal
+
+        dd = self.dd
+        radius = dd.radius
+        counts = mesh_dim(dd.mesh)
+        local = dd.local_size
+        prm = self.prm
+        pad_lo = radius.pad_lo()
+        inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
+        method = pick_method(dd.methods)
+        dt = prm.dt
+        rem = dd.rem
+        gsize = dd.size
+        s = dd.exchange_every
+        overlap = self._overlap
+        validate_temporal(radius, local, s, rem)
+        period = math.lcm(3, s)
+        self._w_padded = True
+        w_keys = [f"w:{q}" for q in FIELDS]
+
+        def make_update(start, origin):
+            ox, oy, oz = origin
+
+            def update_fn(blocks, dims, off, k):
+                sub = (start + k) % 3
+                data = {q: FieldData(blocks[q].astype(comp), inv_ds,
+                                     pad_lo, dims)
+                        for q in FIELDS}
+                rates = mhd_rates(data, prm, comp)
+                alpha = jnp.asarray(RK3_ALPHA[sub], comp)
+                beta = jnp.asarray(RK3_BETA[sub], comp)
+                dt_ = jnp.asarray(dt, comp)
+                if nonper:
+                    from ..ops.stencil_kernels import global_coords
+                    gz, gy, gx = global_coords(
+                        (ox + off[0], oy + off[1], oz + off[2]), dims)
+                    inside = ((gx >= 0) & (gx < gsize.x)
+                              & (gy >= 0) & (gy < gsize.y)
+                              & (gz >= 0) & (gz < gsize.z))
+                out = {}
+                for q in FIELDS:
+                    # w is read POINTWISE: the window-center slice of
+                    # its base-radius-padded block
+                    wv = lax.slice(
+                        blocks[f"w:{q}"],
+                        (pad_lo.z, pad_lo.y, pad_lo.x),
+                        (pad_lo.z + dims.z, pad_lo.y + dims.y,
+                         pad_lo.x + dims.x))
+                    wq = alpha * wv.astype(comp) + dt_ * rates[q]
+                    uq = data[q].value + beta * wq
+                    if nonper:
+                        # the zero-Dirichlet exterior: ring cells beyond
+                        # the global domain hold 0, exactly what a
+                        # stepwise exchange would re-deliver
+                        uq = jnp.where(inside, uq, jnp.zeros_like(uq))
+                    out[f"w:{q}"] = wq.astype(store)
+                    out[q] = uq.astype(store)
+                return out
+
+            return update_fn
+
+        def group(f, w, origin, start, depth):
+            fields = {q: f[q] for q in FIELDS}
+            fields.update({f"w:{q}": w[q] for q in FIELDS})
+            # the group's first substep is the only one reading w from
+            # before the group; its window ring needs wire data only
+            # when alpha != 0 and the window extends past the interior
+            keys = list(FIELDS)
+            if RK3_ALPHA[start] != 0.0 and depth > 1:
+                keys += w_keys
+            out = temporal_shard_steps(
+                fields, radius, counts, method, make_update(start, origin),
+                depth, alloc_steps=s, rem=rem, exchange_keys=keys,
+                overlap=overlap and depth > 1, nonperiodic=nonper)
+            return ({q: out[q] for q in FIELDS},
+                    {q: out[f"w:{q}"] for q in FIELDS})
+
+        def shard_iters(f, w, n):
+            origin = shard_origin(local, rem)
+
+            def period_body(_, fw):
+                f, w = fw
+                for g in range(period // s):
+                    f, w = group(f, w, origin, (g * s) % 3, s)
+                return f, w
+
+            def tail_iter(_, fw):
+                f, w = fw
+                for sub in range(3):
+                    f, w = group(f, w, origin, sub, 1)
+                return f, w
+
+            iters_per_period = period // 3
+            f, w = lax.fori_loop(0, n // iters_per_period, period_body,
+                                 (f, w))
+            return lax.fori_loop(0, n % iters_per_period, tail_iter, (f, w))
+
+        spec = P("z", "y", "x")
+        fields_spec = {q: spec for q in FIELDS}
+        sm_n = jax.shard_map(shard_iters, mesh=dd.mesh,
+                             in_specs=(fields_spec, fields_spec, P()),
+                             out_specs=(fields_spec, fields_spec),
+                             check_vma=False)
+        self._iter_n = jax.jit(sm_n, donate_argnums=(0, 1))
+        self._iter = lambda f, w: self._iter_n(f, w,
+                                               jnp.asarray(1, jnp.int32))
+
     def _build_wrap_step(self) -> None:
         """Single-chip fused substeps on interior views (see
         ops/pallas_mhd.mhd_substep_wrap_pallas).
@@ -491,7 +648,12 @@ class Astaroth:
         from ..ops.pallas_mhd import mhd_substep_wrap_pallas
 
         dd = self.dd
-        lo = dd.radius.pad_lo()
+        if dd.exchange_every > 1:
+            from ..utils.logging import LOG_WARN
+            LOG_WARN("exchange_every has no effect on the single-chip "
+                     "wrap path (it performs no exchange); fields still "
+                     "carry the deepened allocation pads")
+        lo = dd.alloc_radius.pad_lo()
         local = dd.local_size
         prm = self.prm
         dt = prm.dt
@@ -568,7 +730,7 @@ class Astaroth:
         from ..parallel.exchange import exchange_interior_slabs
 
         dd = self.dd
-        lo = dd.radius.pad_lo()
+        lo = dd.alloc_radius.pad_lo()
         local = dd.local_size
         counts = mesh_dim(dd.mesh)
         prm = self.prm
@@ -584,8 +746,13 @@ class Astaroth:
         # three RK substeps (same opt-in as the wrap path; needs the
         # slabs to carry 2R valid rows, hence 2R <= min(bz, tile))
         from ..utils.config import mhd_pair_requested
-        pair_on = (mhd_pair_requested()
+        pair_on = ((mhd_pair_requested() or self._exchange_every == 2)
                    and 2 * HALO_R <= min(bz, tile))
+        if self._exchange_every == 2 and not pair_on:
+            from ..utils.logging import LOG_WARN
+            LOG_WARN("exchange_every=2 requested but the fused "
+                     "substep-0+1 kernel cannot tile this shard; "
+                     "falling back to per-substep exchanges")
         if pair_on:
             from ..ops.pallas_halo import mhd_substep01_halo_pallas
             from ..utils.logging import LOG_INFO
@@ -667,7 +834,7 @@ class Astaroth:
         from ..ops.pallas_mhd_overlap import mhd_substep_overlap
 
         dd = self.dd
-        lo = dd.radius.pad_lo()
+        lo = dd.alloc_radius.pad_lo()
         local = dd.local_size
         counts = mesh_dim(dd.mesh)
         prm = self.prm
@@ -692,8 +859,13 @@ class Astaroth:
         # radius-2R overlapped exchange + one fused pass covers RK
         # substeps 0+1, then substep 2 runs overlapped as usual
         from ..utils.config import mhd_pair_requested
-        pair_on = (mhd_pair_requested()
+        pair_on = ((mhd_pair_requested() or self._exchange_every == 2)
                    and 2 * HALO_R <= min(bz, tile))
+        if self._exchange_every == 2 and not pair_on:
+            from ..utils.logging import LOG_WARN
+            LOG_WARN("exchange_every=2 requested but the fused "
+                     "substep-0+1 kernel cannot tile this shard; "
+                     "falling back to per-substep exchanges")
         if pair_on:
             from ..utils.logging import LOG_INFO
             LOG_INFO("astaroth halo-overlap path: fused substep-0+1")
@@ -793,6 +965,21 @@ class Astaroth:
                         "rounds_per_iteration": 2.0}
             return {"path": path, "bytes_per_iteration": 3 * rnd(HALO_R),
                     "rounds_per_iteration": 3.0}
+        s = self.dd.exchange_every
+        if s > 1:
+            # one deep exchange per s substeps; groups starting at an
+            # alpha != 0 substep also carry the 8 w accumulators (same
+            # dtypes/geometry as the fields -> exactly 2x the bytes)
+            import math
+            period = math.lcm(3, s)
+            starts = [(g * s) % 3 for g in range(period // s)]
+            per_ex = float(self.dd.exchange_bytes_total())
+            iters = period // 3
+            return {"path": path,
+                    "bytes_per_iteration": sum(
+                        per_ex * (2.0 if RK3_ALPHA[st] != 0.0 else 1.0)
+                        for st in starts) / iters,
+                    "rounds_per_iteration": len(starts) / iters}
         return {"path": path,
                 "bytes_per_iteration": 3.0 * self.dd.exchange_bytes_total(),
                 "rounds_per_iteration": 3.0}
@@ -832,7 +1019,9 @@ class Astaroth:
         for _ in range(reps):
             self.dd.exchange()
         device_sync(self.dd.curr[FIELDS[0]])
-        return 3 * (time.perf_counter() - t0) / reps
+        # rounds per iteration: 3 stepwise, 3/s under temporal blocking
+        rounds = self.exchange_stats()["rounds_per_iteration"]
+        return rounds * (time.perf_counter() - t0) / reps
 
     def sync_domain(self) -> None:
         """Materialize interior-resident fast-path state back into the
@@ -846,9 +1035,13 @@ class Astaroth:
     def _ensure_w(self) -> None:
         if self._w is None:
             from jax.sharding import NamedSharding
+
+            from ..local_domain import raw_size
             sharding = NamedSharding(self.dd.mesh, P("z", "y", "x"))
             dim = self.dd.placement.dim()
-            shape = zyx_shape(self.dd.local_size * dim)
+            per_shard = (raw_size(self.dd.local_size, self.dd.alloc_radius)
+                         if self._w_padded else self.dd.local_size)
+            shape = zyx_shape(per_shard * dim)
             self._w = {q: jax.device_put(
                 jnp.zeros(shape, dtype=self._dtype), sharding)
                 for q in FIELDS}

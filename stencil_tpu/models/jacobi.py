@@ -48,7 +48,8 @@ def sphere_geometry(gsize: Dim3):
 
 def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
                       gsize: Dim3, origin_xyz, method: Method,
-                      kernel: str = "xla", rem: Dim3 = Dim3(0, 0, 0)):
+                      kernel: str = "xla", rem: Dim3 = Dim3(0, 0, 0),
+                      nonperiodic: bool = False):
     """One fused Jacobi step on one shard: exchange + 7-point update +
     Dirichlet sphere sources. ``origin_xyz`` is the shard's global
     origin (traced axis_index-derived inside shard_map, or static
@@ -58,7 +59,7 @@ def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
     hot_c, cold_c, sph_r = sphere_geometry(gsize)
 
     p = dispatch_exchange({"temp": p}, radius, counts, method,
-                          rem=rem)["temp"]
+                          rem=rem, nonperiodic=nonperiodic)["temp"]
     if kernel == "pallas":
         from ..ops.pallas_stencil import jacobi7_pallas
         new = jacobi7_pallas(p, radius, local)
@@ -84,9 +85,39 @@ def _apply_sources(new, origin_xyz, local: Dim3, hot_c: Dim3, cold_c: Dim3,
     return new
 
 
+def _apply_sources_windowed(new, origin_xyz, dims: Dim3, gsize: Dim3,
+                            hot_c: Dim3, cold_c: Dim3, sph_r: int,
+                            nonperiodic: bool):
+    """Per-sub-step sources for a temporal-blocking window that may
+    reach into the halo ring: ring cells must get exactly what their
+    OWNER shard computes, so periodic coords wrap mod the global size
+    before the sphere test; with the zero-Dirichlet exterior
+    (Boundary.NONE) out-of-domain cells are forced to zero instead."""
+    gz, gy, gx = global_coords(origin_xyz, dims)
+    if nonperiodic:
+        inside = ((gx >= 0) & (gx < gsize.x) & (gy >= 0) & (gy < gsize.y)
+                  & (gz >= 0) & (gz < gsize.z))
+    else:
+        gx = gx % gsize.x
+        gy = gy % gsize.y
+        gz = gz % gsize.z
+
+    def dist2(c: Dim3):
+        return (gx - c.x) ** 2 + (gy - c.y) ** 2 + (gz - c.z) ** 2
+
+    new = jnp.where(dist2(hot_c) <= sph_r * sph_r,
+                    jnp.asarray(HOT_TEMP, new.dtype), new)
+    new = jnp.where(dist2(cold_c) <= sph_r * sph_r,
+                    jnp.asarray(COLD_TEMP, new.dtype), new)
+    if nonperiodic:
+        new = jnp.where(inside, new, jnp.zeros_like(new))
+    return new
+
+
 def jacobi_shard_step_overlap(p, radius: Radius, counts: Dim3, local: Dim3,
                               gsize: Dim3, origin_xyz, method: Method,
-                              kernel: str = "xla"):
+                              kernel: str = "xla",
+                              nonperiodic: bool = False):
     """Overlapped variant of ``jacobi_shard_step``: the deep-interior
     update is computed from pre-exchange owned data so XLA can schedule
     it against the in-flight halo transfers; thin exterior shells are
@@ -104,17 +135,21 @@ def jacobi_shard_step_overlap(p, radius: Radius, counts: Dim3, local: Dim3,
             return {"temp": jacobi7_pallas(blk, radius, dims)}
         return {"temp": jacobi7(blk, radius, dims)}
 
-    p_ex, new = overlapped_update({"temp": p}, radius, counts, method, upd)
+    p_ex, new = overlapped_update({"temp": p}, radius, counts, method, upd,
+                                  nonperiodic=nonperiodic)
     out = _apply_sources(new["temp"], origin_xyz, local, hot_c, cold_c, sph_r)
     return write_interior(p_ex["temp"], out, radius)
 
 
-def _wrap_steps(tile: int) -> int:
-    """Temporal-blocking depth from STENCIL_WRAP_STEPS (default 2),
-    clamped to [1, sublane tile] — shared by the wrap and halo step
+def _wrap_steps(tile: int, requested: int = 0) -> int:
+    """Temporal-blocking depth for the Pallas fast paths: an explicit
+    ``exchange_every`` request wins; else STENCIL_WRAP_STEPS (default
+    2). Clamped to [1, sublane tile] — shared by the wrap and halo step
     builders (one tunable, two kernel families)."""
     import os
 
+    if requested:
+        return min(max(int(requested), 1), tile)
     try:
         n = int(os.environ.get("STENCIL_WRAP_STEPS", "2") or 2)
     except ValueError:
@@ -171,10 +206,23 @@ class Jacobi3D:
                  methods: Method = Method.Default,
                  placement=None, output_prefix: str = "",
                  kernel: str = "auto", overlap: bool = False,
-                 dcn_axis=None, dcn_groups=None) -> None:
+                 dcn_axis=None, dcn_groups=None,
+                 exchange_every: Optional[int] = None,
+                 boundary=None) -> None:
         self.dd = DistributedDomain(x, y, z, devices=devices)
         self.dd.set_radius(1)
         self.dd.set_methods(methods)
+        # temporal blocking: None = unset (fast paths keep their
+        # STENCIL_WRAP_STEPS default); an explicit s pins the depth —
+        # deep-carry allocations + one deep exchange per s steps on the
+        # XLA path (parallel/temporal.py), the in-kernel step count on
+        # the Pallas wrap/halo paths (s == 1 forces per-step exchange)
+        self._exchange_every = 0 if exchange_every is None \
+            else max(int(exchange_every), 1)
+        if self._exchange_every > 1:
+            self.dd.set_exchange_every(self._exchange_every)
+        if boundary is not None:
+            self.dd.set_boundary(boundary)
         if dcn_axis is not None or dcn_groups is not None:
             self.dd.set_dcn_axis(dcn_axis, dcn_groups)
         if placement is not None:
@@ -237,25 +285,34 @@ class Jacobi3D:
         if self._overlap and rem != Dim3(0, 0, 0):
             raise NotImplementedError("overlap mode requires an evenly "
                                       "divisible grid")
+        from ..topology import Boundary
+        nonper = dd.boundary == Boundary.NONE
+        s_every = dd.exchange_every
         # single-chip fast path: periodic wrap fused INTO the stencil
         # kernel (no halo storage, no exchange program) — the TPU-native
-        # answer to the reference's same-GPU PeerAccessSender shortcut
+        # answer to the reference's same-GPU PeerAccessSender shortcut.
+        # All Pallas fast paths assume the periodic wrap rule, so the
+        # zero-Dirichlet exterior (Boundary.NONE) runs the XLA paths.
         radius_ok = all(radius.face(a, s) == 1
                         for a in range(3) for s in (-1, 1))
         wrap_ok = (counts == Dim3(1, 1, 1) and rem == Dim3(0, 0, 0)
-                   and not self._overlap and radius_ok)
+                   and not self._overlap and radius_ok and not nonper)
         # the multi-device fast path: interior-resident shards + slab
         # exchange + fused halo kernel (ops/pallas_halo.py); uneven
         # (+-1) z/y shards supported via the kernel's interior-length
         # overlay (x is never sharded here, so rem.x is always 0)
-        halo_ok = (counts.x == 1 and not self._overlap and radius_ok)
+        halo_ok = (counts.x == 1 and not self._overlap and radius_ok
+                   and not nonper)
         # the overlapped fast path: in-kernel RDMA slab exchange hidden
         # behind the interior compute (ops/pallas_overlap.py) — the
         # reference's interior/exchange/exterior choreography as one
-        # kernel (bin/jacobi3d.cu:296-377)
+        # kernel (bin/jacobi3d.cu:296-377). With exchange_every > 1 the
+        # temporal paths amortize the exchange instead (the deep
+        # exchange already hides behind sub-step-0 interior compute).
         overlap_ok = (self._overlap and counts.x == 1
                       and rem == Dim3(0, 0, 0) and radius_ok
-                      and local.z >= 4 and local.y >= 2)
+                      and local.z >= 4 and local.y >= 2
+                      and not nonper and s_every == 1)
         from ..ops.pallas_stencil import on_tpu
         from ..utils.logging import LOG_INFO
         # explicit kernel='halo' with overlap opts into the RDMA overlap
@@ -294,10 +351,22 @@ class Jacobi3D:
         if kernel == "halo":
             if not halo_ok:
                 raise ValueError("kernel='halo' needs an x-unsharded "
-                                 "mesh, radius 1, overlap off (or "
-                                 "overlap with local z>=4)")
+                                 "mesh, radius 1, periodic boundaries, "
+                                 "overlap off (or overlap with local "
+                                 "z>=4)")
             self.kernel_path = "halo"
             self._build_halo_step()
+            return
+        if s_every > 1:
+            if kernel == "pallas":
+                raise ValueError("exchange_every > 1 is not supported "
+                                 "with kernel='pallas' (use xla, wrap "
+                                 "or halo)")
+            self.kernel_path = (f"xla-temporal[s={s_every}]"
+                                + ("-overlap" if self._overlap else ""))
+            self._build_temporal_step()
+            from ..utils.logging import LOG_INFO
+            LOG_INFO(f"jacobi kernel path: {self.kernel_path}")
             return
         self.kernel_path = f"{kernel}-overlap" if self._overlap else kernel
         step_fn = (jacobi_shard_step_overlap if self._overlap
@@ -308,9 +377,9 @@ class Jacobi3D:
             origin = shard_origin(local, rem)
             if self._overlap:
                 return step_fn(p, radius, counts, local, gsize,
-                               origin, method, kernel)
+                               origin, method, kernel, nonper)
             return step_fn(p, radius, counts, local, gsize,
-                           origin, method, kernel, rem)
+                           origin, method, kernel, rem, nonper)
 
         spec = P("z", "y", "x")
         sm = jax.shard_map(shard_step, mesh=dd.mesh, in_specs=spec,
@@ -323,6 +392,63 @@ class Jacobi3D:
         sm_n = jax.shard_map(shard_steps, mesh=dd.mesh, in_specs=(spec, P()),
                              out_specs=spec, check_vma=False)
         self._step_n = jax.jit(sm_n, donate_argnums=0)
+
+    def _build_temporal_step(self) -> None:
+        """Communication-avoiding XLA steps: iterations run in groups of
+        ``s = exchange_every`` through ``parallel/temporal.py`` — ONE
+        depth-``s`` exchange, then ``s`` fused 7-point sub-steps on the
+        shrinking window (ring cells recomputed redundantly, numerically
+        identical to step-by-step) — with a depth-1 tail for the
+        remainder. With ``overlap=True`` the deep exchange hides behind
+        sub-step 0's interior compute (even shards)."""
+        from ..parallel.exchange import shard_origin
+        from ..parallel.temporal import temporal_shard_steps, validate_temporal
+        from ..topology import Boundary
+
+        dd = self.dd
+        radius = dd.radius
+        counts = mesh_dim(dd.mesh)
+        local = dd.local_size
+        gsize = dd.size
+        method = pick_method(dd.methods)
+        rem = dd.rem
+        s = dd.exchange_every
+        nonper = dd.boundary == Boundary.NONE
+        overlap = self._overlap
+        hot_c, cold_c, sph_r = sphere_geometry(gsize)
+        validate_temporal(radius, local, s, rem)
+
+        def make_update(origin):
+            ox, oy, oz = origin
+
+            def update_fn(blocks, dims, off, k):
+                new = jacobi7(blocks["temp"], radius, dims)
+                org = (ox + off[0], oy + off[1], oz + off[2])
+                new = _apply_sources_windowed(new, org, dims, gsize, hot_c,
+                                              cold_c, sph_r, nonper)
+                return {"temp": new.astype(blocks["temp"].dtype)}
+
+            return update_fn
+
+        def shard_steps(p, n):
+            upd = make_update(shard_origin(local, rem))
+
+            def group(q, depth, ovl):
+                return temporal_shard_steps(
+                    {"temp": q}, radius, counts, method, upd, depth,
+                    alloc_steps=s, rem=rem, overlap=ovl,
+                    nonperiodic=nonper)["temp"]
+
+            p = lax.fori_loop(0, n // s, lambda _, q: group(q, s, overlap), p)
+            return lax.fori_loop(0, n % s,
+                                 lambda _, q: group(q, 1, False), p)
+
+        spec = P("z", "y", "x")
+        sm = jax.shard_map(shard_steps, mesh=dd.mesh, in_specs=(spec, P()),
+                           out_specs=spec, check_vma=False)
+        self._step_n = jax.jit(sm, donate_argnums=0)
+        self._step = jax.jit(
+            lambda p: sm(p, jnp.asarray(1, jnp.int32)), donate_argnums=0)
 
     def _build_wrap_step(self) -> None:
         """Single-chip fused steps on the interior view: iterations run
@@ -339,12 +465,12 @@ class Jacobi3D:
         from ..utils.config import wrap2_disabled
 
         dd = self.dd
-        lo = dd.radius.pad_lo()
+        lo = dd.alloc_radius.pad_lo()
         local = dd.local_size
         gsize = dd.size
         hot, cold, sph_r = sphere_geometry(gsize)
         tile = sublane_tile(self._dtype)
-        N = _wrap_steps(tile)
+        N = _wrap_steps(tile, self._exchange_every)
         pair_ok = (local.y % tile == 0 and N > 1
                    and not wrap2_disabled())
 
@@ -390,7 +516,7 @@ class Jacobi3D:
         from ..parallel.exchange import shard_origin
 
         dd = self.dd
-        lo = dd.radius.pad_lo()
+        lo = dd.alloc_radius.pad_lo()
         local = dd.local_size
         rem = dd.rem
 
@@ -451,7 +577,7 @@ class Jacobi3D:
         hot, cold, sph_r = sphere_geometry(dd.size)
         tile = sublane_tile(self._dtype)
         esub = tile if local.y % tile == 0 else 1
-        N = _wrap_steps(tile)
+        N = _wrap_steps(tile, self._exchange_every)
         pair_ok = (rem == Dim3(0, 0, 0) and N > 1 and esub == tile
                    and not wrap2_disabled())
         if pair_ok:
@@ -558,8 +684,9 @@ class Jacobi3D:
                         per_shard * n / cfg["per_iter_div"],
                     "rounds_per_iteration": 1.0 / cfg["per_iter_div"]}
         return {"path": path,
-                "bytes_per_iteration": float(self.dd.exchange_bytes_total()),
-                "rounds_per_iteration": 1.0}
+                "bytes_per_iteration":
+                    float(self.dd.exchange_bytes_amortized_per_step()),
+                "rounds_per_iteration": 1.0 / self.dd.exchange_every}
 
     def measure_exchange_seconds(self, reps: int = 10) -> float:
         """Estimated exchange seconds per ITERATION of the built path,
@@ -590,7 +717,8 @@ class Jacobi3D:
         for _ in range(reps):
             self.dd.exchange()
         device_sync(self.dd.curr["temp"])
-        return (time.perf_counter() - t0) / reps
+        # one (possibly deep) exchange feeds exchange_every iterations
+        return (time.perf_counter() - t0) / reps / self.dd.exchange_every
 
     def step(self) -> None:
         """One iteration: exchange + 7-point update + sources."""

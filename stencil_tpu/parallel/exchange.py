@@ -94,16 +94,34 @@ def shard_origin(local: Dim3, rem: Dim3) -> Tuple:
     return tuple(out)
 
 
+def _edge_masked(recv, side: int, axis_name: str, n_dev: int):
+    """Non-periodic boundary rule: the mesh-edge shard's halo on the
+    open side holds ZEROS (the zero-Dirichlet exterior), not the
+    wrapped-around neighbor's data the periodic ppermute ring delivered.
+    ``side`` is +1 for the hi-side halo (zeroed on the last shard) and
+    -1 for the lo-side halo (zeroed on shard 0). A 1-device axis has no
+    interior boundary at all: the whole halo is exterior, so it zeroes
+    unconditionally."""
+    if n_dev == 1:
+        return jnp.zeros_like(recv)
+    i = lax.axis_index(axis_name)
+    edge = (i == n_dev - 1) if side == 1 else (i == 0)
+    return jnp.where(edge, jnp.zeros_like(recv), recv)
+
+
 def exchange_shard(arr: jnp.ndarray, radius: Radius,
                    mesh_counts: Dim3,
                    axis_order: Tuple[int, ...] = (0, 1, 2),
-                   rem: Dim3 = Dim3(0, 0, 0)) -> jnp.ndarray:
+                   rem: Dim3 = Dim3(0, 0, 0),
+                   alloc_radius: "Radius | None" = None,
+                   nonperiodic: bool = False) -> jnp.ndarray:
     """Fill all halo regions of one padded shard via sequential axis
     sweeps. Must be traced inside ``shard_map`` over mesh axes
     ('x','y','z') when the corresponding mesh_counts entry is > 1.
 
     ``arr``: padded (z,y,x) block; interior *capacity* along grid axis a
-    is ``arr.shape[AXIS_TO_DIM[a]] - r_lo - r_hi``.
+    is ``arr.shape[AXIS_TO_DIM[a]] - p_lo - p_hi`` where the allocation
+    pads come from ``alloc_radius`` (default: ``radius``).
     ``mesh_counts``: subdomain count along each grid axis.
     ``rem``: per-axis remainder counts for uneven (+-1) subdomains
     (reference: partition.hpp:55-69). Shards allocate to the capacity;
@@ -111,33 +129,54 @@ def exchange_shard(arr: jnp.ndarray, radius: Radius,
     interior (dynamic position), keeping interior+halo contiguous so
     stencil reads stay static slices. The slack row at the top of a
     short shard's allocation is dead space.
+
+    ``alloc_radius``: when the allocation is padded deeper than this
+    exchange's wire depth (temporal blocking: the buffer carries
+    ``s x r`` pads but a tail step only refreshes the innermost ``r``
+    ring), pass the allocation's Radius here; the slabs then ship
+    ``radius`` rows placed immediately around the interior. Wire depth
+    must not exceed the allocation pads on any face.
+    ``nonperiodic``: zero-fill halos across the open global boundary
+    (``topology.Boundary.NONE`` — zero-Dirichlet exterior).
     """
+    alloc_r = alloc_radius if alloc_radius is not None else radius
     for a in axis_order:
         r_lo = radius.face(a, -1)
         r_hi = radius.face(a, 1)
         if r_lo == 0 and r_hi == 0:
             continue
+        p_lo = alloc_r.face(a, -1)
+        p_hi = alloc_r.face(a, 1)
+        assert p_lo >= r_lo and p_hi >= r_hi, \
+            (f"axis {a}: wire depth ({r_lo},{r_hi}) exceeds allocation "
+             f"pads ({p_lo},{p_hi})")
         dim = AXIS_TO_DIM[a]
         name = AXIS_NAME[a]
         n_dev = mesh_counts[a]
         alloc = arr.shape[dim]
-        interior = alloc - r_lo - r_hi
+        interior = alloc - p_lo - p_hi
         # actual interior length of this shard (traced when uneven)
         L = shard_interior_len(a, interior, rem)
 
-        # fill the hi-side halo [r_lo+L, r_lo+L+r_hi): data lives at the
-        # +a neighbor's interior lo edge [r_lo, r_lo + r_hi)
+        # fill the hi-side halo [p_lo+L, p_lo+L+r_hi): data lives at the
+        # +a neighbor's interior lo edge [p_lo, p_lo + r_hi)
         if r_hi > 0:
-            src = lax.slice_in_dim(arr, r_lo, r_lo + r_hi, axis=dim)
+            src = lax.slice_in_dim(arr, p_lo, p_lo + r_hi, axis=dim)
             recv = _shift_from_plus(src, name, n_dev)
-            arr = lax.dynamic_update_slice_in_dim(arr, recv, r_lo + L,
+            if nonperiodic:
+                recv = _edge_masked(recv, 1, name, n_dev)
+            arr = lax.dynamic_update_slice_in_dim(arr, recv, p_lo + L,
                                                   axis=dim)
-        # fill the lo-side halo [0, r_lo): data lives at the -a
-        # neighbor's interior hi edge [L, L + r_lo)
+        # fill the lo-side halo [p_lo-r_lo, p_lo): data lives at the -a
+        # neighbor's interior hi edge [p_lo + L - r_lo, p_lo + L)
         if r_lo > 0:
-            src = lax.dynamic_slice_in_dim(arr, L, r_lo, axis=dim)
+            src = lax.dynamic_slice_in_dim(arr, p_lo + L - r_lo, r_lo,
+                                           axis=dim)
             recv = _shift_from_minus(src, name, n_dev)
-            arr = lax.dynamic_update_slice_in_dim(arr, recv, 0, axis=dim)
+            if nonperiodic:
+                recv = _edge_masked(recv, -1, name, n_dev)
+            arr = lax.dynamic_update_slice_in_dim(arr, recv, p_lo - r_lo,
+                                                  axis=dim)
     return arr
 
 
@@ -257,7 +296,9 @@ def exchange_interior_slabs(p: jnp.ndarray, mesh_counts: Dim3,
 def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                           mesh_counts: Dim3,
                           axis_order: Tuple[int, ...] = (0, 1, 2),
-                          rem: Dim3 = Dim3(0, 0, 0)
+                          rem: Dim3 = Dim3(0, 0, 0),
+                          alloc_radius: "Radius | None" = None,
+                          nonperiodic: bool = False
                           ) -> Dict[str, jnp.ndarray]:
     """Multi-quantity exchange with per-direction packing: all
     quantities' slabs for one axis-direction are flattened and
@@ -276,7 +317,12 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
     halo lands immediately after the actual interior; packed buffer
     shapes stay static (capacity-sized slabs), so one program serves
     every shard.
+
+    ``alloc_radius``/``nonperiodic``: same contract as
+    :func:`exchange_shard` (deep-carry allocations for temporal
+    blocking; zero-Dirichlet exterior for ``Boundary.NONE``).
     """
+    alloc_r = alloc_radius if alloc_radius is not None else radius
     names = sorted(arrs.keys())  # sorted so both endpoints agree on
     # layout (reference sorts messages by size, src/packer.cu:69,182-183)
     out = {k: v for k, v in arrs.items()}
@@ -285,6 +331,11 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
         r_hi = radius.face(a, 1)
         if r_lo == 0 and r_hi == 0:
             continue
+        p_lo = alloc_r.face(a, -1)
+        p_hi = alloc_r.face(a, 1)
+        assert p_lo >= r_lo and p_hi >= r_hi, \
+            (f"axis {a}: wire depth ({r_lo},{r_hi}) exceeds allocation "
+             f"pads ({p_lo},{p_hi})")
         dim = AXIS_TO_DIM[a]
         name = AXIS_NAME[a]
         n_dev = mesh_counts[a]
@@ -303,23 +354,25 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                 for q in qs:
                     arr = out[q]
                     alloc = arr.shape[dim]
-                    interior = alloc - r_lo - r_hi
+                    interior = alloc - p_lo - p_hi
                     L = shard_interior_len(a, interior, rem)
                     if side == 1:
-                        src = lax.slice_in_dim(arr, r_lo, r_lo + r_hi, axis=dim)
+                        src = lax.slice_in_dim(arr, p_lo, p_lo + r_hi, axis=dim)
                     elif uneven_axis:
                         # hi edge of a short shard sits at its actual
-                        # interior end [L, L + r_lo)
-                        src = lax.dynamic_slice_in_dim(arr, L, r_lo,
-                                                       axis=dim)
+                        # interior end [p_lo + L - r_lo, p_lo + L)
+                        src = lax.dynamic_slice_in_dim(arr, p_lo + L - r_lo,
+                                                       r_lo, axis=dim)
                     else:
-                        src = lax.slice_in_dim(arr, interior, r_lo + interior,
-                                               axis=dim)
+                        src = lax.slice_in_dim(arr, p_lo + interior - r_lo,
+                                               p_lo + interior, axis=dim)
                     shapes.append(src.shape)
                     slabs.append(src.reshape(-1))
                 packed = jnp.concatenate(slabs) if len(slabs) > 1 else slabs[0]
                 moved = (_shift_from_plus(packed, name, n_dev) if side == 1
                          else _shift_from_minus(packed, name, n_dev))
+                if nonperiodic:
+                    moved = _edge_masked(moved, side, name, n_dev)
                 # unpack
                 off = 0
                 for q, shp in zip(qs, shapes):
@@ -329,12 +382,12 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                     off += cnt
                     arr = out[q]
                     alloc = arr.shape[dim]
-                    interior = alloc - r_lo - r_hi
+                    interior = alloc - p_lo - p_hi
                     if side == 1:
                         L = shard_interior_len(a, interior, rem)
-                        start = r_lo + L
+                        start = p_lo + L
                     else:
-                        start = 0
+                        start = p_lo - r_lo
                     out[q] = lax.dynamic_update_slice_in_dim(arr, recv, start,
                                                              axis=dim)
     return out
@@ -389,34 +442,49 @@ def _single_axis_radius(radius: Radius, axis: int) -> Radius:
 def dispatch_exchange(fields: Dict[str, jnp.ndarray], radius: Radius,
                       mesh_counts: Dim3, method: Method,
                       axis_order: Tuple[int, ...] = (0, 1, 2),
-                      rem: Dim3 = Dim3(0, 0, 0)) -> Dict[str, jnp.ndarray]:
+                      rem: Dim3 = Dim3(0, 0, 0),
+                      alloc_radius: "Radius | None" = None,
+                      nonperiodic: bool = False) -> Dict[str, jnp.ndarray]:
     """Route a multi-quantity shard exchange to the selected strategy —
     the single dispatch point shared by the orchestrator and the fused
-    model steps (the Method-routing analog of src/stencil.cu:371-458)."""
+    model steps (the Method-routing analog of src/stencil.cu:371-458).
+
+    ``alloc_radius``/``nonperiodic`` (ppermute methods only): deep-carry
+    allocations for temporal blocking and the zero-Dirichlet exterior
+    of ``Boundary.NONE`` — see :func:`exchange_shard`."""
     uneven = rem != Dim3(0, 0, 0)
     if uneven and method not in (Method.PpermuteSlab,
                                  Method.PpermutePacked):
         raise NotImplementedError(
             f"uneven (+-1 remainder) subdomains are only supported by "
             f"the PpermuteSlab and PpermutePacked methods, not {method}")
+    if ((alloc_radius is not None or nonperiodic)
+            and method not in (Method.PpermuteSlab, Method.PpermutePacked)):
+        raise NotImplementedError(
+            f"deep-carry allocations and non-periodic boundaries are "
+            f"only supported by the PpermuteSlab and PpermutePacked "
+            f"methods, not {method}")
     if method == Method.PallasDMA:
         from .pallas_exchange import exchange_shard_pallas
         return {k: exchange_shard_pallas(v, radius, mesh_counts, axis_order)
                 for k, v in fields.items()}
     if method == Method.PpermutePacked:
         return exchange_shard_packed(fields, radius, mesh_counts,
-                                     axis_order, rem)
+                                     axis_order, rem, alloc_radius,
+                                     nonperiodic)
     if method == Method.AllGather:
         return {k: exchange_shard_allgather(v, radius, mesh_counts, axis_order)
                 for k, v in fields.items()}
-    return {k: exchange_shard(v, radius, mesh_counts, axis_order, rem)
+    return {k: exchange_shard(v, radius, mesh_counts, axis_order, rem,
+                              alloc_radius, nonperiodic)
             for k, v in fields.items()}
 
 
 def make_exchange(mesh: Mesh, radius: Radius,
                   methods: Method = Method.Default,
                   axis_order: Tuple[int, ...] = (0, 1, 2),
-                  rem: Dim3 = Dim3(0, 0, 0)):
+                  rem: Dim3 = Dim3(0, 0, 0),
+                  nonperiodic: bool = False):
     """Build a jitted multi-quantity halo exchange over ``mesh``.
 
     Returns ``exchange(fields: dict[str, Array]) -> dict[str, Array]``
@@ -425,6 +493,12 @@ def make_exchange(mesh: Mesh, radius: Radius,
     DistributedDomain::exchange() (reference: src/stencil.cu:1002-1186)
     — except the whole dance (pack, send, poll, unpack, sync) is one
     XLA program.
+
+    The input fields are DONATED: the exchange updates halos in place
+    (XLA aliases each output to its input buffer), so the per-call HBM
+    copy of every field disappears. Callers must drop their references
+    to the passed arrays (``DistributedDomain.exchange`` rebinds
+    ``curr`` from the result).
     """
     method = pick_method(methods)
     counts = Dim3(mesh.shape["x"], mesh.shape["y"], mesh.shape["z"])
@@ -432,11 +506,11 @@ def make_exchange(mesh: Mesh, radius: Radius,
 
     def shard_fn(fields: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         return dispatch_exchange(fields, radius, counts, method, axis_order,
-                                 rem)
+                                 rem, nonperiodic=nonperiodic)
 
     sm = jax.shard_map(shard_fn, mesh=mesh,
                        in_specs=spec, out_specs=spec, check_vma=False)
-    return jax.jit(sm)
+    return jax.jit(sm, donate_argnums=0)
 
 
 def interior_slab_bytes(shard_zyx: Sequence[int], mesh_counts: Dim3,

@@ -88,7 +88,8 @@ def _region_blocks(fields: Dict[str, jnp.ndarray], radius: Radius,
 
 def overlapped_update(fields: Dict[str, jnp.ndarray], radius: Radius,
                       mesh_counts: Dim3, method: Method,
-                      update_fn: UpdateFn
+                      update_fn: UpdateFn,
+                      nonperiodic: bool = False
                       ) -> Tuple[Dict[str, jnp.ndarray],
                                  Dict[str, jnp.ndarray]]:
     """Run ``update_fn`` over the interior/exterior decomposition with
@@ -109,7 +110,8 @@ def overlapped_update(fields: Dict[str, jnp.ndarray], radius: Radius,
 
     # exchange starts here; inner compute below reads only pre-exchange
     # owned data, so XLA may overlap the two
-    fields_ex = dispatch_exchange(fields, radius, mesh_counts, method)
+    fields_ex = dispatch_exchange(fields, radius, mesh_counts, method,
+                                  nonperiodic=nonperiodic)
 
     pieces: List[Tuple[Dim3, Dim3, Dict[str, jnp.ndarray]]] = []
     for off, dims in inner:

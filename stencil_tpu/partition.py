@@ -213,6 +213,24 @@ def sweep_wire_bytes(part: RankPartition, radius: Radius,
     return out
 
 
+def temporal_sweep_wire_bytes(part: RankPartition, radius: Radius,
+                              elem_size: int, steps: int) -> dict:
+    """Amortized per-STEP whole-mesh wire bytes under ``steps``-deep
+    temporal blocking: one ``radius.deepened(steps)`` exchange feeds
+    ``steps`` stencil steps, so each step is charged ``1/steps`` of the
+    deep sweep. The deep slabs are priced on the DEEPENED padded
+    cross-sections (slabs span the full allocation of the other two
+    axes — exactly what the static-shape ppermute program moves), which
+    is why amortized bytes do not drop ``steps``x: rows amortize to the
+    base count but cross-sections grow by ``2*steps*r`` per axis. The
+    win is the ``steps``x cut in exchange ROUNDS; see
+    ``analysis.costmodel.predict_exchange_every`` for the crossover.
+    Returns per-axis + total floats (``steps == 1`` reproduces
+    ``sweep_wire_bytes``)."""
+    deep = sweep_wire_bytes(part, radius.deepened(steps), elem_size)
+    return {k: v / steps for k, v in deep.items()}
+
+
 def halo_byte_model(part: RankPartition, radius: Radius,
                     elem_size: int) -> dict:
     """The reference's per-message byte-placement model: for every

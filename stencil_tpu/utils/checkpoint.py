@@ -84,8 +84,10 @@ def _interior_fns(dd):
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
-    lo = dd.radius.pad_lo()
-    hi = dd.radius.pad_hi()
+    # allocation pads, not the stencil radius: temporal blocking
+    # (set_exchange_every) deepens the buffers to s*r per side
+    lo = dd.alloc_radius.pad_lo()
+    hi = dd.alloc_radius.pad_hi()
     local = dd.local_size
     spec = P("z", "y", "x")
 

@@ -102,4 +102,13 @@ def exchange_stats_report(dd) -> str:
     if expected and tm > 0:
         line += (f" expected={expected}B/exchange (analytic)"
                  f" eff={expected / tm / 1e9:.2f}GB/s")
+    # temporal blocking: one deep exchange feeds s steps — report the
+    # per-STEP amortization (same analytic byte source as the deep
+    # figure above and the static analyzer's cross-check)
+    s = getattr(dd, "exchange_every", 1)
+    if s > 1 and expected and tm > 0:
+        amortized = dd.exchange_bytes_amortized_per_step()
+        line += (f" exchange_every={s}"
+                 f" amortized={amortized:.0f}B/step"
+                 f" ({tm / s:.6e}s/step exchange cost)")
     return line

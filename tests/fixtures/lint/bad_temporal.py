@@ -1,0 +1,51 @@
+"""Negative control for the footprint checker: temporal blocking gone
+wrong.
+
+A 2-step blocked jacobi group declares the deepened contract — the
+exchange ships a depth-2 halo (``Radius.constant(1).deepened(2)``) —
+but sub-step 0's window forgot to shrink: it computes the FULL
+depth-2-valid region instead of the one-ring-smaller window, so its
+stencil reads reach depth 3 into halo data that the deep exchange
+never delivered. The footprint checker must prove the fused program's
+total static reach exceeds the deepened declaration (the exact bug
+class ``parallel/temporal.py``'s shrinking-window schedule exists to
+prevent).
+"""
+
+import jax
+
+from stencil_tpu.analysis.footprint import StencilOpSpec, StencilOpTarget
+from stencil_tpu.geometry import Dim3, Radius
+
+
+def _f32(shape):
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _temporal_overreach_spec() -> StencilOpSpec:
+    from stencil_tpu.ops.stencil_kernels import jacobi7
+
+    interior = Dim3(8, 8, 8)
+    declared = Radius.constant(1).deepened(2)   # the deep halo contract
+    pad = Dim3(3, 3, 3)                         # buffer padded deeper
+    r1 = Radius.constant(1)
+
+    def fused(p):
+        # sub-step 0 BUG: window [1, 13) (all depth-2-valid cells)
+        # instead of [2, 12) — the 7-point reads span [0, 14), depth 3
+        w0 = jacobi7(p, r1, Dim3(12, 12, 12))
+        # sub-step 1: correct shrink to the interior window
+        w1 = jacobi7(w0, r1, Dim3(10, 10, 10))
+        return w1[1:9, 1:9, 1:9]
+
+    return StencilOpSpec(fn=fused, args=(_f32((14, 14, 14)),),
+                         radius=declared, interior=interior,
+                         pad_lo=pad, pad_hi=pad)
+
+
+TARGETS = [
+    StencilOpTarget("fixture.temporal_substep_reads_past_deep_halo",
+                    _temporal_overreach_spec),
+]

@@ -41,7 +41,7 @@ def test_shipped_registry_is_clean():
 def test_checker_filter():
     report = run_targets(default_targets(), checkers=["collectives"])
     assert report.ok
-    assert all(t.startswith("parallel.exchange")
+    assert all(t.startswith(("parallel.exchange", "parallel.temporal"))
                for t in report.targets_checked)
     with pytest.raises(ValueError):
         run_targets([], checkers=["nope"])
@@ -114,6 +114,20 @@ def test_footprint_fixture_flagged():
     assert any("(0, 1, 0)" in f.message and "required 2" in f.message
                for f in report.errors
                if f.target == "fixture.laundered_through_elementwise")
+
+
+def test_temporal_fixture_flagged():
+    """A blocked kernel whose sub-step window forgot to shrink reads
+    depth 3 against a deepened depth-2 halo contract — the footprint
+    checker must catch the fused program's total reach."""
+    report = run_targets(load_targets(FIXTURES / "bad_temporal.py"))
+    assert not report.ok
+    errs = [f for f in report.errors
+            if f.target == "fixture.temporal_substep_reads_past_deep_halo"]
+    assert any("(0, 0, 1)" in f.message
+               and "declared radius 2 < required 3" in f.message
+               for f in errs), [str(f) for f in errs]
+    assert any("(0, 0, -1)" in f.message for f in errs)
 
 
 def test_dma_fixture_flagged():
@@ -249,7 +263,7 @@ def test_cli_list_and_only(capsys, tmp_path):
 
 @pytest.mark.parametrize("fixture", ["bad_footprint.py", "bad_dma.py",
                                      "bad_collective.py", "bad_hlo.py",
-                                     "bad_vmem.py"])
+                                     "bad_vmem.py", "bad_temporal.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""
