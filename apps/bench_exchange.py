@@ -53,6 +53,15 @@ def main() -> None:
     ap.add_argument("--json-out", default="", metavar="PATH",
                     help="write the steps/s + byte-model comparison "
                          "as a JSON artifact")
+    ap.add_argument("--autotune", action="store_true",
+                    help="run the exchange autotuner (measured plan, "
+                         "stencil_tpu/tuning) and compare tuned vs "
+                         "Method.Default steps/s on the real blocked "
+                         "Jacobi loop")
+    ap.add_argument("--tune-cache", default="", metavar="PATH",
+                    help="plan cache file for --autotune (default: "
+                         "$STENCIL_TUNE_CACHE or "
+                         "~/.cache/stencil_tpu/plans.json)")
     add_method_flags(ap)
     add_device_flags(ap)
     args = ap.parse_args()
@@ -72,6 +81,24 @@ def main() -> None:
     gx, gy, gz = (args.x * mesh_shape.x, args.y * mesh_shape.y,
                   args.z * mesh_shape.z)
     depths = _parse_depths(args.exchange_every)
+
+    def jacobi_steps_per_s(methods, s):
+        """Honest steps/s of the REAL blocked hot path: the Jacobi
+        model's fused run loop (deep exchange + sub-steps incl. the
+        redundant ring compute) under the given configuration."""
+        j = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape,
+                     dtype=np.float32, kernel="xla", methods=methods,
+                     exchange_every=s if s > 1 else None)
+        j.init()
+        n = max(args.iters, s)
+        n -= n % s  # whole groups so configs compare the same work
+        j.run(s)    # compile + warm outside the timed window
+        j.block()
+        t0 = time.perf_counter()
+        j.run(n)
+        j.block()
+        dt = time.perf_counter() - t0
+        return n, dt, n / dt, j
 
     results = []
     for s in depths:
@@ -98,18 +125,7 @@ def main() -> None:
         # honest steps/s: the REAL blocked hot path (deep exchange +
         # fused sub-steps incl. the redundant ring compute), via the
         # Jacobi model's radius-1 run loop on the same grid
-        j = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape, dtype=np.float32,
-                     kernel="xla", methods=methods_from_args(args),
-                     exchange_every=s if s > 1 else None)
-        j.init()
-        n = max(args.iters, s)
-        n -= n % s  # whole groups so configs compare the same work
-        j.run(s)    # compile + warm outside the timed window
-        j.block()
-        t0 = time.perf_counter()
-        j.run(n)
-        j.block()
-        dt = time.perf_counter() - t0
+        n, dt, _, j = jacobi_steps_per_s(methods_from_args(args), s)
         xs = j.exchange_stats()
         results.append({
             "exchange_every": s,
@@ -127,6 +143,51 @@ def main() -> None:
               f"(jacobi blocked loop) rounds/step={1.0 / s:.3f} "
               f"amortized={dd.exchange_bytes_amortized_per_step():.0f}"
               f"B/step (model)", file=sys.stderr)
+
+    autotune_cmp = None
+    if args.autotune:
+        # tune for the Jacobi hot-path problem itself (radius 1, one
+        # f32 field — the configuration the steps/s claim is about),
+        # then race the MEASURED plan against the static Method.Default
+        # on the real blocked loop
+        from stencil_tpu.distributed import DistributedDomain
+        from stencil_tpu.parallel.methods import Method
+        from stencil_tpu.utils.profiling import autotune_report
+
+        dd = DistributedDomain(gx, gy, gz)
+        dd.set_mesh_shape(mesh_shape)
+        dd.set_radius(1)
+        dd.add_data("temp", np.float32)
+        plan = dd.autotune(cache_path=args.tune_cache or None)
+        print(autotune_report(plan), file=sys.stderr)
+
+        # reuse the sweep's s=1 row as the baseline when it already
+        # measured exactly Method.Default (no method flags, depth 1
+        # swept) instead of re-compiling the same configuration
+        base_row = next(
+            (r for r in results if r["exchange_every"] == 1
+             and methods_from_args(args) == Method.Default), None)
+        if base_row is not None:
+            base_sps = base_row["steps_per_s"]
+        else:
+            _, _, base_sps, _ = jacobi_steps_per_s(Method.Default, 1)
+        tuned_m = Method[plan.config.method]
+        _, _, tuned_sps, _ = jacobi_steps_per_s(
+            tuned_m, plan.config.exchange_every)
+        autotune_cmp = {
+            "plan": plan.to_record(),
+            "default_steps_per_s": base_sps,
+            "tuned_steps_per_s": tuned_sps,
+            "tuned_over_default": tuned_sps / base_sps,
+        }
+        print(csv_line("bench_exchange_autotune", plan.config.key(),
+                       plan.provenance, f"{base_sps:.3f}",
+                       f"{tuned_sps:.3f}",
+                       f"{tuned_sps / base_sps:.3f}"))
+        print(f"bench_exchange autotune: tuned {plan.config.key()} "
+              f"({plan.provenance}) {tuned_sps:.3f} steps/s vs default "
+              f"{base_sps:.3f} steps/s "
+              f"(x{tuned_sps / base_sps:.2f})", file=sys.stderr)
 
     if args.json_out:
         base = results[0]
@@ -152,6 +213,8 @@ def main() -> None:
                 k: r["steps_per_s"] / base["steps_per_s"]
                 for k, r in results_by_s.items()},
         }
+        if autotune_cmp is not None:
+            comparison["autotune"] = autotune_cmp
         with open(args.json_out, "w") as f:
             json.dump(comparison, f, indent=2)
         print(f"bench_exchange: wrote {args.json_out}", file=sys.stderr)

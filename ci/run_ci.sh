@@ -23,7 +23,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
-echo "== 1/6 lint (stencil-lint + ruff; tier=$TIER) =="
+echo "== 1/7 lint (stencil-lint + ruff; tier=$TIER) =="
 # stencil-lint: all six static checkers — halo-radius footprint, DMA
 # discipline, ppermute sanity, HLO collective-permute-only lowering,
 # analytic-vs-HLO byte cross-check, and the Pallas VMEM/tiling audit
@@ -63,10 +63,10 @@ if [ "$TIER" = "full" ]; then
   fi
 fi
 
-echo "== 2/6 native build =="
+echo "== 2/7 native build =="
 bash ci/build.sh
 
-echo "== 3/6 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+echo "== 3/7 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
 # The full tier is dominated by interpret-mode Pallas parity tests
 # (CPU-bound, independent): fan them out with pytest-xdist when the
 # machine has cores to spare. Each worker process builds its own
@@ -82,7 +82,7 @@ else
   python -m pytest tests/ -q --maxfail=1 -m "not slow"
 fi
 
-echo "== 4/6 app smoke runs =="
+echo "== 4/7 app smoke runs =="
 # overlap app smokes execute remote DMA: possible only on a TPU or
 # with the distributed (mosaic) interpreter — probe, don't assume
 RDMA_OK=$(python -c "from stencil_tpu._compat import remote_dma_runnable
@@ -107,20 +107,24 @@ smoke() { echo "-- $*"; python "$@" > /dev/null; }
   smoke bench_qap.py --sizes 4 6
 )
 
-echo "== 5/6 bench smoke: temporal blocking (exchange_every 1 vs 4) =="
+echo "== 5/7 bench smoke: temporal blocking + autotuned plan =="
 # communication-avoiding temporal blocking must not regress steps/s of
 # the REAL blocked hot path (Jacobi3D's fused run loop, redundant ring
 # compute included) on the fake CPU mesh; the amortized byte model
 # (cross-checked against HLO by stencil-lint's costmodel checker) is
-# archived next to the measured numbers. The JSON pins the exchange-
-# rounds-per-step 4x cut and the steps/s comparison; it is written to
-# a scratch path (the committed BENCH_pr3.json records the PR-time
-# numbers and must not churn on every CI run) and archived to
-# $CI_ARTIFACT_DIR when a trigger provides one.
-BENCH_JSON="$(mktemp -t BENCH_pr3.XXXXXX.json)"
+# archived next to the measured numbers. --autotune additionally races
+# the MEASURED plan against Method.Default on the same loop. The JSON
+# pins the exchange-rounds-per-step 4x cut and both steps/s
+# comparisons; it is written to a scratch path (the committed
+# BENCH_pr4.json records the PR-time numbers and must not churn on
+# every CI run) and archived to $CI_ARTIFACT_DIR when a trigger
+# provides one.
+BENCH_JSON="$(mktemp -t BENCH_pr4.XXXXXX.json)"
+TUNE_CACHE="$(mktemp -t tune_cache.XXXXXX.json)"; rm -f "$TUNE_CACHE"
 ( cd apps
   python bench_exchange.py --x 8 --y 8 --z 8 --iters 20 --fake-cpu 8 \
-        --exchange-every 1,4 --json-out "$BENCH_JSON" )
+        --exchange-every 1,4 --autotune --tune-cache "$TUNE_CACHE" \
+        --json-out "$BENCH_JSON" )
 BENCH_JSON="$BENCH_JSON" python - <<'EOF'
 import json
 import os
@@ -130,16 +134,55 @@ speed = d["steps_per_s_ratio"]
 assert abs(rounds["4"] - 0.25) < 1e-9, rounds
 # steps/s of the blocked loop must not regress beyond run-to-run noise
 assert speed["4"] > 0.8, speed
+# the MEASURED tuned plan must not lose to the static default beyond
+# noise (the committed BENCH_pr4.json pins the PR-time tuned >= default)
+at = d["autotune"]
+assert at["plan"]["provenance"] in ("tuned", "cached"), at["plan"]
+assert at["tuned_over_default"] > 0.8, at
 print(f"bench smoke OK: rounds/step x{1/rounds['4']:.0f} fewer, "
-      f"steps/s ratio {speed['4']:.2f}")
+      f"steps/s ratio {speed['4']:.2f}, tuned/default "
+      f"x{at['tuned_over_default']:.2f} "
+      f"({at['plan']['config']['method']}"
+      f"[s={at['plan']['config']['exchange_every']}])")
 EOF
 if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
   mkdir -p "$CI_ARTIFACT_DIR"
-  cp "$BENCH_JSON" "$CI_ARTIFACT_DIR/BENCH_pr3.json"
+  cp "$BENCH_JSON" "$CI_ARTIFACT_DIR/BENCH_pr4.json"
 fi
-rm -f "$BENCH_JSON"
+rm -f "$BENCH_JSON" "$TUNE_CACHE"
 
-echo "== 6/6 multi-chip certification sweep =="
+echo "== 6/7 exchange autotuner (fake timer: search/fit/plan/cache) =="
+# the tuner's whole pipeline with deterministic fake measurements (no
+# hardware dependence): first invocation tunes and writes the plan
+# cache, the second MUST be a cache hit performing zero measurements.
+# The plan JSON is the CI artifact.
+TUNE_CACHE="$(mktemp -t tune_cache.XXXXXX.json)"; rm -f "$TUNE_CACHE"
+PLAN1="$(mktemp -t tune_plan1.XXXXXX.json)"
+PLAN2="$(mktemp -t tune_plan2.XXXXXX.json)"
+python -m stencil_tpu.tune --x 64 --y 64 --z 64 --fields 2 --fake-cpu 8 \
+  --fake-timer --cache "$TUNE_CACHE" --json "$PLAN1"
+python -m stencil_tpu.tune --x 64 --y 64 --z 64 --fields 2 --fake-cpu 8 \
+  --fake-timer --cache "$TUNE_CACHE" --json "$PLAN2"
+PLAN1="$PLAN1" PLAN2="$PLAN2" python - <<'EOF'
+import json
+import os
+p1 = json.load(open(os.environ["PLAN1"]))
+p2 = json.load(open(os.environ["PLAN2"]))
+assert p1["provenance"] == "tuned" and p1["measurements"] > 0, p1
+assert p2["provenance"] == "cached" and p2["measurements"] == 0, p2
+assert p1["config"] == p2["config"], (p1["config"], p2["config"])
+print(f"autotune smoke OK: {p1['config']['method']}"
+      f"[s={p1['config']['exchange_every']}] tuned with "
+      f"{p1['measurements']} measurements; second run cache hit "
+      f"with 0")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+  mkdir -p "$CI_ARTIFACT_DIR"
+  cp "$PLAN1" "$CI_ARTIFACT_DIR/tuned_plan.json"
+fi
+rm -f "$TUNE_CACHE" "$PLAN1" "$PLAN2"
+
+echo "== 7/7 multi-chip certification sweep =="
 python __graft_entry__.py 8 | tail -1
 
 echo "CI PASSED"

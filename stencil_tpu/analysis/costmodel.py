@@ -170,6 +170,107 @@ def predict_exchange_every(shard_interior_zyx: Sequence[int], radius,
     return min(costs, key=costs.get), costs
 
 
+@dataclasses.dataclass(frozen=True)
+class LinkCoefficients:
+    """Alpha-beta coefficients of one link class (ICI or DCN): the
+    per-collective launch+hop latency and the sustained wire rate. The
+    assumed defaults below are deliberately coarse; the exchange
+    autotuner (:mod:`stencil_tpu.tuning`) replaces them with MEASURED
+    values (pingpong fit) so :func:`predict_exchange_every`,
+    :func:`temporal_step_exchange_seconds` and
+    :func:`configured_step_seconds` price the actual machine."""
+
+    alpha_s: float        # seconds of latency per collective message
+    beta_bytes_per_s: float  # sustained bytes/s one shard can put on the wire
+
+    def seconds(self, messages: int, wire_bytes: float) -> float:
+        return messages * self.alpha_s + wire_bytes / self.beta_bytes_per_s
+
+
+#: assumed (un-measured) constants — roughly a TPU ICI hop; the tuner
+#: overwrites these with the pingpong fit before ranking anything
+DEFAULT_ICI_COEFFS = LinkCoefficients(alpha_s=20e-6,
+                                      beta_bytes_per_s=4.5e10)
+
+
+def exchange_round_model(method_name: str,
+                         shard_interior_zyx: Sequence[int], radius,
+                         counts, elem_sizes: Sequence[int],
+                         steps: int = 1,
+                         dtype_groups: "int | None" = None
+                         ) -> Tuple[int, int]:
+    """Analytic (messages, wire_bytes) ONE shard contributes per deep
+    exchange round under strategy ``method_name`` — the per-method
+    refinement of :func:`deep_exchange_bytes_per_shard` the autotuner
+    ranks candidate plans with:
+
+    * ``PpermuteSlab`` / ``PallasDMA``: one message per active
+      axis-direction per quantity; halo bytes.
+    * ``PpermutePacked``: quantities concatenate per direction — one
+      message per active axis-direction per DTYPE GROUP; same bytes
+      (packing changes launches, not payload).
+    * ``AllGather``: one collective per active axis-direction per
+      quantity, but the ring moves ``(n_axis - 1)x`` the slab bytes
+      (every shard's slab visits every device).
+
+    ``elem_sizes``: one element size per quantity. ``steps`` > 1 prices
+    the DEEPENED (temporal-blocking) round. ``dtype_groups``: the
+    packed engine concatenates per DTYPE (f32 and i32 pack separately
+    despite equal sizes — parallel/exchange.py groups by ``.dtype``);
+    pass the distinct-dtype count when known, else it is approximated
+    by the distinct element sizes.
+    """
+    from ..parallel.exchange import exchanged_bytes_per_sweep
+
+    deep = radius.deepened(steps)
+    lo, hi = deep.pad_lo(), deep.pad_hi()
+    z, y, x = shard_interior_zyx
+    padded = (z + lo.z + hi.z, y + lo.y + hi.y, x + lo.x + hi.x)
+
+    directions = 0          # active axis-directions crossing devices
+    gather_factor = {}      # axis name -> (n_axis - 1) ring multiplier
+    for a, name in ((0, "x"), (1, "y"), (2, "z")):
+        if counts[a] <= 1:
+            continue
+        for side in (-1, 1):
+            if deep.face(a, side) > 0:
+                directions += 1
+        gather_factor[name] = counts[a] - 1
+
+    if method_name == "PpermutePacked":
+        groups = (int(dtype_groups) if dtype_groups
+                  else len(set(elem_sizes)))
+        messages = directions * groups
+    else:
+        messages = directions * len(elem_sizes)
+
+    nbytes = 0
+    for esize in elem_sizes:
+        per_axis = exchanged_bytes_per_sweep(padded, deep, counts, esize)
+        for name, b in per_axis.items():
+            if method_name == "AllGather":
+                b *= gather_factor.get(name, 1)
+            nbytes += b
+    return messages, nbytes
+
+
+def configured_step_seconds(method_name: str,
+                            shard_interior_zyx: Sequence[int], radius,
+                            counts, elem_sizes: Sequence[int],
+                            steps: int,
+                            coeffs: LinkCoefficients = DEFAULT_ICI_COEFFS,
+                            dtype_groups: "int | None" = None) -> float:
+    """Alpha-beta exchange seconds per STEP of one (method,
+    exchange_every) configuration: the deep round's cost spread over
+    the ``steps`` steps it feeds — :func:`temporal_step_exchange_seconds`
+    generalized across exchange strategies. The autotuner calls this
+    with MEASURED coefficients to prune the sweep before timing."""
+    messages, nbytes = exchange_round_model(
+        method_name, shard_interior_zyx, radius, counts, elem_sizes,
+        steps, dtype_groups)
+    return coeffs.seconds(messages, nbytes) / steps
+
+
 @dataclasses.dataclass
 class CostModelSpec:
     """A jittable exchange program plus its analytic byte expectation.

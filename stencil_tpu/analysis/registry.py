@@ -536,6 +536,80 @@ def _rdma_hlo_spec() -> HloSpec:
 
 
 # ---------------------------------------------------------------------------
+# tuning-plan targets: every exchange configuration the autotuner
+# (stencil_tpu/tuning) can EMIT — Method x exchange_every over the
+# plan's depth set — built exactly the way plan application deploys
+# them (make_exchange on the deepened radius). Whatever plan the tuner
+# picks, its data path is already under the HLO ppermute-only gate and
+# the analytic byte cross-check; a tuned win can never smuggle in an
+# unaudited lowering. (PallasDMA plans exist only where the RDMA
+# engine is runnable; its path is audited by the
+# parallel.pallas_exchange targets above, aliased below so the
+# coverage manifest's Auto entry maps to live targets.)
+
+_PLAN_INTERIOR = 8
+
+
+def _plan_depths():
+    from ..tuning.plan import DEFAULT_DEPTHS
+
+    return DEFAULT_DEPTHS
+
+
+def _plan_exchange_spec(method_name: str, s: int) -> CollectiveSpec:
+    from ..geometry import Radius
+    from ..parallel.exchange import make_exchange
+    from ..parallel.methods import Method
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    deep = Radius.constant(1).deepened(s)
+    ex = make_exchange(mesh, deep, Method[method_name])
+    side = _PLAN_INTERIOR + 2 * s
+    g = tuple(side * m for m in _EXCHANGE_MESH)
+    return CollectiveSpec(fn=ex, args=({"q": _f32(g)},),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=(method_name != "AllGather"))
+
+
+def _plan_exchange_hlo(method_name: str, s: int) -> HloSpec:
+    allow = (("all_gather",) if method_name == "AllGather"
+             else ("collective_permute",))
+    return _hlo_from_collective(
+        lambda: _plan_exchange_spec(method_name, s), allow=allow)
+
+
+def _plan_exchange_cost(method_name: str, s: int) -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+
+    cs = _plan_exchange_spec(method_name, s)
+    side = _PLAN_INTERIOR + 2 * s
+    expected = _sweep_bytes((side, side, side),
+                            Radius.constant(1).deepened(s),
+                            Dim3(*_EXCHANGE_MESH), 4)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _plan_targets() -> List[Target]:
+    targets: List[Target] = []
+    emittable = ([("PpermuteSlab", s) for s in _plan_depths()]
+                 + [("PpermutePacked", s) for s in _plan_depths()]
+                 + [("AllGather", 1)])
+    for method, s in emittable:
+        targets.append(HloTarget(
+            f"tuning.plan[{method},s={s},hlo]",
+            lambda m=method, d=s: _plan_exchange_hlo(m, d)))
+        targets.append(CostModelTarget(
+            f"tuning.plan[{method},s={s},cost]",
+            lambda m=method, d=s: _plan_exchange_cost(m, d)))
+    # the RDMA plan path (emittable on TPU only) — same audited spec
+    # as parallel.pallas_exchange.exchange_shard_pallas[hlo]
+    targets.append(HloTarget("tuning.plan[PallasDMA,s=1,hlo]",
+                             _rdma_hlo_spec))
+    return targets
+
+
+# ---------------------------------------------------------------------------
 # VMEM targets: every shipped Pallas kernel's static memory/tiling
 # audit. The overlap/RDMA builders are shared with the dma targets;
 # the single-chip wrap/halo fast-path kernels (previously outside the
@@ -796,6 +870,8 @@ def default_targets() -> List[Target]:
         CostModelTarget("parallel.exchange.exchange_shard[deep-tail,cost]",
                         _deep_tail_exchange_cost),
     ]
+    # every exchange configuration the autotuner can emit (Method.Auto)
+    targets += _plan_targets()
     # static VMEM/tiling audit: every shipped Pallas kernel
     targets += [
         VmemTarget("parallel.pallas_exchange.exchange_shard_pallas[vmem]",

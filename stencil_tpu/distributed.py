@@ -71,6 +71,9 @@ class DistributedDomain:
         self._dcn_groups = None
         self.dcn_axis: Optional[int] = None
         self.n_slices: int = 1
+        # exchange autotuning (stencil_tpu/tuning): the adopted Plan,
+        # or None when the static Method priority list decided
+        self.plan = None
         # populated by realize()
         self.mesh = None
         self.placement: Optional[Placement] = None
@@ -169,27 +172,75 @@ class DistributedDomain:
         self._timing = on
 
     # ------------------------------------------------------------------
-    # realize (reference: src/stencil.cu:241-850)
+    # exchange autotuning (stencil_tpu/tuning)
     # ------------------------------------------------------------------
-    def realize(self) -> None:
-        assert self._names, "add_data at least one quantity before realize()"
-        if self.boundary not in (Boundary.PERIODIC, Boundary.NONE):
-            raise NotImplementedError(f"unsupported boundary {self.boundary}")
-        if self.boundary == Boundary.NONE and pick_method(self.methods) not \
-                in (Method.PpermuteSlab, Method.PpermutePacked):
-            raise NotImplementedError(
-                "Boundary.NONE (zero-Dirichlet exterior) is supported by "
-                "the PpermuteSlab and PpermutePacked methods only")
-        n = len(self._devices)
+    def autotune(self, timer=None, use_cache: bool = True,
+                 force: bool = False, cache_path=None,
+                 max_measurements: int = 4, depths=None,
+                 overlap_options=(False,)):
+        """Measure the live mesh and adopt the fastest exchange plan
+        (the measured per-pair transport routing of the reference,
+        src/stencil.cu:371-458, as a whole-program decision). Runs the
+        measure -> fit -> plan -> cache pipeline of
+        :mod:`stencil_tpu.tuning`: a plan-cache hit (same fingerprint:
+        topology + mesh + grid + radius + dtypes + quantities +
+        library version) skips measurement entirely; ``force=True``
+        re-measures and rewrites the cache entry. Call between
+        configuration and ``realize()`` — or just set
+        ``Method.Auto`` and realize() calls this itself.
 
-        t0 = time.perf_counter()
-        # --- DCN tier discovery (reference: partition.hpp:120-256) -----
-        groups = None
-        if self._dcn_requested:
-            from .parallel.multihost import slice_groups
-            groups = self._dcn_groups or slice_groups(self._devices)
-            self.n_slices = len(groups)
-        # --- partition: choose the subdomain grid ----------------------
+        ``timer``: injectable measurement backend (tests/CI use the
+        deterministic ``tuning.FakeTimer``; default is the real
+        ``tuning.MeshTimer`` over this domain's mesh shape).
+        Returns the adopted :class:`stencil_tpu.tuning.Plan`."""
+        assert self.mesh is None, "autotune() before realize()"
+        assert self._names, "add_data at least one quantity first"
+        from .tuning import DEFAULT_DEPTHS, autotune_domain
+        plan = autotune_domain(
+            self, timer=timer, use_cache=use_cache, force=force,
+            cache_path=cache_path,
+            depths=DEFAULT_DEPTHS if depths is None else depths,
+            overlap_options=overlap_options,
+            max_measurements=max_measurements)
+        self.apply_plan(plan)
+        return plan
+
+    def apply_plan(self, plan) -> None:
+        """Adopt a tuned/cached/pre-baked plan: the winning Method and
+        temporal-blocking depth replace the static configuration (a
+        fleet can ship a plan file and apply it without measuring).
+        ``plan.config.overlap`` is advisory for the model layer
+        (``Jacobi3D``/``Astaroth`` ``overlap=``) — the orchestrator's
+        own exchange program has no overlap variant."""
+        self.methods = Method[plan.config.method]
+        if plan.config.exchange_every != self.exchange_every:
+            self.set_exchange_every(plan.config.exchange_every)
+        self.plan = plan
+
+    @property
+    def plan_provenance(self) -> str:
+        """How the exchange configuration was decided: ``tuned``
+        (measured this run), ``cached`` (plan-cache hit), or
+        ``default`` (static priority list, no autotuner involved)."""
+        return self.plan.provenance if self.plan is not None else "default"
+
+    def _discover_dcn_groups(self):
+        """DCN tier discovery (reference: partition.hpp:120-256);
+        idempotent — sets ``n_slices`` and returns the device groups
+        (None when no DCN tier was requested)."""
+        if not self._dcn_requested:
+            return None
+        from .parallel.multihost import slice_groups
+        groups = self._dcn_groups or slice_groups(self._devices)
+        self.n_slices = len(groups)
+        return groups
+
+    def _choose_partition_dim(self) -> Dim3:
+        """The subdomain-grid shape realize() will use — factored out
+        so the autotuner prices/measures the same partition the
+        orchestrator deploys. Also resolves the DCN axis."""
+        n = len(self._devices)
+        self._discover_dcn_groups()
         if self._mesh_shape is not None:
             dim = self._mesh_shape
             if dim.flatten() != n:
@@ -218,7 +269,53 @@ class DistributedDomain:
                 dim = RankPartition(self.size, n).dim()
         if self._dcn_requested:
             self.dcn_axis = self._pick_dcn_axis(dim)
+        return dim
+
+    def _choose_placement(self, dim: Dim3, groups) -> Placement:
+        """The device placement realize() will deploy for ``dim`` —
+        factored out so the autotuner times the exact fabric (device
+        order on the mesh) the orchestrator ships, not a raw-order
+        stand-in (reference: src/stencil.cu:201-239)."""
         part = RankPartition.from_dim(self.size, dim)
+        elem_sizes = [self._dtypes[q].itemsize for q in self._names]
+        if self._dcn_requested and self.n_slices > 1:
+            # two-tier placement: the slice-blocked device order IS the
+            # assignment (subdomains along dcn_axis block onto slices);
+            # reject contradictory strategy requests rather than
+            # silently overriding an experiment's control placement
+            if self.strategy != PlacementStrategy.NodeAware:
+                raise ValueError(
+                    f"placement strategy {self.strategy.value!r} is "
+                    f"incompatible with the DCN tier (slice blocking "
+                    f"determines the placement)")
+            from .parallel.multihost import multihost_device_order
+            order = multihost_device_order(dim, self.dcn_axis,
+                                           groups=groups)
+            return Placement(part, order)
+        return make_placement(self.strategy, part, self._devices,
+                              self.radius, elem_sizes)
+
+    # ------------------------------------------------------------------
+    # realize (reference: src/stencil.cu:241-850)
+    # ------------------------------------------------------------------
+    def realize(self) -> None:
+        assert self._names, "add_data at least one quantity before realize()"
+        if Method.Auto in self.methods:
+            # the Auto flag is the standing autotune request: resolve
+            # it to a concrete transport before any pick_method() use
+            self.autotune()
+        if self.boundary not in (Boundary.PERIODIC, Boundary.NONE):
+            raise NotImplementedError(f"unsupported boundary {self.boundary}")
+        if self.boundary == Boundary.NONE and pick_method(self.methods) not \
+                in (Method.PpermuteSlab, Method.PpermutePacked):
+            raise NotImplementedError(
+                "Boundary.NONE (zero-Dirichlet exterior) is supported by "
+                "the PpermuteSlab and PpermutePacked methods only")
+
+        t0 = time.perf_counter()
+        # --- DCN tier + partition: choose the subdomain grid -----------
+        dim = self._choose_partition_dim()
+        groups = self._discover_dcn_groups()
         # per-shard capacity = ceil sizes; uneven shards are one short
         # (reference: partition.hpp:55-69)
         self.local_size = Dim3(*(div_ceil(self.size[a], dim[a])
@@ -254,25 +351,7 @@ class DistributedDomain:
 
         # --- placement (reference: src/stencil.cu:201-239) -------------
         t0 = time.perf_counter()
-        elem_sizes = [self._dtypes[q].itemsize for q in self._names]
-        if self._dcn_requested and self.n_slices > 1:
-            # two-tier placement: the slice-blocked device order IS the
-            # assignment (subdomains along dcn_axis block onto slices);
-            # reject contradictory strategy requests rather than
-            # silently overriding an experiment's control placement
-            if self.strategy != PlacementStrategy.NodeAware:
-                raise ValueError(
-                    f"placement strategy {self.strategy.value!r} is "
-                    f"incompatible with the DCN tier (slice blocking "
-                    f"determines the placement)")
-            from .parallel.multihost import multihost_device_order
-            order = multihost_device_order(dim, self.dcn_axis,
-                                           groups=groups)
-            self.placement = Placement(part, order)
-        else:
-            self.placement = make_placement(self.strategy, part,
-                                            self._devices,
-                                            self.radius, elem_sizes)
+        self.placement = self._choose_placement(dim, groups)
         self.topology = Topology(dim, self.boundary)
         self.setup_seconds["placement"] = time.perf_counter() - t0
 
@@ -451,6 +530,15 @@ class DistributedDomain:
             f.write(f"mesh: {dim}\n")
             f.write(f"local size: {self.local_size}\n")
             f.write(f"method: {pick_method(self.methods)}\n")
+            # where the exchange configuration came from (reference
+            # plan files record the routed transport per message; the
+            # autotuner analog records the decision's provenance)
+            f.write(f"plan provenance: {self.plan_provenance}\n")
+            if self.plan is not None:
+                f.write(f"plan fingerprint: {self.plan.fingerprint}\n")
+                f.write(f"plan config: {self.plan.config.key()}\n")
+                f.write(f"plan measurements: {self.plan.measurements}\n")
+            f.write(f"exchange_every: {self.exchange_every}\n")
             f.write(f"quantities: {self._names}\n")
             for i in range(n):
                 idx = self.placement.part.dimensionize(i)

@@ -17,6 +17,10 @@ EXCHANGE_METHOD_TARGETS: Dict[str, str] = {
     "PpermutePacked": "parallel.exchange.exchange_shard_packed",
     "PallasDMA": "parallel.pallas_exchange.exchange_shard_pallas",
     "AllGather": "parallel.exchange.exchange_shard_allgather",
+    # Auto is the autotuner request flag (stencil_tpu/tuning): its data
+    # paths are whatever plan the tuner can emit — the registry's
+    # tuning.plan[*] targets audit every emittable configuration
+    "Auto": "tuning.plan",
 }
 
 

@@ -15,11 +15,17 @@ wire — so the strategies select *how the halo data rides the ICI*:
   (the manual-transport analog; enables true comm/compute overlap).
 * ``AllGather``     — per-axis ``all_gather`` then slice (control
   strategy for benchmarking, like the reference's method sweeps).
+* ``Auto``          — no transport at all: a request that the exchange
+  autotuner (:mod:`stencil_tpu.tuning`) measure the machine and pick
+  the fastest runnable configuration — the analog of the reference's
+  measured per-pair transport routing (src/stencil.cu:371-458) and of
+  TEMPI's transparent measured-faster substitution.
 """
 
 from __future__ import annotations
 
 import enum
+from typing import Callable, Optional, Set, Tuple
 
 
 class Method(enum.Flag):
@@ -31,15 +37,42 @@ class Method(enum.Flag):
     PpermutePacked = 2
     PallasDMA = 4
     AllGather = 8
+    Auto = 16
     Default = PpermuteSlab
 
     def __str__(self) -> str:  # reference: method.hpp to_string
-        names = ["PpermuteSlab", "PpermutePacked", "PallasDMA", "AllGather"]
+        names = ["PpermuteSlab", "PpermutePacked", "PallasDMA",
+                 "AllGather", "Auto"]
         parts = [n for n in names if Method[n] in self]
         return "|".join(parts) if parts else "none"
 
 
-def pick_method(methods: "Method") -> "Method":
+#: transport flags in routing-priority order (Auto is not a transport)
+METHOD_PRIORITY: Tuple["Method", ...] = (
+    Method.PallasDMA, Method.PpermutePacked, Method.PpermuteSlab,
+    Method.AllGather)
+
+
+def method_runnable(m: "Method") -> bool:
+    """Can this strategy actually EXECUTE in this process? Every
+    XLA-collective strategy runs anywhere; PallasDMA (explicit
+    inter-chip RDMA) needs a TPU backend or the distributed (mosaic)
+    interpreter — the ``_compat`` capability probe. Trace-only uses
+    (the static analyzers) bypass this and call the engines directly."""
+    if m == Method.PallasDMA:
+        from .._compat import remote_dma_runnable
+        return remote_dma_runnable()
+    return True
+
+
+# (requested, fallback) pairs already warned about — the orchestrator
+# consults pick_method several times per realize(); warn once per fact
+_warned: Set[Tuple[int, int]] = set()
+
+
+def pick_method(methods: "Method",
+                runnable: Optional[Callable[["Method"], bool]] = None
+                ) -> "Method":
     """Choose the single strategy the exchange will use this run, by
     priority (the analog of the reference's per-pair transport routing,
     src/stencil.cu:371-458 — on TPU every pair rides the same ICI, so
@@ -47,10 +80,46 @@ def pick_method(methods: "Method") -> "Method":
 
     PallasDMA (explicit inter-chip RDMA, parallel/pallas_exchange.py)
     wins when requested — it is the opt-in manual-transport path, like
-    the reference's direct-write Colo* methods.
+    the reference's direct-write Colo* methods. The pick is
+    capability-aware: a requested strategy the current backend cannot
+    RUN (``method_runnable``, e.g. PallasDMA off-TPU without the
+    distributed interpreter) is skipped with a logged warning in favor
+    of the next runnable requested strategy, or ``Method.Default`` when
+    nothing requested is runnable — selecting an unrunnable transport
+    would only defer the failure into the jitted program.
+
+    ``runnable``: injectable capability predicate (tests exercise both
+    branches without a TPU); defaults to :func:`method_runnable`.
     """
-    for m in (Method.PallasDMA, Method.PpermutePacked, Method.PpermuteSlab,
-              Method.AllGather):
-        if m in methods:
+    if runnable is None:
+        runnable = method_runnable
+    requested = [m for m in METHOD_PRIORITY if m in methods]
+    if not requested:
+        if Method.Auto in methods:
+            raise ValueError(
+                "Method.Auto carries no transport — resolve it first "
+                "via DistributedDomain.autotune()/realize() (the "
+                "autotuner replaces Auto with the measured winner)")
+        raise ValueError(f"no usable method in {methods}")
+    skipped = []
+    for m in requested:
+        if runnable(m):
+            if skipped:
+                _warn_fallback(skipped, m)
             return m
-    raise ValueError(f"no usable method in {methods}")
+        skipped.append(m)
+    fallback = Method.Default
+    _warn_fallback(skipped, fallback)
+    return fallback
+
+
+def _warn_fallback(skipped, chosen: "Method") -> None:
+    from ..utils.logging import LOG_WARN
+
+    key = (sum(m.value for m in skipped), chosen.value)
+    if key in _warned:
+        return
+    _warned.add(key)
+    names = "|".join(m.name or "?" for m in skipped)
+    LOG_WARN(f"requested exchange method(s) {names} cannot run on this "
+             f"backend (capability probe); falling back to {chosen}")

@@ -1,0 +1,107 @@
+"""``python -m stencil_tpu.tune`` — the exchange autotuner CLI.
+
+Tunes an exchange plan for a described problem (grid, radius,
+quantities, mesh) on the current devices and persists it to the plan
+cache, so production runs — or a whole fleet pointed at the same cache
+file via ``$STENCIL_TUNE_CACHE`` — start with a plan-cache hit and
+never pay measurement cost. The deterministic ``--fake-timer`` mode
+exercises the full search/fit/plan/cache pipeline with zero hardware
+dependence (the CI stage and tier-1 tests run it on CPU).
+
+Examples::
+
+    # tune a 256^3 radius-2 two-field problem on this machine
+    python -m stencil_tpu.tune --x 256 --y 256 --z 256 --fr 2 --fields 2
+
+    # deterministic, hardware-free (CI): fake timer + scratch cache
+    python -m stencil_tpu.tune --x 64 --y 64 --z 64 --fake-cpu 8 \
+        --fake-timer --cache /tmp/plans.json --json plan.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _parse_ints(text: str) -> List[int]:
+    return [int(t) for t in text.split(",") if t.strip()]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m stencil_tpu.tune",
+        description="Measurement-driven halo-exchange autotuner: "
+                    "measure -> fit -> plan -> cache.")
+    ap.add_argument("--x", type=int, default=128, help="global x size")
+    ap.add_argument("--y", type=int, default=128)
+    ap.add_argument("--z", type=int, default=128)
+    ap.add_argument("--fr", type=int, default=1, help="face radius")
+    ap.add_argument("--er", type=int, default=1, help="edge radius")
+    ap.add_argument("--cr", type=int, default=1, help="corner radius")
+    ap.add_argument("--fields", type=int, default=1,
+                    help="number of quantities")
+    ap.add_argument("--dtype", default="float32",
+                    help="quantity dtype (numpy name)")
+    ap.add_argument("--mesh-shape", default="", metavar="MX,MY,MZ",
+                    help="explicit subdomain grid (default: derived)")
+    ap.add_argument("--depths", default="1,2,4,8", metavar="S[,S...]",
+                    help="temporal-blocking depths to sweep")
+    ap.add_argument("--max-measure", type=int, default=4,
+                    help="timing runs after cost-model pruning")
+    ap.add_argument("--cache", default="", metavar="PATH",
+                    help="plan cache file (default: "
+                         "$STENCIL_TUNE_CACHE or "
+                         "~/.cache/stencil_tpu/plans.json)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="neither read nor write the plan cache")
+    ap.add_argument("--force", action="store_true",
+                    help="ignore a cached plan; re-measure and rewrite")
+    ap.add_argument("--fake-timer", action="store_true",
+                    help="deterministic analytic measurements (no "
+                         "hardware timing; exercises the full search)")
+    ap.add_argument("--json", default="", metavar="PATH",
+                    help="write the tuned plan record as JSON")
+    ap.add_argument("--fake-cpu", type=int, default=0, metavar="N",
+                    help="run on N virtual CPU devices")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from .utils.config import apply_fake_cpu
+    apply_fake_cpu(args.fake_cpu)
+
+    import numpy as np
+
+    from .distributed import DistributedDomain
+    from .geometry import Radius
+    from .tuning import FakeTimer
+    from .utils.profiling import autotune_report
+
+    dd = DistributedDomain(args.x, args.y, args.z)
+    dd.set_radius(Radius.face_edge_corner(args.fr, args.er, args.cr))
+    if args.mesh_shape:
+        dd.set_mesh_shape(tuple(_parse_ints(args.mesh_shape)))
+    for i in range(args.fields):
+        dd.add_data(f"q{i}", np.dtype(args.dtype))
+
+    timer = FakeTimer() if args.fake_timer else None
+    plan = dd.autotune(timer=timer,
+                       use_cache=not args.no_cache,
+                       force=args.force,
+                       cache_path=args.cache or None,
+                       max_measurements=args.max_measure,
+                       depths=tuple(_parse_ints(args.depths)))
+    print(autotune_report(plan))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(plan.to_record(), f, indent=2, sort_keys=True)
+        print(f"tune: wrote {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

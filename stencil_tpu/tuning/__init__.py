@@ -1,0 +1,237 @@
+"""Measurement-driven exchange autotuning with a persistent plan cache.
+
+The reference library's defining optimization is that it *measures*
+the machine and routes every halo message over the fastest transport
+for its src/dst pair (reference: src/stencil.cu:371-458); TEMPI
+(PAPERS.md) shows the same win done transparently under an unchanged
+API. This package closes that loop for the TPU port — the static
+priority list in ``parallel/methods.py`` becomes a measured decision:
+
+1. **measure** (:mod:`.measure`) — pingpong ring shifts calibrate
+   per-link alpha-beta coefficients; short jitted loops built from the
+   existing exchange engines time whole candidate configurations;
+2. **fit** (:mod:`.fit`) — least-squares alpha-beta over the pingpong
+   samples replaces the assumed constants in
+   ``analysis/costmodel.py``;
+3. **plan** (:mod:`.plan`) — the calibrated cost model
+   (``configured_step_seconds`` generalizing
+   ``temporal_step_exchange_seconds``; ``predict_exchange_every`` for
+   the depth crossover) ranks every feasible (Method, overlap,
+   exchange_every) candidate and PRUNES the sweep so only the top few
+   are ever timed; the measured winner becomes the :class:`Plan`;
+4. **cache** (:mod:`.cache`) — the plan persists under a fingerprint
+   of topology + mesh + grid + radius + dtypes + quantities + library
+   version; a hit skips measurement entirely, a mismatch re-tunes.
+
+It is the same measure → fit → plan → cache shape a training stack
+uses for collective/layout autotuning. Everything is testable off-TPU
+via the injectable timer (:class:`.measure.FakeTimer`): tier-1
+exercises search, fit, pruning, and cache logic deterministically on
+the CPU mesh.
+
+Entry points: ``DistributedDomain.autotune()`` / ``Method.Auto`` at
+``realize()`` time, ``python -m stencil_tpu.tune``, and
+``apps/bench_exchange.py --autotune``.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..analysis.costmodel import (LinkCoefficients,
+                                  configured_step_seconds,
+                                  predict_exchange_every)
+from ..utils.logging import LOG_INFO
+from .cache import default_cache_path, load_plan, store_plan
+from .fit import calibrate_link, coefficients_record, fit_alpha_beta
+from .measure import CountingTimer, FakeTimer, MeshTimer
+from .plan import (DEFAULT_DEPTHS, Candidate, Plan, TuneGeometry,
+                   candidate_space, fingerprint, fingerprint_inputs)
+
+__all__ = [
+    "Candidate", "Plan", "TuneGeometry", "FakeTimer", "MeshTimer",
+    "CountingTimer", "LinkCoefficients", "autotune_domain",
+    "run_autotune", "candidate_space", "calibrate_link",
+    "fit_alpha_beta", "fingerprint", "fingerprint_inputs",
+    "default_cache_path", "load_plan", "store_plan", "DEFAULT_DEPTHS",
+]
+
+
+def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
+                 read_cache: bool = True, write_cache: bool = True,
+                 cache_path=None,
+                 depths: Sequence[int] = DEFAULT_DEPTHS,
+                 overlap_options: Sequence[bool] = (False,),
+                 max_measurements: int = 4,
+                 runnable=None) -> Plan:
+    """The core search (timer injected — deterministic under
+    :class:`FakeTimer`): cache lookup, alpha-beta calibration,
+    model-ranked pruning, measurement of the survivors, plan store.
+
+    ``max_measurements`` bounds the exchange timing runs (the
+    calibration pingpongs are counted separately in
+    ``Plan.measurements``); the calibrated cost model decides WHICH
+    candidates are worth those runs.
+    """
+    fp = fingerprint(inputs)
+    if read_cache:
+        plan = load_plan(fp, cache_path)
+        if plan is not None:
+            plan.provenance = "cached"
+            plan.measurements = 0
+            LOG_INFO(f"autotune: plan cache hit for {fp[:12]}... -> "
+                     f"{plan.config.key()} (no measurements)")
+            return plan
+
+    counted = CountingTimer(timer)
+
+    # --- fit: measured alpha-beta replaces the assumed constants, per
+    # link class: the ICI always; the DCN when the mesh has a
+    # slice-blocked axis (timer.has_dcn). The exchange is three
+    # SEQUENTIAL axis sweeps, so for ranking the two classes combine
+    # as the bottleneck link (max latency, min bandwidth) — the
+    # conservative price of a sweep that must cross both fabrics.
+    links = {"ici": calibrate_link(counted.pingpong)}
+    if getattr(counted, "has_dcn", False):
+        links["dcn"] = calibrate_link(counted.pingpong_dcn)
+    coeffs = LinkCoefficients(
+        alpha_s=max(c.alpha_s for c in links.values()),
+        beta_bytes_per_s=min(c.beta_bytes_per_s
+                             for c in links.values()))
+
+    # --- plan: rank every feasible candidate with the CALIBRATED model
+    cands = candidate_space(geom, depths=depths,
+                            overlap_options=overlap_options,
+                            runnable=runnable)
+    if not cands:
+        raise ValueError("no feasible exchange configuration for this "
+                         "geometry (shards smaller than the radius?)")
+    predicted = {
+        c: configured_step_seconds(c.method, geom.shard_interior_zyx,
+                                   geom.radius, geom.counts,
+                                   geom.elem_sizes, c.exchange_every,
+                                   coeffs, geom.dtype_groups)
+        for c in cands}
+    ranked = sorted(cands, key=lambda c: predicted[c])
+
+    # the temporal-depth crossover predictor, on the calibrated
+    # coefficients (recorded as Plan.predicted_best_depth)
+    best_depth: Optional[int] = None
+    try:
+        best_depth, _ = predict_exchange_every(
+            geom.shard_interior_zyx, geom.radius, geom.counts,
+            max(geom.elem_sizes), coeffs.alpha_s * 6,
+            coeffs.beta_bytes_per_s, candidates=tuple(depths))
+    except ValueError:
+        pass
+
+    survivors = ranked[:max(int(max_measurements), 1)]
+    pruned = len(ranked) - len(survivors)
+
+    # --- measure the survivors ---------------------------------------
+    measured: List[Tuple[float, Candidate]] = []
+    for c in survivors:
+        per_step = counted.exchange_round(c, geom) / c.exchange_every
+        measured.append((per_step, c))
+    win_s, winner = min(measured,
+                        key=lambda t: (t[0], survivors.index(t[1])))
+
+    costs = {}
+    for c in cands:
+        rec = {"predicted_s": predicted[c]}
+        for s, mc in measured:
+            if mc == c:
+                rec["measured_s"] = s
+        costs[c.key()] = rec
+
+    plan = Plan(config=winner, fingerprint=fp,
+                coefficients=coefficients_record(links),
+                costs=costs, provenance="tuned",
+                measurements=counted.calls,
+                created=_time.time(),
+                library_version=str(inputs.get("library_version", "")),
+                fingerprint_inputs=dict(inputs),
+                predicted_best_depth=best_depth)
+    LOG_INFO(f"autotune: measured {len(survivors)}/{len(cands)} "
+             f"candidates (pruned {pruned} by the calibrated model; "
+             f"depth crossover predicts s={best_depth}) -> "
+             f"{winner.key()} at {win_s:.3e}s/step "
+             f"[alpha={coeffs.alpha_s:.2e}s "
+             f"beta={coeffs.beta_bytes_per_s:.2e}B/s]")
+    if write_cache:
+        store_plan(plan, cache_path)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# DistributedDomain adapters
+
+
+def geometry_from_domain(dd, dim) -> TuneGeometry:
+    """Per-shard tuning geometry from a configured (not yet realized)
+    ``DistributedDomain`` and its chosen partition ``dim``."""
+    from ..geometry import Dim3
+    from ..numerics import div_ceil
+    from ..topology import Boundary
+
+    local = Dim3(*(div_ceil(dd.size[a], dim[a]) for a in range(3)))
+    rem = dd.size % dim
+    min_local = Dim3(*(local[a] - (1 if rem[a] else 0)
+                       for a in range(3)))
+    return TuneGeometry(
+        shard_interior_zyx=(local.z, local.y, local.x),
+        min_interior_zyx=(min_local.z, min_local.y, min_local.x),
+        radius=dd.radius, counts=Dim3.of(dim),
+        elem_sizes=tuple(dd._dtypes[q].itemsize for q in dd._names),
+        uneven=rem != Dim3(0, 0, 0),
+        nonperiodic=dd.boundary == Boundary.NONE,
+        dtype_strs=tuple(str(dd._dtypes[q]) for q in dd._names))
+
+
+def inputs_from_domain(dd, dim) -> Dict:
+    """Fingerprint inputs from a configured ``DistributedDomain``."""
+    platform = (dd._devices[0].platform if dd._devices else "cpu")
+    return fingerprint_inputs(
+        platform=platform, device_count=len(dd._devices),
+        mesh_shape=list(dim), grid=list(dd.size), radius=dd.radius,
+        quantities={q: str(dd._dtypes[q]) for q in dd._names},
+        boundary=dd.boundary.name, n_slices=dd.n_slices)
+
+
+def autotune_domain(dd, timer=None, use_cache: bool = True,
+                    force: bool = False, cache_path=None,
+                    depths: Sequence[int] = DEFAULT_DEPTHS,
+                    overlap_options: Sequence[bool] = (False,),
+                    max_measurements: int = 4) -> Plan:
+    """Autotune a configured ``DistributedDomain`` (called by
+    ``DistributedDomain.autotune()`` — use that). Chooses the partition
+    the orchestrator will use, builds the real :class:`MeshTimer` over
+    a throwaway mesh of that shape (unless a timer is injected), and
+    runs the search. Does NOT apply the plan; the domain does."""
+    dim = dd._choose_partition_dim()
+    geom = geometry_from_domain(dd, dim)
+    inputs = inputs_from_domain(dd, dim)
+    if timer is None:
+        from ..parallel.mesh import make_mesh
+        from ..geometry import Dim3
+        from ..numerics import div_ceil
+        local = Dim3(*(div_ceil(dd.size[a], dim[a]) for a in range(3)))
+        # time the fabric realize() will DEPLOY: the same placement
+        # (slice-blocked / NodeAware device order), not raw device
+        # order — on a DCN-tiered mesh the raw order would let the
+        # "dcn" pingpong ride ICI links and fit fantasy coefficients
+        groups = dd._discover_dcn_groups()
+        placement = dd._choose_placement(dim, groups)
+        mesh = make_mesh(dim, placement.device_order_for_mesh())
+        timer = MeshTimer(mesh, local,
+                          [dd._dtypes[q] for q in dd._names],
+                          rem=dd.size % dim,
+                          nonperiodic=geom.nonperiodic,
+                          dcn_axis=(dd.dcn_axis if dd.n_slices > 1
+                                    else None))
+    return run_autotune(geom, inputs, timer,
+                        read_cache=use_cache and not force,
+                        write_cache=use_cache, cache_path=cache_path,
+                        depths=depths, overlap_options=overlap_options,
+                        max_measurements=max_measurements)

@@ -1,0 +1,111 @@
+"""Persistent plan cache: fingerprint-keyed tuned plans on disk.
+
+One JSON file holds every plan this machine has tuned, keyed by the
+problem fingerprint (:func:`..tuning.plan.fingerprint`). Subsequent
+runs with a matching fingerprint skip measurement entirely; a
+fingerprint miss (different radius, dtype, mesh, grid, library
+version...) re-tunes automatically. The schema is versioned: a cache
+written by an incompatible library schema — or a corrupt/truncated
+file — is REJECTED gracefully (warn + re-tune + rewrite), never
+trusted and never fatal.
+
+Location: ``$STENCIL_TUNE_CACHE`` when set, else
+``~/.cache/stencil_tpu/plans.json``. Fleets can pre-bake a plan file
+at that path (or point the env var at a read-only shipped plan) so no
+job ever pays the measurement cost — the README "Autotuning" section
+documents the recipe.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Dict, Optional, Union
+
+from ..utils.logging import LOG_DEBUG, LOG_WARN
+from .plan import Plan, SCHEMA_VERSION
+
+ENV_CACHE = "STENCIL_TUNE_CACHE"
+
+
+def default_cache_path() -> Path:
+    env = os.environ.get(ENV_CACHE, "")
+    if env:
+        return Path(env)
+    return Path(os.path.expanduser("~/.cache/stencil_tpu/plans.json"))
+
+
+def _resolve(path: Union[str, Path, None]) -> Path:
+    return Path(path) if path is not None else default_cache_path()
+
+
+def load_cache(path: Union[str, Path, None] = None) -> Dict[str, Dict]:
+    """The raw fingerprint -> plan-record table, or {} when the file is
+    absent, unreadable, corrupt, or of a foreign schema version."""
+    p = _resolve(path)
+    if not p.exists():
+        return {}
+    try:
+        data = json.loads(p.read_text())
+    except (OSError, ValueError) as e:
+        LOG_WARN(f"plan cache {p} is corrupt ({type(e).__name__}: {e}); "
+                 f"ignoring it (will re-tune and rewrite)")
+        return {}
+    if not isinstance(data, dict) or "plans" not in data:
+        LOG_WARN(f"plan cache {p} has no 'plans' table; ignoring it")
+        return {}
+    schema = data.get("schema")
+    if schema != SCHEMA_VERSION:
+        LOG_WARN(f"plan cache {p} has schema {schema!r}, this library "
+                 f"speaks {SCHEMA_VERSION}; ignoring it (will re-tune)")
+        return {}
+    plans = data["plans"]
+    return dict(plans) if isinstance(plans, dict) else {}
+
+
+def load_plan(fingerprint: str,
+              path: Union[str, Path, None] = None) -> Optional[Plan]:
+    """The cached plan for ``fingerprint``, or None (miss / bad file /
+    record that does not parse)."""
+    rec = load_cache(path).get(fingerprint)
+    if rec is None:
+        return None
+    try:
+        plan = Plan.from_record(rec)
+    except (KeyError, TypeError, ValueError) as e:
+        LOG_WARN(f"cached plan for {fingerprint[:12]}... does not parse "
+                 f"({type(e).__name__}: {e}); treating as a miss")
+        return None
+    if plan.fingerprint != fingerprint:
+        LOG_WARN(f"cached plan under key {fingerprint[:12]}... carries "
+                 f"mismatched fingerprint {plan.fingerprint[:12]}...; "
+                 f"treating as a miss")
+        return None
+    return plan
+
+
+def store_plan(plan: Plan, path: Union[str, Path, None] = None) -> Path:
+    """Insert/replace ``plan`` under its fingerprint (atomic tmp+rename
+    write; concurrent writers last-win whole-file, never interleave)."""
+    p = _resolve(path)
+    plans = load_cache(p)
+    plans[plan.fingerprint] = plan.to_record()
+    payload = {"schema": SCHEMA_VERSION, "plans": plans}
+    p.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=str(p.parent),
+                               prefix=p.name, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(tmp, p)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    LOG_DEBUG(f"plan cache {p}: stored {plan.config.key()} under "
+              f"{plan.fingerprint[:12]}...")
+    return p

@@ -1,0 +1,66 @@
+"""Alpha-beta coefficient fitting from measured timings.
+
+The reference measures the machine before planning (bandwidth matrix +
+GPU distance matrix, reference: src/machine.cu, bin/pingpong.cu); the
+TPU analog fits the two-parameter LogP-style model
+
+    seconds(message) = alpha + bytes / beta
+
+to ring-shift timings at several message sizes (the pingpong harness,
+apps/pingpong.py). The fitted :class:`LinkCoefficients` replace the
+assumed constants in ``analysis/costmodel.py`` so the candidate
+ranking — ``configured_step_seconds`` / ``predict_exchange_every`` —
+prices the actual fabric, not a datasheet.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Sequence, Tuple
+
+from ..analysis.costmodel import LinkCoefficients
+
+#: message sizes the calibration samples: one latency-dominated, one
+#: bandwidth-dominated, one in between (least squares over all three)
+DEFAULT_CALIBRATION_BYTES: Tuple[int, ...] = (1 << 12, 1 << 17, 1 << 21)
+
+
+def fit_alpha_beta(samples: Sequence[Tuple[int, float]]
+                   ) -> LinkCoefficients:
+    """Least-squares fit of ``seconds = alpha + bytes / beta`` over
+    ``(bytes, seconds)`` samples. Degenerate inputs (a single sample,
+    or zero byte spread) fall back to attributing everything to
+    latency — safe for ranking, which only needs relative costs."""
+    if not samples:
+        raise ValueError("fit_alpha_beta needs at least one sample")
+    if len(samples) == 1 or len({b for b, _ in samples}) == 1:
+        alpha = max(min(t for _, t in samples), 1e-12)
+        return LinkCoefficients(alpha_s=alpha, beta_bytes_per_s=1e30)
+    n = len(samples)
+    sx = sum(float(b) for b, _ in samples)
+    sy = sum(float(t) for _, t in samples)
+    sxx = sum(float(b) * float(b) for b, _ in samples)
+    sxy = sum(float(b) * float(t) for b, t in samples)
+    denom = n * sxx - sx * sx
+    slope = (n * sxy - sx * sy) / denom     # seconds per byte = 1/beta
+    alpha = (sy - slope * sx) / n
+    # noisy small-sample fits can cross zero; clamp to physical values
+    alpha = max(alpha, 1e-12)
+    beta = 1.0 / slope if slope > 0 else 1e30
+    return LinkCoefficients(alpha_s=alpha, beta_bytes_per_s=beta)
+
+
+def calibrate_link(pingpong: Callable[[int], float],
+                   sizes: Sequence[int] = DEFAULT_CALIBRATION_BYTES
+                   ) -> LinkCoefficients:
+    """Measure ``pingpong(nbytes)`` (seconds per neighbor shift of one
+    ``nbytes`` message) at each size and fit the alpha-beta model."""
+    return fit_alpha_beta([(int(b), float(pingpong(int(b))))
+                           for b in sizes])
+
+
+def coefficients_record(coeffs_by_link: Dict[str, LinkCoefficients]
+                        ) -> Dict[str, Dict[str, float]]:
+    """JSON-ready form for the plan cache."""
+    return {link: {"alpha_s": c.alpha_s,
+                   "beta_bytes_per_s": c.beta_bytes_per_s}
+            for link, c in coeffs_by_link.items()}

@@ -1,0 +1,200 @@
+"""Measurement harness: short jitted timing loops over the live mesh.
+
+Two timer implementations share one interface (the injectable-timer
+contract the off-TPU tests rely on):
+
+* :class:`MeshTimer` — the real thing: ``pingpong(nbytes)`` times a
+  neighbor ring shift (the apps/pingpong.py harness, inlined) and
+  ``exchange_round(candidate, geom)`` times one deep exchange round of
+  a throwaway jitted program built from the EXISTING exchange engines
+  (``parallel.exchange.make_exchange``) — the same code path
+  ``DistributedDomain.realize`` will run, so the measurement is the
+  deployment.
+* :class:`FakeTimer` — deterministic: evaluates the SAME analytic
+  alpha-beta model the calibrated cost model uses
+  (``analysis.costmodel.exchange_round_model``), from injected
+  coefficients. Search, fit, pruning, and cache logic are exercised
+  bit-for-bit on CPU with zero hardware variance; tier-1 runs the
+  whole autotune end-to-end this way.
+
+:class:`CountingTimer` wraps either and counts invocations — the
+number the plan records as ``measurements`` and the cache-hit CI gate
+asserts is zero on the second run.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..analysis.costmodel import LinkCoefficients, exchange_round_model
+from ..geometry import Dim3
+from .plan import Candidate, TuneGeometry
+
+
+class FakeTimer:
+    """Deterministic measurement stand-in driven by injected
+    alpha-beta coefficients (and optional per-method scale factors for
+    tests that need a specific winner)."""
+
+    def __init__(self, coeffs: Optional[LinkCoefficients] = None,
+                 scale: Optional[Dict[str, float]] = None,
+                 overlap_factor: float = 1.0,
+                 dcn_coeffs: Optional[LinkCoefficients] = None) -> None:
+        self.coeffs = coeffs if coeffs is not None else LinkCoefficients(
+            alpha_s=50e-6, beta_bytes_per_s=1e10)
+        self.scale = dict(scale or {})
+        self.overlap_factor = float(overlap_factor)
+        self.dcn_coeffs = dcn_coeffs
+
+    @property
+    def has_dcn(self) -> bool:
+        return self.dcn_coeffs is not None
+
+    def pingpong(self, nbytes: int) -> float:
+        return self.coeffs.seconds(1, nbytes)
+
+    def pingpong_dcn(self, nbytes: int) -> float:
+        assert self.dcn_coeffs is not None, "no DCN link configured"
+        return self.dcn_coeffs.seconds(1, nbytes)
+
+    def exchange_round(self, cand: Candidate, geom: TuneGeometry
+                       ) -> float:
+        messages, nbytes = exchange_round_model(
+            cand.method, geom.shard_interior_zyx, geom.radius,
+            geom.counts, geom.elem_sizes, cand.exchange_every,
+            geom.dtype_groups)
+        t = self.coeffs.seconds(messages, nbytes)
+        t *= self.scale.get(cand.method, 1.0)
+        if cand.overlap:
+            t *= self.overlap_factor
+        return t
+
+
+class MeshTimer:
+    """Micro-benchmarks on the live mesh. ``dtypes`` are the realized
+    quantities' dtypes (one timing field each, matching the deployed
+    buffer layout); ``rem``/``nonperiodic`` mirror the orchestrator so
+    the timed program is the one realize() would build."""
+
+    def __init__(self, mesh, local: Dim3, dtypes: Sequence,
+                 rem: Dim3 = Dim3(0, 0, 0), nonperiodic: bool = False,
+                 reps: int = 5, dcn_axis: Optional[int] = None) -> None:
+        self.mesh = mesh
+        self.local = local
+        self.dtypes = [np.dtype(d) for d in dtypes]
+        self.rem = rem
+        self.nonperiodic = nonperiodic
+        self.reps = int(reps)
+        self.dcn_axis = dcn_axis
+
+    @property
+    def has_dcn(self) -> bool:
+        return self.dcn_axis is not None
+
+    def _sync(self, tree) -> None:
+        from ..utils.timers import device_sync
+        device_sync(tree)
+
+    def pingpong(self, nbytes: int) -> float:
+        """Seconds per neighbor ring shift of one ``nbytes`` message
+        along the largest mesh axis (the alpha-beta sample source)."""
+        name = max(self.mesh.shape, key=lambda k: self.mesh.shape[k])
+        return self._ring_shift_seconds(name, nbytes)
+
+    def pingpong_dcn(self, nbytes: int) -> float:
+        """Same, along the slice-blocked (DCN) mesh axis — the slow
+        link class's alpha-beta samples."""
+        assert self.dcn_axis is not None, "no DCN axis configured"
+        return self._ring_shift_seconds("xyz"[self.dcn_axis], nbytes)
+
+    def _ring_shift_seconds(self, name: str, nbytes: int) -> float:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n = self.mesh.shape[name]
+        elems = max(int(nbytes) // 4, 1)
+        spec = P(name)
+        sharding = NamedSharding(self.mesh, spec)
+
+        def shift(x):
+            if n == 1:
+                return x + 1.0
+            return lax.ppermute(x, name,
+                                [(i, (i + 1) % n) for i in range(n)])
+
+        fn = jax.jit(jax.shard_map(shift, mesh=self.mesh, in_specs=spec,
+                                   out_specs=spec, check_vma=False))
+        x = jax.device_put(jnp.zeros((elems * n,), jnp.float32), sharding)
+        x = fn(x)
+        self._sync(x)
+        t0 = time.perf_counter()
+        for _ in range(self.reps):
+            x = fn(x)
+        self._sync(x)
+        return (time.perf_counter() - t0) / self.reps
+
+    def exchange_round(self, cand: Candidate, geom: TuneGeometry
+                       ) -> float:
+        """Seconds per deep exchange round of ``cand``'s configuration,
+        timed on a throwaway jitted program over zero fields — built by
+        the same ``make_exchange`` the orchestrator deploys."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..local_domain import raw_size, zyx_shape
+        from ..parallel.exchange import make_exchange
+        from ..parallel.mesh import mesh_dim
+        from ..parallel.methods import Method
+
+        deep = geom.radius.deepened(cand.exchange_every)
+        ex = make_exchange(self.mesh, deep, Method[cand.method],
+                           rem=self.rem, nonperiodic=self.nonperiodic)
+        dim = mesh_dim(self.mesh)
+        padded = raw_size(self.local, deep)
+        gshape = zyx_shape(padded * dim)
+        sharding = NamedSharding(self.mesh, P("z", "y", "x"))
+        make = {i: jax.jit(lambda dt=dt: jnp.zeros(gshape, dt),
+                           out_shardings=sharding)
+                for i, dt in enumerate(self.dtypes)}
+        fields = {f"q{i}": mk() for i, mk in make.items()}
+        # make_exchange DONATES its input dict: rebind every call
+        fields = dict(ex(fields))
+        self._sync(fields)
+        t0 = time.perf_counter()
+        for _ in range(self.reps):
+            fields = dict(ex(fields))
+        self._sync(fields)
+        return (time.perf_counter() - t0) / self.reps
+
+
+class CountingTimer:
+    """Delegating wrapper that counts timer invocations — the
+    ``Plan.measurements`` source and the cache-hit-skips-measurement
+    assertion's witness."""
+
+    def __init__(self, timer) -> None:
+        self._timer = timer
+        self.calls = 0
+
+    @property
+    def has_dcn(self) -> bool:
+        return bool(getattr(self._timer, "has_dcn", False))
+
+    def pingpong(self, nbytes: int) -> float:
+        self.calls += 1
+        return self._timer.pingpong(nbytes)
+
+    def pingpong_dcn(self, nbytes: int) -> float:
+        self.calls += 1
+        return self._timer.pingpong_dcn(nbytes)
+
+    def exchange_round(self, cand: Candidate, geom: TuneGeometry
+                       ) -> float:
+        self.calls += 1
+        return self._timer.exchange_round(cand, geom)

@@ -1,0 +1,227 @@
+"""Plan data model: candidates, fingerprints, and the tuned Plan.
+
+The reference library routes every halo message over the fastest
+measured transport for its src/dst pair (reference:
+src/stencil.cu:371-458) and records the decision in per-rank plan
+files. The TPU analog is a whole-program choice: ONE exchange
+configuration — (Method, overlap, exchange_every) — serves every pair
+because XLA SPMD owns the wire. A :class:`Plan` is that choice plus
+everything needed to trust and reuse it: the measured alpha-beta
+coefficients, the per-candidate costs, a provenance tag
+(tuned/cached/default), and a fingerprint of the machine+problem the
+measurements are valid for.
+
+Fingerprint semantics: two runs share a plan iff their fingerprint
+inputs match — device platform and count, mesh shape, slice count,
+global grid, full 26-direction radius, quantity names and dtypes,
+boundary, and the library version (a new library may lower the same
+exchange differently, so plans do not survive upgrades). Anything else
+(iteration counts, output prefixes, CLI flags) is deliberately NOT
+fingerprinted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..geometry import Dim3, Radius, all_directions
+
+SCHEMA_VERSION = 1
+
+#: temporal-blocking depths the tuner sweeps by default
+DEFAULT_DEPTHS: Tuple[int, ...] = (1, 2, 4, 8)
+
+#: the strategies a plan may select, in generation (tie-break) order
+PLAN_METHODS: Tuple[str, ...] = ("PpermuteSlab", "PpermutePacked",
+                                 "PallasDMA", "AllGather")
+
+#: strategies supporting deep-carry allocations / uneven shards /
+#: the zero-Dirichlet exterior (mirrors DistributedDomain.realize)
+_PPERMUTE = ("PpermuteSlab", "PpermutePacked")
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the configuration space the tuner sweeps."""
+
+    method: str
+    exchange_every: int = 1
+    overlap: bool = False
+
+    def key(self) -> str:
+        tag = f"{self.method}[s={self.exchange_every}"
+        return tag + (",overlap]" if self.overlap else "]")
+
+    @staticmethod
+    def from_key(key: str) -> "Candidate":
+        method, _, rest = key.partition("[")
+        rest = rest.rstrip("]")
+        parts = rest.split(",")
+        s = int(parts[0].split("=")[1])
+        return Candidate(method, s, "overlap" in parts[1:])
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneGeometry:
+    """The per-shard geometry every cost/feasibility rule consumes.
+
+    ``shard_interior_zyx`` is the CAPACITY interior (ceil sizes — the
+    slabs that actually ride the wire); ``min_interior_zyx`` is the
+    smallest shard (one less along remainder axes), which bounds the
+    feasible blocking depth exactly like realize()'s check.
+    """
+
+    shard_interior_zyx: Tuple[int, int, int]
+    min_interior_zyx: Tuple[int, int, int]
+    radius: Radius
+    counts: Dim3
+    elem_sizes: Tuple[int, ...]
+    uneven: bool = False
+    nonperiodic: bool = False
+    #: per-quantity dtype names — the packed engine groups launches by
+    #: DTYPE, not element size (f32 + i32 pack separately); empty means
+    #: unknown and the cost model falls back to distinct element sizes
+    dtype_strs: Tuple[str, ...] = ()
+
+    @property
+    def dtype_groups(self) -> "int | None":
+        return len(set(self.dtype_strs)) if self.dtype_strs else None
+
+
+def candidate_feasible(cand: Candidate, geom: TuneGeometry) -> bool:
+    """The realize()-equivalent feasibility rules, applied up front so
+    the tuner never measures a configuration the orchestrator would
+    reject."""
+    if cand.method not in PLAN_METHODS:
+        return False
+    if cand.method not in _PPERMUTE:
+        if cand.exchange_every > 1 or geom.uneven or geom.nonperiodic:
+            return False
+        if cand.overlap:
+            return False
+    if cand.exchange_every < 1:
+        return False
+    # the deepened radius must fit the SMALLEST shard on every face
+    mz, my, mx = geom.min_interior_zyx
+    min_xyz = (mx, my, mz)
+    for a in range(3):
+        need = cand.exchange_every * max(geom.radius.face(a, -1),
+                                         geom.radius.face(a, 1))
+        if need > min_xyz[a]:
+            return False
+    return True
+
+
+def candidate_space(geom: TuneGeometry,
+                    depths: Sequence[int] = DEFAULT_DEPTHS,
+                    overlap_options: Sequence[bool] = (False,),
+                    runnable: Optional[Callable] = None
+                    ) -> List[Candidate]:
+    """Every feasible, runnable configuration, in deterministic
+    tie-break order (method priority x depth ascending x overlap off
+    first). ``runnable`` filters strategies the backend cannot execute
+    (capability probes — PallasDMA off-TPU); defaults to
+    ``parallel.methods.method_runnable``."""
+    from ..parallel.methods import Method, method_runnable
+
+    if runnable is None:
+        runnable = method_runnable
+    out: List[Candidate] = []
+    for name in PLAN_METHODS:
+        if not runnable(Method[name]):
+            continue
+        for s in sorted(set(int(d) for d in depths)):
+            for ovl in overlap_options:
+                cand = Candidate(name, s, bool(ovl))
+                if candidate_feasible(cand, geom):
+                    out.append(cand)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fingerprint
+
+
+def radius_signature(radius: Radius) -> List[List[int]]:
+    """Canonical 26-direction serialization (x,y,z,dir -> r rows)."""
+    return [[d.x, d.y, d.z, radius.dir(d)] for d in all_directions()]
+
+
+def fingerprint(inputs: Dict) -> str:
+    """Stable hash of the fingerprint inputs (sorted-key JSON)."""
+    blob = json.dumps(inputs, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def fingerprint_inputs(platform: str, device_count: int,
+                       mesh_shape: Sequence[int],
+                       grid: Sequence[int], radius: Radius,
+                       quantities: Dict[str, str],
+                       boundary: str, n_slices: int = 1,
+                       library_version: Optional[str] = None) -> Dict:
+    """The identity a plan is valid for (see module docstring).
+    ``quantities`` maps name -> numpy dtype string."""
+    if library_version is None:
+        from .. import __version__ as library_version
+    return {
+        "platform": str(platform),
+        "device_count": int(device_count),
+        "mesh_shape": [int(v) for v in mesh_shape],
+        "grid": [int(v) for v in grid],
+        "radius": radius_signature(radius),
+        "quantities": {str(k): str(v) for k, v in quantities.items()},
+        "boundary": str(boundary),
+        "n_slices": int(n_slices),
+        "library_version": str(library_version),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the Plan
+
+
+@dataclasses.dataclass
+class Plan:
+    """The autotuner's output: the winning configuration plus the
+    evidence (coefficients, per-candidate costs) and provenance."""
+
+    config: Candidate
+    fingerprint: str
+    #: link class -> {"alpha_s": ..., "beta_bytes_per_s": ...}
+    coefficients: Dict[str, Dict[str, float]]
+    #: candidate key -> {"predicted_s": ..., "measured_s": ...?}
+    costs: Dict[str, Dict[str, float]]
+    provenance: str = "tuned"        # tuned | cached | default
+    measurements: int = 0            # timer invocations THIS process
+    created: float = 0.0
+    library_version: str = ""
+    fingerprint_inputs: Optional[Dict] = None
+    #: predict_exchange_every's calibrated depth-crossover estimate
+    #: (observability: what the analytic model alone would have picked)
+    predicted_best_depth: Optional[int] = None
+
+    def to_record(self) -> Dict:
+        rec = dataclasses.asdict(self)  # recurses into Candidate
+        rec["schema"] = SCHEMA_VERSION
+        return rec
+
+    @staticmethod
+    def from_record(rec: Dict) -> "Plan":
+        cfg = rec["config"]
+        return Plan(
+            config=Candidate(str(cfg["method"]),
+                             int(cfg["exchange_every"]),
+                             bool(cfg.get("overlap", False))),
+            fingerprint=str(rec["fingerprint"]),
+            coefficients=dict(rec.get("coefficients", {})),
+            costs=dict(rec.get("costs", {})),
+            provenance=str(rec.get("provenance", "tuned")),
+            measurements=int(rec.get("measurements", 0)),
+            created=float(rec.get("created", 0.0)),
+            library_version=str(rec.get("library_version", "")),
+            fingerprint_inputs=rec.get("fingerprint_inputs"),
+            predicted_best_depth=rec.get("predicted_best_depth"),
+        )

@@ -111,4 +111,32 @@ def exchange_stats_report(dd) -> str:
         line += (f" exchange_every={s}"
                  f" amortized={amortized:.0f}B/step"
                  f" ({tm / s:.6e}s/step exchange cost)")
+    # autotuned domains: say who decided this configuration
+    prov = getattr(dd, "plan_provenance", "default")
+    if prov != "default":
+        line += f" plan={prov}"
     return line
+
+
+def autotune_report(plan) -> str:
+    """Multi-line report of an autotuner Plan (stencil_tpu/tuning):
+    the decision, its provenance, the measured link coefficients, and
+    the best few candidate costs — the plan-file observability analog
+    of the reference's transport-routing printout
+    (src/stencil.cu:482-637)."""
+    lines = [f"autotune: {plan.config.key()} provenance={plan.provenance}"
+             f" measurements={plan.measurements}"
+             f" fingerprint={plan.fingerprint[:12]}..."]
+    for link, c in sorted(plan.coefficients.items()):
+        lines.append(f"  link {link}: alpha={c['alpha_s']:.3e}s"
+                     f" beta={c['beta_bytes_per_s']:.3e}B/s (measured)")
+    ranked = sorted(plan.costs.items(),
+                    key=lambda kv: kv[1].get(
+                        "measured_s", kv[1].get("predicted_s", 0.0)))
+    for key, rec in ranked[:4]:
+        meas = (f" measured={rec['measured_s']:.3e}s/step"
+                if "measured_s" in rec else " (pruned by model)")
+        lines.append(f"  {key}: predicted="
+                     f"{rec.get('predicted_s', float('nan')):.3e}s/step"
+                     f"{meas}")
+    return "\n".join(lines)

@@ -1,0 +1,53 @@
+"""Negative control for the tuned-plan registry gate.
+
+The autotuner's whole trust story is that every configuration a plan
+can apply is already under the registry's HLO ppermute-only gate
+(``tuning.plan[*]`` targets). This fixture is the attack that gate
+exists for: a plan record tampered with (or a buggy plan-application
+path) that silently enables the O(domain) ``AllGather`` strategy while
+the registered contract still claims collective-permute-only halo
+traffic. The lowered StableHLO betrays it — ``python -m
+stencil_tpu.analysis tests/fixtures/lint/bad_plan.py`` MUST exit
+nonzero.
+"""
+
+import jax
+
+from stencil_tpu.analysis import HloSpec, HloTarget
+from stencil_tpu.geometry import Radius
+from stencil_tpu.parallel.exchange import make_exchange
+from stencil_tpu.parallel.mesh import make_mesh
+from stencil_tpu.parallel.methods import Method
+from stencil_tpu.tuning import Candidate, Plan
+
+
+def _tampered_plan() -> Plan:
+    """A plan-cache record whose method field was flipped to AllGather
+    — fingerprint and provenance look perfectly healthy."""
+    return Plan.from_record({
+        "config": {"method": "AllGather", "exchange_every": 1,
+                   "overlap": False},
+        "fingerprint": "deadbeef" * 4,
+        "coefficients": {"ici": {"alpha_s": 2e-5,
+                                 "beta_bytes_per_s": 4.5e10}},
+        "costs": {}, "provenance": "cached", "measurements": 0,
+        "created": 0.0, "library_version": "0.1.0",
+    })
+
+
+def _plan_applied_exchange() -> HloSpec:
+    """Apply the tampered plan the way a deployment would and register
+    the result under the tuned-plan contract (collective-permute
+    only): the hlo checker must flag the smuggled all-gather."""
+    plan = _tampered_plan()
+    mesh = make_mesh((2, 2, 2), jax.devices()[:8])
+    radius = Radius.constant(1).deepened(plan.config.exchange_every)
+    ex = make_exchange(mesh, radius, Method[plan.config.method])
+    arg = {"q": jax.ShapeDtypeStruct((20, 20, 20), jax.numpy.float32)}
+    return HloSpec(fn=ex, args=(arg,), allow=("collective_permute",))
+
+
+TARGETS = [
+    HloTarget("fixture.plan_silently_enables_allgather",
+              _plan_applied_exchange),
+]

@@ -83,7 +83,7 @@ def test_hlo_registry_collective_permute_only():
         if "collectives" in m:
             kinds_by_target[key] = set(m["collectives"])
     for key, kinds in kinds_by_target.items():
-        if "allgather" in key:
+        if "allgather" in key.lower():
             assert kinds == {"all_gather"}, (key, kinds)
         else:
             assert kinds <= {"collective_permute"}, (key, kinds)
@@ -175,6 +175,37 @@ def test_hlo_fixture_flagged():
     assert "2304 B/shard" in m and "1152 B/shard" in m and "+100.0%" in m
 
 
+def test_plan_fixture_flagged():
+    """A tampered/buggy tuned plan that silently enables the AllGather
+    strategy must trip the registry's ppermute-only HLO gate — the
+    negative control proving tuned-plan coverage is not vacuous."""
+    from stencil_tpu.analysis.hlo import lowering_supported
+
+    if not lowering_supported():
+        pytest.skip("no StableHLO lowering in this JAX/backend")
+    report = run_targets(load_targets(FIXTURES / "bad_plan.py"))
+    assert not report.ok
+    msgs = {f.target: f.message for f in report.errors}
+    assert "stablehlo.all_gather" in \
+        msgs["fixture.plan_silently_enables_allgather"]
+
+
+def test_tuner_emittable_configs_are_registered():
+    """Every (method, depth) configuration the autotuner's candidate
+    space can emit on a capability-complete backend has a tuning.plan
+    HLO target in the shipped registry (the Auto manifest entry's
+    substance)."""
+    from stencil_tpu.tuning.plan import DEFAULT_DEPTHS, PLAN_METHODS
+
+    names = _registry_names()
+    for method in PLAN_METHODS:
+        depths = DEFAULT_DEPTHS if method in (
+            "PpermuteSlab", "PpermutePacked") else (1,)
+        for s in depths:
+            assert f"tuning.plan[{method},s={s},hlo]" in names, \
+                f"emittable plan config {method} s={s} unregistered"
+
+
 def test_vmem_fixture_flagged():
     report = run_targets(load_targets(FIXTURES / "bad_vmem.py"))
     assert not report.ok
@@ -263,13 +294,14 @@ def test_cli_list_and_only(capsys, tmp_path):
 
 @pytest.mark.parametrize("fixture", ["bad_footprint.py", "bad_dma.py",
                                      "bad_collective.py", "bad_hlo.py",
-                                     "bad_vmem.py", "bad_temporal.py"])
+                                     "bad_vmem.py", "bad_temporal.py",
+                                     "bad_plan.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""
     from stencil_tpu.analysis.__main__ import main
 
-    if fixture == "bad_hlo.py":
+    if fixture in ("bad_hlo.py", "bad_plan.py"):
         from stencil_tpu.analysis.hlo import lowering_supported
 
         if not lowering_supported():
@@ -346,7 +378,7 @@ def test_every_exchange_method_is_registered():
     names = _registry_names()
     manifest = exchange_method_targets()
     assert set(manifest) == {"PpermuteSlab", "PpermutePacked",
-                             "PallasDMA", "AllGather"}
+                             "PallasDMA", "AllGather", "Auto"}
     for method, prefix in manifest.items():
         assert any(n.startswith(prefix) for n in names), \
             f"exchange method {method} ({prefix}) has no analysis target"
