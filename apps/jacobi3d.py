@@ -19,6 +19,44 @@ from _common import (KERNEL_CHOICES, add_dcn_flags, add_device_flags,
                      timed_samples)
 
 
+def _run_resilient(j, args) -> None:
+    """The chaos-smoke entry: drive the solver under the recovery
+    driver with the seeded faults from the --chaos-* flags, then emit
+    a summary line and (optionally) the event-log JSON artifact."""
+    from stencil_tpu.resilience import (FaultPlan, HaloCorruption,
+                                        NaNInjection, Preemption,
+                                        ResiliencePolicy,
+                                        TransientSaveFailure)
+
+    plan = FaultPlan(seed=args.chaos_seed)
+    if args.chaos_nan:
+        plan.nans.append(NaNInjection(step=args.chaos_nan))
+    if args.chaos_halo:
+        plan.halos.append(HaloCorruption(step=args.chaos_halo))
+    if args.chaos_save_fail:
+        plan.save_failures.append(
+            TransientSaveFailure(step=args.chaos_save_fail))
+    if args.chaos_preempt:
+        plan.preemptions.append(Preemption(step=args.chaos_preempt))
+    policy = ResiliencePolicy(check_every=args.check_every,
+                              ckpt_every=args.ckpt_every,
+                              max_retries=args.max_retries,
+                              base_delay=0.01)
+    report = j.run_resilient(args.iters, policy=policy,
+                             ckpt_dir=args.ckpt_dir or None,
+                             faults=plan)
+    if args.events_json:
+        report.write(args.events_json)
+    print(csv_line("jacobi3d-resilient", methods_label(args),
+                   report.steps, report.rollbacks, report.save_retries,
+                   len(report.degradations),
+                   int(report.preempted), report.final_config))
+
+
+def methods_label(args) -> str:
+    return str(methods_from_args(args))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--x", type=int, default=128, help="per-device x size")
@@ -51,6 +89,32 @@ def main() -> None:
     add_placement_flags(ap)
     add_dcn_flags(ap)
     add_device_flags(ap)
+    res = ap.add_argument_group(
+        "resilience", "run under the checkpoint-rollback recovery "
+        "driver (stencil_tpu/resilience); the --chaos-* flags inject "
+        "seeded faults so recovery paths can be smoked in CI")
+    res.add_argument("--resilient", action="store_true",
+                     help="run --iters iterations under run_resilient "
+                          "instead of the timed benchmark loop")
+    res.add_argument("--ckpt-dir", default="",
+                     help="checkpoint/resume directory (preempted runs "
+                          "resume from it on the next invocation)")
+    res.add_argument("--ckpt-every", type=int, default=10)
+    res.add_argument("--check-every", type=int, default=1,
+                     help="health-sentinel probe cadence (steps)")
+    res.add_argument("--max-retries", type=int, default=3)
+    res.add_argument("--events-json", default="",
+                     help="write the resilience event log (JSON) here")
+    res.add_argument("--chaos-nan", type=int, default=0, metavar="STEP",
+                     help="inject one NaN into shard 0 after STEP")
+    res.add_argument("--chaos-halo", type=int, default=0, metavar="STEP",
+                     help="corrupt a halo cell after STEP")
+    res.add_argument("--chaos-save-fail", type=int, default=0,
+                     metavar="STEP", help="the checkpoint save at STEP "
+                     "raises transient IOErrors (retried)")
+    res.add_argument("--chaos-preempt", type=int, default=0,
+                     metavar="STEP", help="deliver SIGTERM after STEP")
+    res.add_argument("--chaos-seed", type=int, default=0)
     args = ap.parse_args()
     apply_device_flags(args)
     dtype = dtype_from_args(args)
@@ -86,6 +150,10 @@ def main() -> None:
     j.init()
     if args.paraview:
         j.dd.write_paraview(args.prefix + "jacobi3d_init")
+
+    if args.resilient:
+        _run_resilient(j, args)
+        return
 
     samples = max(args.iters // args.batch, 1)
     n = 0

@@ -23,7 +23,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
-echo "== 1/7 lint (stencil-lint + ruff; tier=$TIER) =="
+echo "== 1/8 lint (stencil-lint + ruff; tier=$TIER) =="
 # stencil-lint: all six static checkers — halo-radius footprint, DMA
 # discipline, ppermute sanity, HLO collective-permute-only lowering,
 # analytic-vs-HLO byte cross-check, and the Pallas VMEM/tiling audit
@@ -63,10 +63,10 @@ if [ "$TIER" = "full" ]; then
   fi
 fi
 
-echo "== 2/7 native build =="
+echo "== 2/8 native build =="
 bash ci/build.sh
 
-echo "== 3/7 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+echo "== 3/8 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
 # The full tier is dominated by interpret-mode Pallas parity tests
 # (CPU-bound, independent): fan them out with pytest-xdist when the
 # machine has cores to spare. Each worker process builds its own
@@ -82,7 +82,7 @@ else
   python -m pytest tests/ -q --maxfail=1 -m "not slow"
 fi
 
-echo "== 4/7 app smoke runs =="
+echo "== 4/8 app smoke runs =="
 # overlap app smokes execute remote DMA: possible only on a TPU or
 # with the distributed (mosaic) interpreter — probe, don't assume
 RDMA_OK=$(python -c "from stencil_tpu._compat import remote_dma_runnable
@@ -107,7 +107,7 @@ smoke() { echo "-- $*"; python "$@" > /dev/null; }
   smoke bench_qap.py --sizes 4 6
 )
 
-echo "== 5/7 bench smoke: temporal blocking + autotuned plan =="
+echo "== 5/8 bench smoke: temporal blocking + autotuned plan =="
 # communication-avoiding temporal blocking must not regress steps/s of
 # the REAL blocked hot path (Jacobi3D's fused run loop, redundant ring
 # compute included) on the fake CPU mesh; the amortized byte model
@@ -151,7 +151,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -f "$BENCH_JSON" "$TUNE_CACHE"
 
-echo "== 6/7 exchange autotuner (fake timer: search/fit/plan/cache) =="
+echo "== 6/8 exchange autotuner (fake timer: search/fit/plan/cache) =="
 # the tuner's whole pipeline with deterministic fake measurements (no
 # hardware dependence): first invocation tunes and writes the plan
 # cache, the second MUST be a cache hit performing zero measurements.
@@ -182,7 +182,41 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -f "$TUNE_CACHE" "$PLAN1" "$PLAN2"
 
-echo "== 7/7 multi-chip certification sweep =="
+echo "== 7/8 chaos smoke: resilient run loop under injected faults =="
+# the Jacobi app under run_resilient (stencil_tpu/resilience) with a
+# seeded fault plan: one NaN injection (must trip the health sentinel
+# and roll back to the last good checkpoint) and one transient save
+# IOError (must be retried with backoff, not kill the run). The run
+# must COMPLETE all iterations with >= 1 rollback and >= 1 save retry
+# recorded; the resilience event log JSON is the CI artifact.
+CHAOS_CKPT="$(mktemp -d -t chaos_ckpt.XXXXXX)"
+CHAOS_EVENTS="$(mktemp -t chaos_events.XXXXXX.json)"
+( cd apps
+  python jacobi3d.py --x 8 --y 8 --z 8 --iters 12 --fake-cpu 8 \
+        --resilient --ckpt-dir "$CHAOS_CKPT" --ckpt-every 4 \
+        --check-every 1 --chaos-nan 6 --chaos-save-fail 4 \
+        --events-json "$CHAOS_EVENTS" )
+CHAOS_EVENTS="$CHAOS_EVENTS" python - <<'EOF'
+import json
+import os
+d = json.load(open(os.environ["CHAOS_EVENTS"]))
+assert d["steps"] == 12, d
+assert d["rollbacks"] >= 1, d
+assert d["save_retries"] >= 1, d
+assert not d["preempted"], d
+kinds = [e["event"] for e in d["events"]]
+assert "sentinel_tripped" in kinds and "restored" in kinds, kinds
+print(f"chaos smoke OK: {d['steps']} steps completed with "
+      f"{d['rollbacks']} rollback(s), {d['save_retries']} save "
+      f"retr(ies), final config {d['final_config']}")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+  mkdir -p "$CI_ARTIFACT_DIR"
+  cp "$CHAOS_EVENTS" "$CI_ARTIFACT_DIR/chaos_events.json"
+fi
+rm -rf "$CHAOS_CKPT" "$CHAOS_EVENTS"
+
+echo "== 8/8 multi-chip certification sweep =="
 python __graft_entry__.py 8 | tail -1
 
 echo "CI PASSED"

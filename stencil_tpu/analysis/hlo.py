@@ -69,12 +69,16 @@ class HloSpec:
     ``allow=("all_gather",)`` — deliberately O(domain), benchmarked as
     such. ``expect_collective`` guards against the checker passing
     vacuously on a refactor that traced away the exchange.
+    ``exact_counts`` pins the op count of specific kinds — the health
+    sentinel registers its probe with ``{"all_reduce": 1}`` to prove
+    it adds exactly one small all-reduce and nothing else.
     """
 
     fn: Callable
     args: Sequence[Any]
     allow: Tuple[str, ...] = ("collective_permute",)
     expect_collective: bool = True
+    exact_counts: Optional[Dict[str, int]] = None
 
 
 @dataclasses.dataclass
@@ -266,6 +270,15 @@ def check_hlo(target: HloTarget) -> Tuple[List[Finding], Dict]:
                 f"({entry['bytes_per_shard']} B/shard) — a halo "
                 f"exchange must be {'/'.join(spec.allow)} only; this "
                 f"collective moves O(domain), not O(halo), bytes",
+                ERROR))
+    for kind, want in sorted((spec.exact_counts or {}).items()):
+        got = metrics["collectives"].get(kind, {}).get("count", 0)
+        if got != want:
+            findings.append(Finding(
+                "hlo", target.name,
+                f"lowers to {got} stablehlo.{kind} ops, contract "
+                f"requires exactly {want} — extra collectives mean "
+                f"hidden communication smuggled into the step program",
                 ERROR))
     if spec.expect_collective and not ops:
         findings.append(Finding(

@@ -610,6 +610,71 @@ def _plan_targets() -> List[Target]:
 
 
 # ---------------------------------------------------------------------------
+# resilience targets: the health sentinel's in-graph probe. The probe
+# rides the production step loop, so its communication contract is the
+# whole point: exactly ONE small all-reduce (the stacked-stats pmax)
+# and nothing else — a sentinel that smuggled extra collectives into
+# every check_every-th step would tax the fabric it is guarding.
+
+
+def _health_probe_spec() -> HloSpec:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..resilience.health import probe_shard
+
+    mesh = _mesh((2, 2, 2))
+    spec = P("z", "y", "x")
+
+    def shard(a, b):
+        return probe_shard({"a": a, "b": b})
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(spec, spec),
+                       out_specs=P(), check_vma=False)
+    return HloSpec(fn=sm, args=(_f32((16, 16, 16)), _f32((16, 16, 16))),
+                   allow=("all_reduce",),
+                   exact_counts={"all_reduce": 1})
+
+
+def _health_step_probe_spec() -> HloSpec:
+    """The probe fused INTO the production jacobi step: the step's own
+    collective-permutes plus exactly one all-reduce — the lowering the
+    resilient run loop actually dispatches on probe steps."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3, Radius
+    from ..models.jacobi import jacobi_shard_step
+    from ..parallel.exchange import shard_origin
+    from ..parallel.mesh import mesh_dim
+    from ..parallel.methods import Method
+    from ..resilience.health import probe_shard
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+    local = Dim3(12, 12, 12)
+    gsize = Dim3(24, 24, 24)
+
+    def shard(p):
+        origin = shard_origin(local, Dim3(0, 0, 0))
+        stepped = jacobi_shard_step(p, radius, counts, local, gsize,
+                                    origin, Method.PpermuteSlab)
+        return stepped, probe_shard({"temp": stepped})
+
+    spec = P("z", "y", "x")
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=spec,
+                       out_specs=(spec, P()), check_vma=False)
+    # 6 ppermutes = the slab exchange's own 2-per-axis contract; a
+    # probe-fusion regression that re-triggers the exchange would
+    # double them and must fail the gate, not just the all_reduce pin
+    return HloSpec(fn=sm, args=(_f32(_EXCHANGE_GLOBAL),),
+                   allow=("collective_permute", "all_reduce"),
+                   exact_counts={"all_reduce": 1,
+                                 "collective_permute": 6})
+
+
+# ---------------------------------------------------------------------------
 # VMEM targets: every shipped Pallas kernel's static memory/tiling
 # audit. The overlap/RDMA builders are shared with the dma targets;
 # the single-chip wrap/halo fast-path kernels (previously outside the
@@ -872,6 +937,13 @@ def default_targets() -> List[Target]:
     ]
     # every exchange configuration the autotuner can emit (Method.Auto)
     targets += _plan_targets()
+    # the health sentinel's probe: exactly one small all-reduce, alone
+    # and fused into the production step (see resilience/health.py)
+    targets += [
+        HloTarget("resilience.health.probe[hlo]", _health_probe_spec),
+        HloTarget("resilience.health.step+probe[hlo]",
+                  _health_step_probe_spec),
+    ]
     # static VMEM/tiling audit: every shipped Pallas kernel
     targets += [
         VmemTarget("parallel.pallas_exchange.exchange_shard_pallas[vmem]",

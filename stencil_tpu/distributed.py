@@ -573,6 +573,19 @@ class DistributedDomain:
         np.savetxt(f"{prefix}comm_matrix.txt", w, fmt="%d")
 
     # ------------------------------------------------------------------
+    # checkpointing (utils/checkpoint.py keeps one cached
+    # CheckpointManager per directory; the save loop of a long campaign
+    # reuses it instead of paying construct/close churn every save)
+    # ------------------------------------------------------------------
+    def close_checkpoints(self) -> None:
+        """Release the cached checkpoint managers for every directory
+        this domain saved to or restored from (also runs atexit; call
+        explicitly when a campaign rotates checkpoint directories)."""
+        from .utils.checkpoint import close_checkpoints
+        for d in getattr(self, "_ckpt_dirs", ()):
+            close_checkpoints(d)
+
+    # ------------------------------------------------------------------
     # IO (reference: src/stencil.cu:1188-1264)
     # ------------------------------------------------------------------
     def interior_to_host(self, name: str) -> np.ndarray:

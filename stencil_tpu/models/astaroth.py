@@ -1074,6 +1074,48 @@ class Astaroth:
             return np.asarray(inner[name])
         return self.dd.interior_to_host(name)
 
+    # -- resilient run loop (stencil_tpu/resilience) -------------------
+    def run_resilient(self, n_steps: int, policy=None,
+                      ckpt_dir: Optional[str] = None, faults=None):
+        """``n_steps`` RK3 iterations under the checkpoint-rollback
+        recovery driver. The RK accumulators ride the checkpoint as
+        ``extra`` arrays; interior-resident fast-path state is flushed
+        (``sync_domain``) before every save and invalidated on restore.
+        Returns the :class:`~stencil_tpu.resilience.ResilienceReport`."""
+        from ..resilience.driver import run_resilient
+
+        size = self.dd.size
+
+        def rebuild(cfg):
+            from .jacobi import _dcn_request_kwargs
+            new = Astaroth(size.x, size.y, size.z, params=self.prm,
+                           mesh_shape=tuple(self.dd.placement.dim()),
+                           dtype=self._dtype, devices=self.dd._devices,
+                           methods=cfg.method, kernel=self._kernel,
+                           overlap=self._overlap,
+                           exchange_every=cfg.exchange_every,
+                           boundary=self.dd.boundary,
+                           **_dcn_request_kwargs(self.dd))
+            # adopt in place; re-point the interior-write hook at the
+            # surviving handle so sync_domain keeps working
+            new.dd._on_interior_write.clear()
+            self.__dict__.update(new.__dict__)
+            self.dd.on_interior_write(lambda name: self.sync_domain())
+            return self.dd, self.step
+
+        def on_restore(extras):
+            # restored state replaces everything the fast paths cached
+            self._inner = None
+            self._w = dict(extras) if extras else None
+
+        return run_resilient(
+            self.dd, self.step, n_steps, policy=policy,
+            ckpt_dir=ckpt_dir, faults=faults, rebuild=rebuild,
+            extra_fn=lambda: self._w, on_restore=on_restore,
+            fields_fn=lambda: (self._inner if self._inner is not None
+                               else self.dd.curr),
+            pre_checkpoint=self.sync_domain)
+
 
 # ----------------------------------------------------------------------
 # initial-condition fields (reference: astaroth/astaroth.cu:84-200)

@@ -141,6 +141,18 @@ def jacobi_shard_step_overlap(p, radius: Radius, counts: Dim3, local: Dim3,
     return write_interior(p_ex["temp"], out, radius)
 
 
+def _dcn_request_kwargs(dd) -> dict:
+    """The DCN-tier request the domain was configured with, as model
+    constructor kwargs — a degradation rebuild must not silently strip
+    the slice tiering (``None`` axis means auto-derive, which the
+    constructors spell ``"auto"``)."""
+    if not dd._dcn_requested:
+        return {}
+    req = dd._dcn_axis_req
+    return {"dcn_axis": "auto" if req is None else req,
+            "dcn_groups": dd._dcn_groups}
+
+
 def _wrap_steps(tile: int, requested: int = 0) -> int:
     """Temporal-blocking depth for the Pallas fast paths: an explicit
     ``exchange_every`` request wins; else STENCIL_WRAP_STEPS (default
@@ -737,6 +749,41 @@ class Jacobi3D:
     def temperature(self) -> np.ndarray:
         """Global interior (z,y,x) on host."""
         return self.dd.interior_to_host("temp")
+
+    # -- resilient run loop (stencil_tpu/resilience) -------------------
+    def run_resilient(self, n_steps: int, policy=None,
+                      ckpt_dir: Optional[str] = None, faults=None):
+        """``n_steps`` iterations under the checkpoint-rollback
+        recovery driver (:func:`stencil_tpu.resilience.run_resilient`):
+        health sentinels every ``policy.check_every`` steps, integrity-
+        checked checkpoints every ``policy.ckpt_every``, rollback +
+        bounded retry on divergence, configuration degradation on
+        repeat failure (the solver is rebuilt in place at the softer
+        config), and clean SIGTERM preemption/resume via ``ckpt_dir``.
+        Returns the :class:`~stencil_tpu.resilience.ResilienceReport`."""
+        from ..resilience.driver import run_resilient
+
+        def rebuild(cfg):
+            new = Jacobi3D(
+                self.dd.size.x, self.dd.size.y, self.dd.size.z,
+                mesh_shape=tuple(self.dd.placement.dim()),
+                dtype=self._dtype, devices=self.dd._devices,
+                methods=cfg.method, kernel=self._kernel,
+                overlap=self._overlap,
+                exchange_every=cfg.exchange_every,
+                boundary=self.dd.boundary,
+                placement=self.dd.strategy,
+                output_prefix=self.dd._output_prefix,
+                **_dcn_request_kwargs(self.dd))
+            # adopt the rebuilt engine in place so the caller's handle
+            # (and the driver's fields_fn closure) stay valid
+            self.__dict__.update(new.__dict__)
+            return self.dd, self.step
+
+        return run_resilient(self.dd, self.step, n_steps, policy=policy,
+                             ckpt_dir=ckpt_dir, faults=faults,
+                             rebuild=rebuild,
+                             fields_fn=lambda: self.dd.curr)
 
 
 def dense_reference_step(temp: np.ndarray, hot_c: Tuple[int, int, int],

@@ -1,0 +1,39 @@
+"""Driver-level robustness: health sentinels, fault injection, and
+checkpoint-rollback recovery (see README "Resilience").
+
+Three cooperating parts:
+
+* :mod:`.health` — a fused on-device probe (one small all-reduce,
+  proven by the ``resilience.health.*`` stencil-lint targets) with
+  async host readback and a divergence predicate;
+* :mod:`.faults` — deterministic, seeded fault injection (NaN steps,
+  corrupted halos, checkpoint bit-rot, transient save ``IOError``,
+  SIGTERM preemption) so every recovery path is pinned by tier-1;
+* :mod:`.driver` — ``run_resilient``: checkpoint / watch / roll back /
+  degrade / resume around any per-step engine.
+"""
+
+from .driver import (ResilienceError, ResiliencePolicy, ResilienceReport,
+                     StepConfig, degradation_ladder, run_resilient)
+from .faults import (CheckpointCorruption, FaultPlan, HaloCorruption,
+                     NaNInjection, Preemption, TransientSaveFailure)
+from .health import HealthSentinel, HealthStats, make_probe, probe_shard
+
+__all__ = [
+    "CheckpointCorruption",
+    "FaultPlan",
+    "HaloCorruption",
+    "HealthSentinel",
+    "HealthStats",
+    "NaNInjection",
+    "Preemption",
+    "ResilienceError",
+    "ResiliencePolicy",
+    "ResilienceReport",
+    "StepConfig",
+    "TransientSaveFailure",
+    "degradation_ladder",
+    "make_probe",
+    "probe_shard",
+    "run_resilient",
+]

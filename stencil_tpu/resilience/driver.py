@@ -1,0 +1,401 @@
+"""Checkpoint-rollback recovery driver: the resilient run loop.
+
+``run_resilient(domain, step_fn, n_steps, policy)`` wraps any per-step
+engine (``Jacobi3D.step``, ``Astaroth.step``, or a bare closure over a
+``DistributedDomain``) with the full detect → degrade → retry ladder
+the reference library never had and production stencil codes
+(PIConGPU, arXiv:1606.02862) treat as table stakes:
+
+* **checkpoint** every ``ckpt_every`` steps (integrity sha256 in the
+  meta record, transient-I/O retry with backoff), after a *blocking*
+  health drain so poisoned state is never persisted;
+* **watch** via the in-graph :class:`~.health.HealthSentinel` every
+  ``check_every`` steps — async readback, the loop never stalls;
+* **roll back** to the last good checkpoint when the sentinel trips
+  (corrupt checkpoints fall back to older steps automatically), with
+  bounded attempts and exponential backoff;
+* **degrade** when retries at the current configuration are exhausted:
+  drop ``exchange_every`` toward 1, then fall down the capability-aware
+  ``pick_method`` priority list (PR 4's fallback, reused) — the caller
+  supplies ``rebuild(config)`` to re-realize the engine;
+* **preempt cleanly**: SIGTERM (a fleet scheduler reclaiming the host,
+  or an injected :class:`~.faults.Preemption`) writes a final
+  checkpoint tagged ``preempted`` and returns; the next
+  ``run_resilient`` on the same directory resumes from it.
+
+Everything lands in a JSON-serializable :class:`ResilienceReport`
+event log — the CI chaos-smoke artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import signal
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..parallel.methods import (METHOD_PRIORITY, Method, method_runnable,
+                                pick_method)
+from ..utils.checkpoint import restore_domain, save_domain
+from ..utils.logging import LOG_INFO, LOG_WARN
+from ..utils.retry import retry
+from .faults import FaultPlan
+from .health import HealthSentinel, HealthStats
+
+
+class ResilienceError(RuntimeError):
+    """The run could not be kept alive: the sentinel tripped with no
+    checkpoint to roll back to, or every retry and degradation was
+    exhausted."""
+
+
+@dataclasses.dataclass
+class ResiliencePolicy:
+    """Knobs of the resilient loop (see README "Resilience")."""
+
+    check_every: int = 10       # sentinel probe cadence (steps)
+    ckpt_every: int = 50        # checkpoint cadence (steps)
+    max_retries: int = 3        # rollbacks per configuration
+    base_delay: float = 0.05    # backoff seed (seconds), doubles
+    save_attempts: int = 3      # transient-I/O retries per save
+    max_to_keep: Optional[int] = 3   # checkpoint history depth
+    window: int = 8             # sentinel sliding window (probes)
+    growth_factor: float = 1e6  # max-abs growth trip factor
+    degrade: bool = True        # walk the degradation ladder
+    sleep: Callable[[float], None] = time.sleep  # injectable clock
+
+
+@dataclasses.dataclass(frozen=True)
+class StepConfig:
+    """One rung of the degradation ladder: the exchange transport and
+    temporal-blocking depth the engine should be rebuilt with."""
+
+    method: Method
+    exchange_every: int
+
+    def key(self) -> str:
+        return f"{self.method.name}[s={self.exchange_every}]"
+
+
+def degradation_ladder(method: Method, exchange_every: int,
+                       runnable: Optional[Callable[[Method], bool]] = None
+                       ) -> List[StepConfig]:
+    """Successively safer configurations: first halve the temporal-
+    blocking depth down to per-step exchanges (deep halos stress the
+    fabric hardest), then fall down the capability-aware
+    ``pick_method`` priority list below the current transport.
+    ``runnable`` is injectable for tests (defaults to the real
+    capability probe)."""
+    if runnable is None:
+        runnable = method_runnable
+    out: List[StepConfig] = []
+    s = int(exchange_every)
+    while s > 1:
+        s //= 2
+        out.append(StepConfig(method, s))
+    live = [m for m in METHOD_PRIORITY if runnable(m)]
+    if method in live:
+        live = live[live.index(method) + 1:]
+    out.extend(StepConfig(m, 1) for m in live)
+    return out
+
+
+@dataclasses.dataclass
+class ResilienceReport:
+    """What happened, machine-readable (the chaos-smoke CI artifact)."""
+
+    steps: int = 0
+    rollbacks: int = 0
+    save_retries: int = 0
+    degradations: List[str] = dataclasses.field(default_factory=list)
+    preempted: bool = False
+    resumed_from: Optional[int] = None
+    final_config: str = ""
+    events: List[Dict] = dataclasses.field(default_factory=list)
+
+    def log(self, kind: str, **kw) -> None:
+        self.events.append({"event": kind, "time": time.time(), **kw})
+
+    def to_record(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_record(), f, indent=1)
+
+
+def _current_config(dd) -> StepConfig:
+    return StepConfig(pick_method(dd.methods), dd.exchange_every)
+
+
+class _ResilientRun:
+    """One ``run_resilient`` invocation (state bundled for clarity)."""
+
+    def __init__(self, dd, step_fn, n_steps, policy, ckpt_dir, faults,
+                 rebuild, extra_fn, on_restore, fields_fn,
+                 pre_checkpoint):
+        self.dd = dd
+        self.step_fn = step_fn
+        self.n_steps = int(n_steps)
+        self.policy = policy or ResiliencePolicy()
+        self.ckpt_dir = ckpt_dir
+        self.faults = faults
+        self.rebuild = rebuild
+        self.extra_fn = extra_fn
+        self.on_restore = on_restore
+        self.fields_fn = fields_fn
+        self.pre_checkpoint = pre_checkpoint
+        self.report = ResilienceReport()
+        if faults is not None:
+            faults.bind(self.report.log)
+        self.sentinel = HealthSentinel(
+            dd, window=self.policy.window,
+            growth_factor=self.policy.growth_factor)
+        self.step = 0
+        self.attempts = 0
+        self.last_saved: Optional[int] = None
+        self.ladder: Optional[List[StepConfig]] = None
+        self._preempt = False
+
+    # -- helpers --------------------------------------------------------
+    def _fields(self):
+        return self.fields_fn() if self.fields_fn is not None \
+            else self.dd.curr
+
+    def _save(self, preempted: bool = False) -> None:
+        if self.pre_checkpoint is not None:
+            self.pre_checkpoint()
+        extra = self.extra_fn() if self.extra_fn is not None else None
+        meta_extra = {"preempted": preempted,
+                      "completed_steps": self.step,
+                      "config": _current_config(self.dd).key()}
+        step = self.step
+
+        def attempt():
+            if self.faults is not None:
+                self.faults.maybe_fail_save(step)
+            # attempts=1: THIS retry loop (policy clock, event-logged)
+            # is the only one — no hidden nested retries inside
+            save_domain(self.dd, self.ckpt_dir, step, extra=extra,
+                        max_to_keep=self.policy.max_to_keep,
+                        meta_extra=meta_extra, attempts=1)
+
+        def on_retry(k, e, delay):
+            self.report.save_retries += 1
+            self.report.log("save_retry", step=step, attempt=k,
+                            error=f"{type(e).__name__}: {e}",
+                            delay=delay)
+
+        retry(attempt, attempts=self.policy.save_attempts,
+              base_delay=self.policy.base_delay, retriable=(OSError,),
+              sleep=self.policy.sleep, on_retry=on_retry)
+        if self.faults is not None:
+            self.faults.after_save(self.ckpt_dir, step)
+        self.last_saved = step
+        # a successful checkpoint is verified-healthy progress: bound
+        # retries per INCIDENT, not per configuration lifetime —
+        # independent transient faults days apart must not accumulate
+        # toward forced degradation
+        self.attempts = 0
+        self.report.log("checkpoint", step=step, preempted=preempted)
+
+    def _drain_probe(self) -> List[HealthStats]:
+        """Blocking health verdict on the CURRENT state (used at
+        checkpoint boundaries and loop end). Reuses an in-flight probe
+        of this step rather than paying a duplicate reduction."""
+        if not self.sentinel.has_pending(self.step):
+            self.sentinel.probe(self._fields(), self.step)
+        results = self.sentinel.poll(block=True)
+        return [s for s in results if s.tripped]
+
+    def _restore(self) -> None:
+        step, extras = restore_domain(self.dd, self.ckpt_dir)
+        if self.on_restore is not None:
+            self.on_restore(extras)
+        self.step = step
+        self.sentinel.reset()
+        self.report.log("restored", step=step)
+
+    def _handle_trip(self, tripped: List[HealthStats]) -> None:
+        stats = tripped[0]
+        self.report.rollbacks += 1
+        self.attempts += 1
+        self.report.log("sentinel_tripped", step=stats.step,
+                        reason=stats.reason,
+                        stats=stats.to_record(),
+                        attempt=self.attempts)
+        LOG_WARN(f"health sentinel tripped at step {stats.step}: "
+                 f"{stats.reason} (attempt {self.attempts}/"
+                 f"{self.policy.max_retries})")
+        if self.ckpt_dir is None:
+            raise ResilienceError(
+                f"sentinel tripped at step {stats.step} "
+                f"({stats.reason}) and no ckpt_dir was given — "
+                f"nothing to roll back to")
+        if self.attempts > self.policy.max_retries:
+            self._degrade_or_die(stats)  # resets attempts to 0
+        self.policy.sleep(self.policy.base_delay
+                          * (2 ** max(self.attempts - 1, 0)))
+        self._restore()
+
+    def _degrade_or_die(self, stats: HealthStats) -> None:
+        if self.ladder is None:
+            cfg = _current_config(self.dd)
+            self.ladder = degradation_ladder(cfg.method,
+                                             cfg.exchange_every)
+        # walk rungs until one actually realizes: capability is known
+        # up front (method_runnable) but domain feasibility (uneven
+        # shards, Boundary.NONE, temporal-depth limits) only surfaces
+        # in the constructor — an infeasible rung is skipped, never
+        # allowed to kill the recovery with a raw NotImplementedError
+        while (self.policy.degrade and self.rebuild is not None
+               and self.ladder):
+            cfg = self.ladder.pop(0)
+            LOG_WARN(f"degrading configuration to {cfg.key()} after "
+                     f"repeated failures")
+            try:
+                self.dd, self.step_fn = self.rebuild(cfg)
+            except (NotImplementedError, ValueError) as e:
+                self.report.log("degrade_rung_infeasible",
+                                config=cfg.key(),
+                                error=f"{type(e).__name__}: {e}")
+                LOG_WARN(f"degradation rung {cfg.key()} is infeasible "
+                         f"for this domain ({e}); trying the next")
+                continue
+            self.sentinel = HealthSentinel(
+                self.dd, window=self.policy.window,
+                growth_factor=self.policy.growth_factor)
+            self.attempts = 0
+            self.report.degradations.append(cfg.key())
+            self.report.log("degraded", config=cfg.key())
+            return
+        raise ResilienceError(
+            f"retries exhausted ({self.policy.max_retries}) at "
+            f"step {stats.step}: {stats.reason}; no degradation "
+            f"available")
+
+    # -- the loop -------------------------------------------------------
+    def run(self) -> ResilienceReport:
+        policy = self.policy
+        if self.ckpt_dir is not None:
+            try:
+                self._restore()
+                self.report.resumed_from = self.step
+                LOG_INFO(f"resuming from checkpoint step {self.step}")
+            except FileNotFoundError:
+                self._save()  # step 0: the rollback anchor
+        handler_installed = False
+        prev_handler = None
+        if threading.current_thread() is threading.main_thread():
+            prev_handler = signal.signal(
+                signal.SIGTERM, lambda *_: setattr(self, "_preempt",
+                                                   True))
+            handler_installed = True
+        try:
+            while True:
+                if self._preempt:
+                    if self.ckpt_dir is not None:
+                        # same invariant as periodic checkpoints:
+                        # poisoned state must never be persisted — if
+                        # the drain trips, skip the save and let the
+                        # last good checkpoint anchor the resume
+                        tripped = self._drain_probe()
+                        if tripped:
+                            self.report.log(
+                                "preempt_checkpoint_skipped",
+                                step=self.step,
+                                reason=tripped[0].reason)
+                            LOG_WARN(
+                                f"preempted at step {self.step} with "
+                                f"unhealthy state ({tripped[0].reason})"
+                                f"; NOT checkpointing it — resume will "
+                                f"restore step {self.last_saved}")
+                        else:
+                            self._save(preempted=True)
+                    self.report.preempted = True
+                    self.report.log("preempted", step=self.step)
+                    LOG_WARN(f"preempted at step {self.step}; exiting "
+                             f"cleanly")
+                    break
+                if self.step >= self.n_steps:
+                    if self.last_saved == self.step:
+                        break  # this step already drained + saved
+                    tripped = self._drain_probe()
+                    if tripped:
+                        self._handle_trip(tripped)
+                        continue
+                    if self.ckpt_dir is not None:
+                        self._save()
+                    break
+                self.step_fn()
+                self.step += 1
+                self.report.steps = self.step
+                if self.faults is not None:
+                    # faults hit the LIVE fields — the same dict the
+                    # sentinel probes (interior-resident fast paths
+                    # keep their state outside dd.curr)
+                    self.faults.on_step(self.dd, self.step,
+                                        self._fields())
+                if self._preempt:
+                    continue  # SIGTERM landed during the step
+                ckpt_due = (self.ckpt_dir is not None
+                            and self.step % policy.ckpt_every == 0)
+                if self.step % policy.check_every == 0 and not ckpt_due:
+                    # checkpoint boundaries probe via the blocking
+                    # drain below — one reduction per step, not two
+                    self.sentinel.probe(self._fields(), self.step)
+                tripped = [s for s in self.sentinel.poll()
+                           if s.tripped]
+                if tripped:
+                    self._handle_trip(tripped)
+                    continue
+                if ckpt_due:
+                    tripped = self._drain_probe()
+                    if tripped:
+                        self._handle_trip(tripped)
+                        continue
+                    self._save()
+        finally:
+            if handler_installed:
+                signal.signal(signal.SIGTERM,
+                              prev_handler if prev_handler is not None
+                              else signal.SIG_DFL)
+        self.report.steps = self.step
+        self.report.final_config = _current_config(self.dd).key()
+        return self.report
+
+
+def run_resilient(dd, step_fn: Callable[[], None], n_steps: int,
+                  policy: Optional[ResiliencePolicy] = None,
+                  ckpt_dir: Optional[str] = None,
+                  faults: Optional[FaultPlan] = None,
+                  rebuild: Optional[Callable] = None,
+                  extra_fn: Optional[Callable[[], Optional[Dict]]] = None,
+                  on_restore: Optional[Callable[[Dict], None]] = None,
+                  fields_fn: Optional[Callable[[], Dict]] = None,
+                  pre_checkpoint: Optional[Callable[[], None]] = None
+                  ) -> ResilienceReport:
+    """Drive ``step_fn`` for ``n_steps`` steps with health sentinels,
+    periodic integrity-checked checkpoints, rollback-retry recovery,
+    optional configuration degradation, and clean SIGTERM preemption.
+
+    ``dd``: the realized :class:`~stencil_tpu.distributed.
+    DistributedDomain` whose ``curr`` fields ARE the run state.
+    ``step_fn()``: advance the state by one step (e.g. a model's
+    ``step`` bound method). ``ckpt_dir``: checkpoint directory; when
+    None the sentinel still watches but a trip raises (watchdog-only
+    mode). ``rebuild(config)``: re-realize the engine at a degraded
+    :class:`StepConfig`, returning ``(dd, step_fn)`` — required for the
+    degradation ladder. ``extra_fn``/``on_restore``: checkpoint and
+    reinstall auxiliary state (RK accumulators). ``fields_fn``: the
+    dict the sentinel probes (defaults to ``dd.curr``).
+    ``pre_checkpoint``: flush hook run before every save (fast paths
+    sync interior-resident state). Returns a :class:`ResilienceReport`;
+    if it says ``preempted``, rerun with the same ``ckpt_dir`` to
+    resume. If a run was previously preempted mid-campaign, the same
+    call resumes it automatically."""
+    return _ResilientRun(dd, step_fn, n_steps, policy, ckpt_dir, faults,
+                         rebuild, extra_fn, on_restore, fields_fn,
+                         pre_checkpoint).run()

@@ -1,0 +1,281 @@
+"""Deterministic fault injection: every recovery path is rehearsable.
+
+A recovery path that has never run is a bug that hasn't happened yet.
+This module makes the failure modes of a long campaign *injectable* —
+seeded, step-addressed, CPU-runnable — so tier-1 tests (and the CI
+chaos-smoke stage) pin rollback, retry, fallback, and preemption
+behavior deterministically, the way TEMPI (arXiv:2012.14363) rehearses
+its interposed degradation paths.
+
+Fault classes (all dataclasses on a :class:`FaultPlan`):
+
+* :class:`NaNInjection` — poison an interior cell of a chosen shard at
+  a chosen step (a compute blow-up).
+* :class:`HaloCorruption` — poison a halo (pad) cell post-step (a
+  poisoned exchange; the sentinel probes padded fields exactly so this
+  is caught even though the next exchange would overwrite it).
+* :class:`TransientSaveFailure` — the next orbax save raises
+  ``IOError`` for the first N attempts (an NFS blip mid-checkpoint).
+* :class:`CheckpointCorruption` — after checkpoint ``step`` lands on
+  disk, truncate or bit-flip one of its data files (bit-rot; restore
+  must fall back to an older step).
+* :class:`Preemption` — deliver a real ``SIGTERM`` to this process at
+  a chosen step (the fleet scheduler reclaiming the host).
+
+Each event fires at most ``repeat`` times, so a transient fault
+disappears on the retry pass while a persistent one (``repeat`` large)
+keeps tripping until the driver degrades the configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from pathlib import Path
+from typing import Callable, List, Optional, Tuple
+
+from ..utils.logging import LOG_WARN
+
+LogFn = Callable[..., None]
+
+
+def _noop_log(kind: str, **kw) -> None:  # pragma: no cover - default
+    pass
+
+
+@dataclasses.dataclass
+class NaNInjection:
+    """Write NaN into the interior center of shard ``shard`` of
+    ``quantity`` (first registered quantity when None) right after step
+    ``step`` completes."""
+
+    step: int
+    quantity: Optional[str] = None
+    shard: Tuple[int, int, int] = (0, 0, 0)
+    repeat: int = 1
+    fired: int = 0
+
+    def due(self, step: int) -> bool:
+        return step == self.step and self.fired < self.repeat
+
+    def fire(self, dd, log: LogFn, fields=None) -> None:
+        self.fired += 1
+        fields = dd.curr if fields is None else fields
+        q = self.quantity or dd._names[0]
+        z, y, x = _shard_cell(dd, self.shard, interior=True,
+                              arr=fields[q])
+        fields[q] = fields[q].at[z, y, x].set(float("nan"))
+        log("fault_nan", step=self.step, quantity=q,
+            shard=list(self.shard), cell=[z, y, x])
+
+
+@dataclasses.dataclass
+class HaloCorruption:
+    """Write NaN into a halo (pad) cell of shard ``shard`` after step
+    ``step`` — the signature of a poisoned exchange."""
+
+    step: int
+    quantity: Optional[str] = None
+    shard: Tuple[int, int, int] = (0, 0, 0)
+    repeat: int = 1
+    fired: int = 0
+
+    def due(self, step: int) -> bool:
+        return step == self.step and self.fired < self.repeat
+
+    def fire(self, dd, log: LogFn, fields=None) -> None:
+        self.fired += 1
+        fields = dd.curr if fields is None else fields
+        q = self.quantity or dd._names[0]
+        cell = _shard_cell(dd, self.shard, interior=False,
+                           arr=fields[q])
+        if cell is None:
+            LOG_WARN("HaloCorruption: the live fields carry no halo "
+                     "pads (radius 0 or interior-resident fast path); "
+                     "fault is a no-op")
+            return
+        z, y, x = cell
+        fields[q] = fields[q].at[z, y, x].set(float("nan"))
+        log("fault_halo", step=self.step, quantity=q,
+            shard=list(self.shard), cell=[z, y, x])
+
+
+@dataclasses.dataclass
+class TransientSaveFailure:
+    """The checkpoint save at step ``step`` raises ``IOError`` for its
+    first ``failures`` attempts, then succeeds (exercises the retry/
+    backoff path without touching the filesystem)."""
+
+    step: int
+    failures: int = 2
+    fired: int = 0
+
+    def maybe_raise(self, step: int, log: LogFn) -> None:
+        if step == self.step and self.fired < self.failures:
+            self.fired += 1
+            log("fault_save_ioerror", step=step, attempt=self.fired)
+            raise IOError(
+                f"injected transient save failure "
+                f"{self.fired}/{self.failures} at step {step}")
+
+
+@dataclasses.dataclass
+class CheckpointCorruption:
+    """After checkpoint ``step`` is written, corrupt one of its data
+    files on disk: ``mode='truncate'`` halves it, ``mode='bitflip'``
+    flips one seeded byte. Restore must detect either (orbax/
+    tensorstore error or integrity sha256 mismatch) and fall back."""
+
+    step: int
+    mode: str = "truncate"
+    repeat: int = 1
+    fired: int = 0
+
+    def due(self, step: int) -> bool:
+        return step == self.step and self.fired < self.repeat
+
+    def fire(self, directory: str, step: int, rng, log: LogFn) -> None:
+        self.fired += 1
+        targets = _state_data_files(directory, step)
+        if not targets:  # pragma: no cover - layout drift guard
+            LOG_WARN(f"CheckpointCorruption: no data file under "
+                     f"{directory}/{step}; fault is a no-op")
+            return
+        for target in targets:
+            data = bytearray(target.read_bytes())
+            if self.mode == "truncate":
+                target.write_bytes(bytes(data[:max(len(data) // 2, 1)]))
+            elif self.mode == "bitflip":
+                i = int(rng.integers(0, len(data)))
+                data[i] ^= 0xFF
+                target.write_bytes(bytes(data))
+            else:
+                raise ValueError(f"unknown corruption mode {self.mode!r}")
+        log("fault_ckpt_corruption", step=step, mode=self.mode,
+            files=[str(t) for t in targets])
+
+
+@dataclasses.dataclass
+class Preemption:
+    """Deliver ``SIGTERM`` to this process after step ``step`` — the
+    driver's handler turns it into a final 'preempted' checkpoint and a
+    clean exit, exactly like a fleet scheduler reclaiming the host."""
+
+    step: int
+    fired: int = 0
+
+    def due(self, step: int) -> bool:
+        return step == self.step and self.fired < 1
+
+    def fire(self, log: LogFn) -> None:
+        self.fired += 1
+        log("fault_preemption", step=self.step)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A seeded schedule of injected faults, consumed by the resilience
+    driver. All hooks are no-ops when their event lists are empty, so a
+    production run with ``faults=None`` pays nothing."""
+
+    nans: List[NaNInjection] = dataclasses.field(default_factory=list)
+    halos: List[HaloCorruption] = dataclasses.field(default_factory=list)
+    save_failures: List[TransientSaveFailure] = \
+        dataclasses.field(default_factory=list)
+    ckpt_corruptions: List[CheckpointCorruption] = \
+        dataclasses.field(default_factory=list)
+    preemptions: List[Preemption] = dataclasses.field(default_factory=list)
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        import numpy as np
+        self._rng = np.random.default_rng(self.seed)
+        self._log: LogFn = _noop_log
+
+    def bind(self, log: LogFn) -> None:
+        """Route fault firings into the driver's event log."""
+        self._log = log
+
+    # -- driver hooks ---------------------------------------------------
+    def on_step(self, dd, step: int, fields=None) -> None:
+        """Fire state faults due after ``step`` (NaN, halo, SIGTERM).
+        ``fields`` is the LIVE field dict (the driver passes the same
+        one the sentinel probes) — on interior-resident fast paths
+        that is the model's resident state, not the stale ``dd.curr``;
+        it is mutated in place. Defaults to ``dd.curr``."""
+        for ev in self.nans:
+            if ev.due(step):
+                ev.fire(dd, self._log, fields)
+        for ev in self.halos:
+            if ev.due(step):
+                ev.fire(dd, self._log, fields)
+        for ev in self.preemptions:
+            if ev.due(step):
+                ev.fire(self._log)
+
+    def maybe_fail_save(self, step: int) -> None:
+        """Raise the scheduled transient ``IOError`` for this save."""
+        for ev in self.save_failures:
+            ev.maybe_raise(step, self._log)
+
+    def after_save(self, directory: str, step: int) -> None:
+        """Fire on-disk corruption due for the checkpoint just saved."""
+        for ev in self.ckpt_corruptions:
+            if ev.due(step):
+                ev.fire(directory, step, self._rng, self._log)
+
+
+# ----------------------------------------------------------------------
+# geometry helpers
+# ----------------------------------------------------------------------
+def _shard_cell(dd, shard: Tuple[int, int, int], interior: bool,
+                arr=None) -> Optional[Tuple[int, int, int]]:
+    """An index into the live field array inside shard ``(bx, by,
+    bz)``: the interior center (``interior=True``) or the first halo
+    pad cell of the first padded axis (``interior=False``; None when
+    the array has no pads). ``arr`` disambiguates the layout: the
+    padded global (``dd.curr``) vs the interior-resident global of the
+    fast paths (no pads — halo corruption is a no-op there)."""
+    from ..geometry import Dim3
+    from ..local_domain import raw_size, zyx_shape
+    bx, by, bz = shard
+    pr = raw_size(dd.local_size, dd.alloc_radius)
+    lo = dd.alloc_radius.pad_lo()
+    if arr is not None and tuple(arr.shape) != \
+            zyx_shape(pr * dd.placement.dim()):
+        if not interior:
+            return None        # interior-resident: nothing to corrupt
+        pr = dd.local_size
+        lo = Dim3(0, 0, 0)
+    base = (bz * pr.z, by * pr.y, bx * pr.x)
+    if interior:
+        return (base[0] + lo.z + dd.local_size.z // 2,
+                base[1] + lo.y + dd.local_size.y // 2,
+                base[2] + lo.x + dd.local_size.x // 2)
+    center = (base[0] + lo.z + dd.local_size.z // 2,
+              base[1] + lo.y + dd.local_size.y // 2,
+              base[2] + lo.x + dd.local_size.x // 2)
+    if lo.z > 0:     # first z-lo pad row of this shard, centered in y/x
+        return (base[0], center[1], center[2])
+    if lo.y > 0:
+        return (center[0], base[1], center[2])
+    if lo.x > 0:
+        return (center[0], center[1], base[2])
+    return None
+
+
+def _state_data_files(directory: str, step: int) -> List[Path]:
+    """The ocdbt data blobs of the step's ``state`` item (files under a
+    ``d/`` directory) — where the array bytes live, so corrupting them
+    is guaranteed to hit data, not an ignorable sidecar. Falls back to
+    every state file when the layout has no ``d/`` dirs."""
+    root = Path(directory).absolute() / str(step) / "state"
+    if not root.is_dir():
+        root = Path(directory).absolute() / str(step)
+        if not root.is_dir():
+            return []
+    files = [p for p in sorted(root.rglob("*")) if p.is_file()]
+    data = [p for p in files if p.parent.name == "d"]
+    return data or files

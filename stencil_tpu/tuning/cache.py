@@ -25,9 +25,16 @@ from pathlib import Path
 from typing import Dict, Optional, Union
 
 from ..utils.logging import LOG_DEBUG, LOG_WARN
+from ..utils.retry import retry
 from .plan import Plan, SCHEMA_VERSION
 
 ENV_CACHE = "STENCIL_TUNE_CACHE"
+
+# transient-I/O retry budget for cache reads/writes (an NFS blip must
+# not kill a tune or lose a measured plan); tests inject a fake clock
+_RETRY_ATTEMPTS = 3
+_RETRY_BASE_DELAY = 0.05
+_RETRY_SLEEP = None  # None -> time.sleep
 
 
 def default_cache_path() -> Path:
@@ -48,9 +55,12 @@ def load_cache(path: Union[str, Path, None] = None) -> Dict[str, Dict]:
     if not p.exists():
         return {}
     try:
-        data = json.loads(p.read_text())
+        text = retry(p.read_text, attempts=_RETRY_ATTEMPTS,
+                     base_delay=_RETRY_BASE_DELAY, sleep=_RETRY_SLEEP)
+        data = json.loads(text)
     except (OSError, ValueError) as e:
-        LOG_WARN(f"plan cache {p} is corrupt ({type(e).__name__}: {e}); "
+        LOG_WARN(f"plan cache {p} is corrupt or unreadable "
+                 f"({type(e).__name__}: {e}); "
                  f"ignoring it (will re-tune and rewrite)")
         return {}
     if not isinstance(data, dict) or "plans" not in data:
@@ -94,18 +104,23 @@ def store_plan(plan: Plan, path: Union[str, Path, None] = None) -> Path:
     plans[plan.fingerprint] = plan.to_record()
     payload = {"schema": SCHEMA_VERSION, "plans": plans}
     p.parent.mkdir(parents=True, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=str(p.parent),
-                               prefix=p.name, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(payload, f, indent=1, sort_keys=True)
-        os.replace(tmp, p)
-    except BaseException:
+
+    def write_once():
+        fd, tmp = tempfile.mkstemp(dir=str(p.parent),
+                                   prefix=p.name, suffix=".tmp")
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f, indent=1, sort_keys=True)
+            os.replace(tmp, p)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    retry(write_once, attempts=_RETRY_ATTEMPTS,
+          base_delay=_RETRY_BASE_DELAY, sleep=_RETRY_SLEEP)
     LOG_DEBUG(f"plan cache {p}: stored {plan.config.key()} under "
               f"{plan.fingerprint[:12]}...")
     return p

@@ -9,43 +9,183 @@ it: sharded field arrays are written with orbax (each host writes its
 own shards; restore re-shards onto the current mesh), alongside a JSON
 metadata record (step counter, grid geometry) used to validate
 compatibility on resume.
+
+Robustness (the resilience subsystem's storage layer):
+
+* one ``CheckpointManager`` is cached per directory — the save loop of
+  a long campaign reuses it instead of paying construct/close churn on
+  every checkpoint; :func:`close_checkpoints` (also an atexit hook)
+  releases them.
+* every array carries a sha256 digest in the meta record; restore
+  verifies it, so a bit-flipped or truncated checkpoint is detected
+  rather than silently resumed from.
+* :func:`restore_domain` is fallback-aware: when the newest step is
+  corrupt or unreadable it logs a warning and walks back to the next
+  older step, raising only when NO step is restorable.
+* orbax save/restore I/O runs through :func:`..utils.retry.retry` so a
+  transient filesystem error costs a backoff, not the run.
 """
 
 from __future__ import annotations
 
+import atexit
+import hashlib
 from pathlib import Path
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from .logging import LOG_WARN
+from .retry import retry
 
-def _manager(directory: str, max_to_keep: Optional[int] = None):
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint step exists but cannot be trusted (orbax restore
+    failure or integrity-digest mismatch)."""
+
+
+# ----------------------------------------------------------------------
+# manager cache: one CheckpointManager per directory
+# ----------------------------------------------------------------------
+# directory (absolute) -> (manager, max_to_keep it was built with)
+_MANAGERS: Dict[str, Tuple[Any, Optional[int]]] = {}
+_atexit_registered = False
+
+#: read-only callers (latest_step/restore/meta probes) don't care about
+#: retention — they reuse any cached manager. Writers pass the real
+#: max_to_keep, where ``None`` genuinely means "keep every step".
+_ANY_RETENTION = object()
+
+
+def _manager(directory: str, max_to_keep=_ANY_RETENTION):
+    """The cached manager for ``directory`` (built on first use; rebuilt
+    when the caller's ``max_to_keep`` differs from the one it was built
+    with). Callers must NOT close it — :func:`close_checkpoints` owns
+    the lifecycle."""
+    global _atexit_registered
     import orbax.checkpoint as ocp
-    opts = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                        create=True)
-    return ocp.CheckpointManager(Path(directory).absolute(), options=opts)
+    key = str(Path(directory).absolute())
+    cached = _MANAGERS.get(key)
+    if cached is not None:
+        mgr, kept = cached
+        if max_to_keep is _ANY_RETENTION or kept == max_to_keep:
+            return mgr
+        _close_one(key)
+    keep = None if max_to_keep is _ANY_RETENTION else max_to_keep
+    opts = ocp.CheckpointManagerOptions(max_to_keep=keep, create=True)
+    mgr = ocp.CheckpointManager(key, options=opts)
+    _MANAGERS[key] = (mgr, keep)
+    if not _atexit_registered:
+        atexit.register(close_checkpoints)
+        _atexit_registered = True
+    return mgr
 
 
+def _close_one(key: str) -> None:
+    mgr, _ = _MANAGERS.pop(key)
+    try:
+        mgr.close()
+    except Exception as e:  # noqa: BLE001 - the dir may be gone (tmpdirs)
+        LOG_WARN(f"closing checkpoint manager for {key}: "
+                 f"{type(e).__name__}: {e}")
+
+
+def close_checkpoints(directory: Optional[str] = None) -> None:
+    """Close the cached manager for ``directory`` (or ALL cached
+    managers when None). Safe to call repeatedly; also runs atexit."""
+    if directory is not None:
+        key = str(Path(directory).absolute())
+        if key in _MANAGERS:
+            _close_one(key)
+        return
+    for key in list(_MANAGERS):
+        _close_one(key)
+
+
+# ----------------------------------------------------------------------
+# array integrity digests
+# ----------------------------------------------------------------------
+def _single_host() -> bool:
+    """Integrity digests need every array fully addressable from this
+    process — true only for single-host runs (patchable in tests)."""
+    return jax.process_count() == 1
+
+
+def array_digest(arr) -> str:
+    """sha256 over an array's raw bytes + shape + dtype (host order) —
+    the integrity record written next to every checkpointed array."""
+    import numpy as np
+    host = np.asarray(arr)
+    h = hashlib.sha256()
+    h.update(str(host.shape).encode())
+    h.update(str(host.dtype).encode())
+    h.update(np.ascontiguousarray(host).tobytes())
+    return h.hexdigest()
+
+
+def verify_digests(arrays: Dict[str, jnp.ndarray],
+                   digests: Dict[str, str]) -> List[str]:
+    """Names whose current digest does not match the recorded one
+    (restored-but-tampered data). Arrays without a recorded digest
+    (older checkpoints) are skipped — absence is not corruption."""
+    bad = []
+    for name, arr in arrays.items():
+        want = digests.get(name)
+        if want is not None and array_digest(arr) != want:
+            bad.append(name)
+    return sorted(bad)
+
+
+# ----------------------------------------------------------------------
+# low-level save/restore
+# ----------------------------------------------------------------------
 def save_state(directory: str, step: int, arrays: Dict[str, jnp.ndarray],
                meta: Optional[Dict[str, Any]] = None,
-               max_to_keep: Optional[int] = None) -> None:
+               max_to_keep: Optional[int] = None,
+               attempts: int = 3, base_delay: float = 0.1,
+               sleep=None) -> None:
     """Write ``arrays`` (a flat dict of possibly-sharded jax arrays) and
-    JSON-serializable ``meta`` as checkpoint ``step``."""
+    JSON-serializable ``meta`` as checkpoint ``step``. Transient
+    ``OSError``s are retried with backoff (``attempts``/``base_delay``/
+    ``sleep`` — callers owning their own retry loop, like the
+    resilience driver, pass ``attempts=1`` so exactly one layer
+    retries)."""
     import orbax.checkpoint as ocp
     mgr = _manager(directory, max_to_keep)
-    mgr.save(step, args=ocp.args.Composite(
-        state=ocp.args.StandardSave(arrays),
-        meta=ocp.args.JsonSave(meta or {})))
-    mgr.wait_until_finished()
-    mgr.close()
+
+    def attempt():
+        # a rolled-back run re-checkpoints steps it already saved once
+        # (possibly as a corrupt/partial write) — replace, don't refuse
+        # (read=True: see the directory as it is, not the cached
+        # manager's construction-time snapshot)
+        if step in mgr.all_steps(read=True):
+            try:
+                mgr.delete(step)
+            except Exception:  # noqa: BLE001 - partial step dirs
+                import shutil
+                shutil.rmtree(Path(directory).absolute() / str(step),
+                              ignore_errors=True)
+        mgr.save(step, args=ocp.args.Composite(
+            state=ocp.args.StandardSave(arrays),
+            meta=ocp.args.JsonSave(meta or {})), force=True)
+        mgr.wait_until_finished()
+
+    retry(attempt, attempts=attempts, base_delay=base_delay, sleep=sleep)
 
 
 def latest_step(directory: str) -> Optional[int]:
-    mgr = _manager(directory)
-    out = mgr.latest_step()
-    mgr.close()
-    return out
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def all_steps(directory: str) -> List[int]:
+    """Every checkpoint step in ``directory``, ascending. Always reads
+    the directory fresh (``read=True``) — the cached manager's
+    in-memory step list is a construction-time snapshot and would be
+    blind to steps another process wrote (a monitor polling a
+    campaign's checkpoint dir must see them)."""
+    return sorted(_manager(directory).all_steps(read=True))
 
 
 def restore_state(directory: str,
@@ -59,14 +199,12 @@ def restore_state(directory: str,
     import orbax.checkpoint as ocp
     mgr = _manager(directory)
     if step is None:
-        step = mgr.latest_step()
+        step = latest_step(directory)
         if step is None:
-            mgr.close()
             raise FileNotFoundError(f"no checkpoint in {directory}")
-    out = mgr.restore(step, args=ocp.args.Composite(
+    out = retry(lambda: mgr.restore(step, args=ocp.args.Composite(
         state=ocp.args.StandardRestore(targets),
-        meta=ocp.args.JsonRestore()))
-    mgr.close()
+        meta=ocp.args.JsonRestore())), attempts=3, base_delay=0.1)
     return step, dict(out["state"]), dict(out["meta"] or {})
 
 
@@ -118,12 +256,35 @@ def domain_meta(dd) -> Dict[str, Any]:
     }
 
 
+_warned_multihost_integrity = False
+
+
+def _track_dir(dd, directory: str) -> None:
+    """Remember the directories this domain checkpoints into so
+    ``DistributedDomain.close_checkpoints()`` can release exactly its
+    own managers."""
+    dirs = getattr(dd, "_ckpt_dirs", None)
+    if dirs is None:
+        dirs = set()
+        dd._ckpt_dirs = dirs
+    dirs.add(str(Path(directory).absolute()))
+
+
 def save_domain(dd, directory: str, step: int,
                 extra: Optional[Dict[str, jnp.ndarray]] = None,
-                max_to_keep: Optional[int] = None) -> None:
+                max_to_keep: Optional[int] = None,
+                meta_extra: Optional[Dict[str, Any]] = None,
+                integrity: bool = True,
+                attempts: int = 3, base_delay: float = 0.1,
+                sleep=None) -> None:
     """Checkpoint a DistributedDomain's curr fields (+ optional extra
-    arrays, e.g. RK accumulators) at ``step``."""
+    arrays, e.g. RK accumulators) at ``step``. ``meta_extra`` is merged
+    into the JSON meta record (the resilience driver tags preemption
+    checkpoints through it); ``integrity=True`` (default) records a
+    sha256 per array so restore can detect corruption — it costs one
+    host gather per array per checkpoint."""
     from ..geometry import Dim3
+    _track_dir(dd, directory)
     if dd.rem == Dim3(0, 0, 0):
         extract, _ = _interior_fns(dd)
         arrays = {q: extract(v) for q, v in dd.curr.items()}
@@ -138,18 +299,66 @@ def save_domain(dd, directory: str, step: int,
     for k, v in (extra or {}).items():
         arrays[f"extra:{k}"] = v
         meta["extra"][k] = {"shape": list(v.shape), "dtype": str(v.dtype)}
-    save_state(directory, step, arrays, meta=meta, max_to_keep=max_to_keep)
+    if integrity and not _single_host():
+        # digesting needs the full array on THIS host; multi-host
+        # shards are not process-addressable, so integrity is skipped
+        # (restore treats absent digests as not-corrupt, never flags)
+        global _warned_multihost_integrity
+        if not _warned_multihost_integrity:
+            _warned_multihost_integrity = True
+            LOG_WARN("checkpoint integrity digests are single-host "
+                     "only; skipping them on this multi-host run")
+        integrity = False
+    if integrity:
+        meta["integrity"] = {k: array_digest(v) for k, v in arrays.items()}
+    for k, v in (meta_extra or {}).items():
+        meta[k] = v
+    save_state(directory, step, arrays, meta=meta,
+               max_to_keep=max_to_keep, attempts=attempts,
+               base_delay=base_delay, sleep=sleep)
 
 
-def restore_domain(dd, directory: str, step: Optional[int] = None
-                   ) -> Tuple[int, Dict[str, jnp.ndarray]]:
-    """Restore a realized DistributedDomain's curr fields in place;
-    returns ``(step, extra_arrays)``. The domain must have the same
-    global size and quantities as the checkpoint (mesh may differ —
-    orbax reshards onto the current one)."""
+def _restore_step_arrays(dd, mgr, step: int
+                         ) -> Tuple[Dict[str, jnp.ndarray],
+                                    Dict[str, Any]]:
+    """Restore checkpoint ``step`` for ``dd`` and verify integrity.
+    Raises :class:`CorruptCheckpointError` when the step cannot be
+    trusted, or ``ValueError`` when it belongs to a DIFFERENT problem
+    (size/quantities/dtype mismatch — not corruption, never fallback)."""
+    import orbax.checkpoint as ocp
     from ..geometry import Dim3
     from ..local_domain import zyx_shape
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # the meta probe: transient OSErrors get the same backoff as the
+    # bulk restore below; a step whose meta record STILL cannot be
+    # read is corrupt
+    try:
+        probe = retry(lambda: mgr.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())),
+            attempts=3, base_delay=0.1)
+        saved_meta = dict(probe["meta"] or {})
+    except Exception as e:  # noqa: BLE001 - orbax raises many types
+        raise CorruptCheckpointError(
+            f"step {step}: meta record unreadable "
+            f"({type(e).__name__}: {e})") from e
+
+    # compatibility gates come from the meta record, BEFORE the bulk
+    # restore: a mismatched domain raises (the caller's bug), it is not
+    # a corrupt checkpoint to skip past
+    if saved_meta.get("size") and list(dd.size) != saved_meta["size"]:
+        raise ValueError(f"checkpoint size {saved_meta['size']} != "
+                         f"domain {list(dd.size)}")
+    if saved_meta.get("quantities") and \
+            saved_meta["quantities"] != list(dd._names):
+        raise ValueError(f"checkpoint quantities "
+                         f"{saved_meta['quantities']} != "
+                         f"{list(dd._names)}")
+    for q, dt in (saved_meta.get("dtypes") or {}).items():
+        if q in dd._dtypes and str(dd._dtypes[q]) != dt:
+            raise ValueError(f"checkpoint dtype {dt} for {q!r} != "
+                             f"domain dtype {dd._dtypes[q]}")
+
     targets: Dict[str, jax.ShapeDtypeStruct] = {}
     ishape = zyx_shape(dd.size)
     uneven = dd.rem != Dim3(0, 0, 0)
@@ -160,40 +369,68 @@ def restore_domain(dd, directory: str, step: Optional[int] = None
         cur = dd.curr[q]
         targets[q] = jax.ShapeDtypeStruct(
             ishape, cur.dtype, sharding=repl if uneven else cur.sharding)
-    # one manager for step lookup, the meta probe, and the restore
-    import orbax.checkpoint as ocp
-    mgr = _manager(directory)
+    cur0 = dd.curr[dd._names[0]]
+    for k, desc in (saved_meta.get("extra") or {}).items():
+        targets[f"extra:{k}"] = jax.ShapeDtypeStruct(
+            tuple(desc["shape"]), jnp.dtype(desc["dtype"]),
+            sharding=cur0.sharding)
     try:
-        step_found = mgr.latest_step() if step is None else step
-        if step_found is None:
-            raise FileNotFoundError(f"no checkpoint in {directory}")
-        # extras are described in the JSON meta record (saved alongside)
-        probe = mgr.restore(
-            step_found, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
-        saved_meta = dict(probe["meta"] or {})
-        cur0 = dd.curr[dd._names[0]]
-        for k, desc in (saved_meta.get("extra") or {}).items():
-            targets[f"extra:{k}"] = jax.ShapeDtypeStruct(
-                tuple(desc["shape"]), jnp.dtype(desc["dtype"]),
-                sharding=cur0.sharding)
-        out = mgr.restore(step_found, args=ocp.args.Composite(
-            state=ocp.args.StandardRestore(targets),
-            meta=ocp.args.JsonRestore()))
-    finally:
-        mgr.close()
-    step_out, arrays, meta = step_found, dict(out["state"]), dict(
-        out["meta"] or {})
-    if meta.get("size") and list(dd.size) != meta["size"]:
-        raise ValueError(f"checkpoint size {meta['size']} != domain "
-                         f"{list(dd.size)}")
-    if meta.get("quantities") and meta["quantities"] != list(dd._names):
-        raise ValueError(f"checkpoint quantities {meta['quantities']} != "
-                         f"{list(dd._names)}")
-    for q, dt in (meta.get("dtypes") or {}).items():
-        if q in dd._dtypes and str(dd._dtypes[q]) != dt:
-            raise ValueError(f"checkpoint dtype {dt} for {q!r} != "
-                             f"domain dtype {dd._dtypes[q]}")
+        # the meta record was already read by the probe above — only
+        # the state item rides this bulk restore
+        out = retry(lambda: mgr.restore(step, args=ocp.args.Composite(
+            state=ocp.args.StandardRestore(targets))),
+            attempts=3, base_delay=0.1)
+    except Exception as e:  # noqa: BLE001 - truncated files raise deep
+        raise CorruptCheckpointError(
+            f"step {step}: restore failed "
+            f"({type(e).__name__}: {e})") from e
+    arrays = dict(out["state"])
+    if _single_host():  # digests need host-addressable arrays
+        bad = verify_digests(arrays, saved_meta.get("integrity") or {})
+        if bad:
+            raise CorruptCheckpointError(
+                f"step {step}: integrity sha256 mismatch for {bad} "
+                f"(bit-rot or tampering)")
+    return arrays, saved_meta
+
+
+def restore_domain(dd, directory: str, step: Optional[int] = None
+                   ) -> Tuple[int, Dict[str, jnp.ndarray]]:
+    """Restore a realized DistributedDomain's curr fields in place;
+    returns ``(step, extra_arrays)``. The domain must have the same
+    global size and quantities as the checkpoint (mesh may differ —
+    orbax reshards onto the current one).
+
+    Fallback-aware: when the requested/newest step is corrupt or
+    unreadable (integrity mismatch, truncated file, orbax error) a
+    warning is logged and the next-older step is tried; the call raises
+    only when NO step is restorable (or on a genuine domain mismatch,
+    which no amount of walking back would fix)."""
     from ..geometry import Dim3
+    _track_dir(dd, directory)
+    mgr = _manager(directory)
+    if step is not None:
+        candidates = [step]
+    else:
+        candidates = sorted(all_steps(directory), reverse=True)
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoint in {directory}")
+    arrays = saved_meta = step_found = None
+    last_err: Optional[CorruptCheckpointError] = None
+    for cand in candidates:
+        try:
+            arrays, saved_meta = _restore_step_arrays(dd, mgr, cand)
+            step_found = cand
+            break
+        except CorruptCheckpointError as e:
+            last_err = e
+            LOG_WARN(f"checkpoint {directory} {e}; "
+                     f"falling back to an older step")
+    if step_found is None:
+        raise CorruptCheckpointError(
+            f"no restorable checkpoint in {directory} "
+            f"(tried steps {candidates}): {last_err}")
+
     if dd.rem == Dim3(0, 0, 0):
         _, insert = _interior_fns(dd)
         for q in dd._names:
@@ -206,4 +443,20 @@ def restore_domain(dd, directory: str, step: Optional[int] = None
     dd.exchange()
     extra = {k[len("extra:"):]: v for k, v in arrays.items()
              if k.startswith("extra:")}
-    return step_out, extra
+    return step_found, extra
+
+
+def checkpoint_meta(directory: str, step: Optional[int] = None
+                    ) -> Dict[str, Any]:
+    """The JSON meta record of checkpoint ``step`` (latest when None) —
+    the resilience driver reads the ``preempted`` tag through this
+    without paying an array restore."""
+    import orbax.checkpoint as ocp
+    mgr = _manager(directory)
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {directory}")
+    probe = mgr.restore(
+        step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+    return dict(probe["meta"] or {})

@@ -1,0 +1,59 @@
+"""Negative control for the health-sentinel probe contract.
+
+The sentinel's license to ride the production step loop is its
+communication bill: exactly ONE small all-reduce (the stacked-stats
+pmax in ``resilience/health.py``), pinned by ``exact_counts`` on its
+registry targets. This fixture is the tempting refactor that breaks
+the contract without changing any *result*: reducing each statistic
+with its own ``pmax`` (one per quantity per row) instead of stacking
+first — numerically identical, but every probe step now pays N
+all-reduce launches on the fabric the sentinel is supposed to be
+guarding. ``python -m stencil_tpu.analysis tests/fixtures/lint/
+bad_probe.py`` MUST exit nonzero.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stencil_tpu.analysis import HloSpec, HloTarget
+
+
+def _f32(shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _unstacked_probe_spec() -> HloSpec:
+    """Per-quantity, per-row pmax: 4 all-reduces where the shipped
+    probe does 1. Sold under the shipped contract (exactly one
+    all_reduce) — the checker must flag it."""
+    import numpy as np
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("z", "y", "x"))
+    axes = ("z", "y", "x")
+
+    def shard(a, b):
+        stats = []
+        for p in (a, b):
+            finite = jnp.isfinite(p)
+            nf = jnp.sum(~finite).astype(jnp.float32)
+            ma = jnp.max(jnp.where(finite, jnp.abs(p),
+                                   jnp.zeros_like(p))).astype(jnp.float32)
+            # the bug: reduce each scalar separately instead of
+            # stacking into one vector and reducing once
+            stats.append(jnp.stack([jax.lax.pmax(nf, axes),
+                                    jax.lax.pmax(ma, axes)]))
+        return jnp.stack(stats, axis=1)
+
+    spec = P("z", "y", "x")
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(spec, spec),
+                       out_specs=P(), check_vma=False)
+    return HloSpec(fn=sm, args=(_f32((16, 16, 16)), _f32((16, 16, 16))),
+                   allow=("all_reduce",),
+                   exact_counts={"all_reduce": 1})
+
+
+TARGETS = [
+    HloTarget("bad_probe.unstacked_pmax[hlo]", _unstacked_probe_spec),
+]

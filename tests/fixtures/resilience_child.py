@@ -1,0 +1,64 @@
+"""Subprocess runner for the preemption e2e test.
+
+Runs a small Jacobi campaign under ``run_resilient``. With
+``--preempt-at N`` a seeded :class:`Preemption` delivers a real
+SIGTERM to this process mid-loop; the driver writes a final
+"preempted" checkpoint and the process exits 0 (the clean-preemption
+contract a fleet scheduler relies on). Invoked again on the same
+``--ckpt-dir`` without the fault, it resumes from that checkpoint and
+writes the final temperature field to ``--out`` — the parent test
+asserts bitwise equality with an uninterrupted run.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--preempt-at", type=int, default=0)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.resilience import (FaultPlan, Preemption,
+                                        ResiliencePolicy)
+
+    j = Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float32)
+    j.init()
+    faults = None
+    if args.preempt_at:
+        faults = FaultPlan(preemptions=[Preemption(step=args.preempt_at)])
+    policy = ResiliencePolicy(check_every=2, ckpt_every=4,
+                              base_delay=0.0)
+    report = j.run_resilient(args.steps, policy=policy,
+                             ckpt_dir=args.ckpt_dir, faults=faults)
+    if report.preempted:
+        print(f"PREEMPTED steps={report.steps}")
+        return
+    if args.out:
+        np.save(args.out, j.temperature())
+    print(f"DONE steps={report.steps} "
+          f"resumed_from={report.resumed_from}")
+
+
+if __name__ == "__main__":
+    main()

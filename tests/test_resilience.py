@@ -1,0 +1,669 @@
+"""Resilient run loop: sentinels, fault injection, rollback recovery.
+
+Every injected fault class (NaN step, corrupted halo, corrupt
+checkpoint, transient save IOError, SIGTERM preemption) has a test
+proving the driver recovers and the final state matches the fault-free
+run — the ISSUE 5 acceptance contract.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.models.jacobi import Jacobi3D
+from stencil_tpu.resilience import (CheckpointCorruption, FaultPlan,
+                                    HaloCorruption, HealthSentinel,
+                                    NaNInjection, Preemption,
+                                    ResilienceError, ResiliencePolicy,
+                                    StepConfig, TransientSaveFailure,
+                                    degradation_ladder)
+
+N = 16
+STEPS = 12
+
+
+def make_jacobi(**kw):
+    j = Jacobi3D(N, N, N, mesh_shape=(2, 2, 2), dtype=np.float32, **kw)
+    j.init()
+    return j
+
+
+def fast_policy(**kw):
+    kw.setdefault("check_every", 1)
+    kw.setdefault("ckpt_every", 4)
+    kw.setdefault("base_delay", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return ResiliencePolicy(**kw)
+
+
+@pytest.fixture(scope="module")
+def clean_final():
+    j = make_jacobi()
+    j.run(STEPS)
+    return j.temperature()
+
+
+# ----------------------------------------------------------------------
+# health sentinel units
+# ----------------------------------------------------------------------
+def test_sentinel_clean_state_never_trips():
+    j = make_jacobi()
+    s = HealthSentinel(j.dd)
+    for step in (1, 2, 3):
+        s.probe(j.dd.curr, step)
+    results = s.poll(block=True)
+    assert len(results) == 3
+    assert not any(r.tripped for r in results)
+    assert s.tripped is None
+    # stats are real: jacobi init is the 0.5 mean field
+    assert results[0].max_abs["temp"] == pytest.approx(0.5)
+    assert results[0].nonfinite["temp"] == 0
+
+
+def test_sentinel_detects_nonfinite():
+    j = make_jacobi()
+    j.dd.curr["temp"] = j.dd.curr["temp"].at[3, 3, 3].set(float("nan"))
+    s = HealthSentinel(j.dd)
+    s.probe(j.dd.curr, 5)
+    (r,) = s.poll(block=True)
+    assert r.tripped and "non-finite" in r.reason
+    assert r.nonfinite["temp"] >= 1
+    assert s.tripped is r
+    s.reset()
+    assert s.tripped is None
+
+
+def test_sentinel_detects_halo_corruption():
+    """The probe reads PADDED fields: a poisoned halo cell trips it
+    even though the next exchange would overwrite it."""
+    j = make_jacobi()
+    s = HealthSentinel(j.dd)
+    # (0,0,0) is a pad cell of shard 0 (alloc radius 1 on all sides)
+    j.dd.curr["temp"] = j.dd.curr["temp"].at[0, 0, 0].set(float("inf"))
+    s.probe(j.dd.curr, 1)
+    (r,) = s.poll(block=True)
+    assert r.tripped and r.nonfinite["temp"] >= 1
+
+
+def test_sentinel_growth_window_trips():
+    j = make_jacobi()
+    s = HealthSentinel(j.dd, window=4, growth_factor=10.0)
+    base = j.dd.curr["temp"]
+    s.probe({"temp": base}, 1)          # max_abs 0.5 -> history
+    assert not any(r.tripped for r in s.poll(block=True))
+    s.probe({"temp": base * 100.0}, 2)  # x100 > factor 10 -> trip
+    (r,) = s.poll(block=True)
+    assert r.tripped and "grew" in r.reason
+
+
+def test_sentinel_async_poll_then_drain():
+    j = make_jacobi()
+    s = HealthSentinel(j.dd)
+    s.probe(j.dd.curr, 1)
+    s.probe(j.dd.curr, 2)
+    got = s.poll()              # non-blocking: harvest whatever is done
+    got += s.poll(block=True)   # drain the rest
+    assert [r.step for r in got] == [1, 2]
+
+
+# ----------------------------------------------------------------------
+# fault class -> recover -> fault-free equivalence
+# ----------------------------------------------------------------------
+def test_nan_injection_rollback_equivalence(tmp_path, clean_final):
+    j = make_jacobi()
+    plan = FaultPlan(nans=[NaNInjection(step=7)])
+    rep = j.run_resilient(STEPS, policy=fast_policy(),
+                          ckpt_dir=str(tmp_path), faults=plan)
+    assert rep.steps == STEPS
+    assert rep.rollbacks == 1
+    assert not rep.preempted
+    kinds = [e["event"] for e in rep.events]
+    assert "fault_nan" in kinds and "sentinel_tripped" in kinds \
+        and "restored" in kinds
+    np.testing.assert_array_equal(j.temperature(), clean_final)
+
+
+def test_halo_corruption_rollback_equivalence(tmp_path, clean_final):
+    j = make_jacobi()
+    plan = FaultPlan(halos=[HaloCorruption(step=6, shard=(1, 0, 1))])
+    rep = j.run_resilient(STEPS, policy=fast_policy(),
+                          ckpt_dir=str(tmp_path), faults=plan)
+    assert rep.steps == STEPS and rep.rollbacks == 1
+    np.testing.assert_array_equal(j.temperature(), clean_final)
+
+
+def test_transient_save_failure_retried(tmp_path, clean_final):
+    j = make_jacobi()
+    plan = FaultPlan(save_failures=[TransientSaveFailure(step=4,
+                                                         failures=2)])
+    rep = j.run_resilient(STEPS, policy=fast_policy(),
+                          ckpt_dir=str(tmp_path), faults=plan)
+    assert rep.steps == STEPS
+    assert rep.save_retries == 2
+    assert rep.rollbacks == 0
+    np.testing.assert_array_equal(j.temperature(), clean_final)
+
+
+def test_persistent_save_failure_raises(tmp_path):
+    j = make_jacobi()
+    plan = FaultPlan(save_failures=[TransientSaveFailure(step=4,
+                                                         failures=99)])
+    with pytest.raises(OSError, match="injected"):
+        j.run_resilient(STEPS, policy=fast_policy(save_attempts=3),
+                        ckpt_dir=str(tmp_path), faults=plan)
+
+
+def test_corrupt_checkpoint_falls_back_during_recovery(tmp_path,
+                                                       clean_final):
+    """Checkpoint 4 is corrupted on disk after it lands; the NaN at
+    step 6 forces a rollback, which must skip the corrupt step and
+    restore the older anchor instead of dying."""
+    j = make_jacobi()
+    plan = FaultPlan(
+        nans=[NaNInjection(step=6)],
+        ckpt_corruptions=[CheckpointCorruption(step=4,
+                                               mode="truncate")])
+    rep = j.run_resilient(STEPS, policy=fast_policy(),
+                          ckpt_dir=str(tmp_path), faults=plan)
+    assert rep.steps == STEPS and rep.rollbacks == 1
+    restored = [e for e in rep.events if e["event"] == "restored"]
+    assert restored[0]["step"] == 0  # NOT the corrupt step 4
+    np.testing.assert_array_equal(j.temperature(), clean_final)
+
+
+def test_watchdog_mode_without_ckpt_dir_raises():
+    j = make_jacobi()
+    plan = FaultPlan(nans=[NaNInjection(step=3)])
+    with pytest.raises(ResilienceError, match="nothing to roll back"):
+        j.run_resilient(STEPS, policy=fast_policy(), ckpt_dir=None,
+                        faults=plan)
+
+
+def test_faults_target_live_interior_resident_fields():
+    """On the interior-resident fast paths the live state is NOT
+    dd.curr: state faults must hit the field dict the driver passes
+    (the one the sentinel probes), and halo corruption — which has no
+    resident pads to poison — must no-op instead of corrupting the
+    stale padded buffer."""
+    from stencil_tpu.local_domain import zyx_shape
+
+    j = make_jacobi()
+    inner = {"temp": jnp.zeros(zyx_shape(j.dd.size), jnp.float32)}
+    FaultPlan(nans=[NaNInjection(step=1)]).on_step(j.dd, 1, inner)
+    assert int(np.isnan(np.asarray(inner["temp"])).sum()) == 1
+    assert not np.isnan(np.asarray(j.dd.curr["temp"])).any()
+
+    inner2 = {"temp": jnp.zeros(zyx_shape(j.dd.size), jnp.float32)}
+    FaultPlan(halos=[HaloCorruption(step=1)]).on_step(j.dd, 1, inner2)
+    assert not np.isnan(np.asarray(inner2["temp"])).any()  # no-op
+    assert not np.isnan(np.asarray(j.dd.curr["temp"])).any()
+
+
+# ----------------------------------------------------------------------
+# degradation ladder
+# ----------------------------------------------------------------------
+def test_degradation_ladder_order():
+    from stencil_tpu.parallel.methods import Method
+
+    ladder = degradation_ladder(Method.PpermutePacked, 4,
+                                runnable=lambda m: m != Method.PallasDMA)
+    assert ladder == [
+        StepConfig(Method.PpermutePacked, 2),
+        StepConfig(Method.PpermutePacked, 1),
+        StepConfig(Method.PpermuteSlab, 1),
+        StepConfig(Method.AllGather, 1),
+    ]
+    # depth-1 slab start: straight down the method list
+    ladder = degradation_ladder(Method.PpermuteSlab, 1,
+                                runnable=lambda m: m != Method.PallasDMA)
+    assert ladder == [StepConfig(Method.AllGather, 1)]
+
+
+def test_repeat_failure_degrades_config(tmp_path, clean_final):
+    """A fault that keeps firing past the retry budget walks the
+    degradation ladder (exchange_every 4 -> 2); the rebuilt engine is
+    numerically identical, so the run still matches fault-free."""
+    j = make_jacobi(exchange_every=4)
+    plan = FaultPlan(nans=[NaNInjection(step=3, repeat=2)])
+    pol = fast_policy(max_retries=1)
+    rep = j.run_resilient(STEPS, policy=pol, ckpt_dir=str(tmp_path),
+                          faults=plan)
+    assert rep.steps == STEPS
+    assert rep.rollbacks == 2
+    assert rep.degradations == ["PpermuteSlab[s=2]"]
+    assert rep.final_config == "PpermuteSlab[s=2]"
+    assert j.dd.exchange_every == 2  # the handle was rebuilt in place
+    np.testing.assert_array_equal(j.temperature(), clean_final)
+
+
+def test_independent_incidents_get_fresh_retry_budgets(tmp_path,
+                                                       clean_final):
+    """Two unrelated transient faults separated by a successful
+    checkpoint must NOT accumulate toward degradation: a checkpoint
+    resets the attempt counter (retries are bounded per incident)."""
+    j = make_jacobi()
+    plan = FaultPlan(nans=[NaNInjection(step=3),
+                           NaNInjection(step=9)])
+    pol = fast_policy(max_retries=1)
+    rep = j.run_resilient(STEPS, policy=pol, ckpt_dir=str(tmp_path),
+                          faults=plan)
+    assert rep.steps == STEPS
+    assert rep.rollbacks == 2
+    assert rep.degradations == []  # neither incident exhausted alone
+    np.testing.assert_array_equal(j.temperature(), clean_final)
+
+
+def test_one_probe_per_step_at_checkpoint_boundaries(tmp_path,
+                                                     monkeypatch):
+    """check_every=1 with ckpt_every=2: boundary steps are probed by
+    the blocking drain ONLY — never a duplicate async reduction."""
+    from stencil_tpu.resilience import driver as drv
+
+    calls = []
+
+    class Counting(drv.HealthSentinel):
+        def probe(self, fields, step):
+            calls.append(step)
+            super().probe(fields, step)
+
+    monkeypatch.setattr(drv, "HealthSentinel", Counting)
+    j = make_jacobi()
+    j.run_resilient(4, policy=fast_policy(ckpt_every=2),
+                    ckpt_dir=str(tmp_path))
+    assert calls == [1, 2, 3, 4]
+
+
+def test_retries_and_ladder_exhausted_raises(tmp_path):
+    j = make_jacobi()
+    plan = FaultPlan(nans=[NaNInjection(step=3, repeat=99)])
+    pol = fast_policy(max_retries=1, degrade=False)
+    with pytest.raises(ResilienceError, match="retries exhausted"):
+        j.run_resilient(STEPS, policy=pol, ckpt_dir=str(tmp_path),
+                        faults=plan)
+
+
+def test_infeasible_ladder_rung_skipped_not_fatal(tmp_path):
+    """An uneven (+-1) partition supports only the ppermute methods:
+    the AllGather rung's constructor rejection must be absorbed as
+    'rung infeasible', ending in ResilienceError — never a raw
+    NotImplementedError escaping mid-recovery."""
+    j = Jacobi3D(17, 17, 17, mesh_shape=(2, 2, 2), dtype=np.float32)
+    j.init()
+    plan = FaultPlan(nans=[NaNInjection(step=2, repeat=99)])
+    pol = fast_policy(max_retries=0)
+    with pytest.raises(ResilienceError, match="no degradation"):
+        j.run_resilient(STEPS, policy=pol, ckpt_dir=str(tmp_path),
+                        faults=plan)
+
+
+def test_degrade_preserves_dcn_tier(tmp_path):
+    """A degradation rebuild must carry the DCN slice tiering (and
+    placement strategy) into the new engine, not silently fall back to
+    raw device order."""
+    import jax
+
+    devs = jax.devices()[:8]
+    groups = [devs[:4], devs[4:]]
+    j = Jacobi3D(N, N, N, mesh_shape=(2, 2, 2), dtype=np.float32,
+                 dcn_axis="z", dcn_groups=groups, exchange_every=4)
+    j.init()
+    assert j.dd.dcn_axis == 2 and j.dd.n_slices == 2
+    plan = FaultPlan(nans=[NaNInjection(step=3, repeat=2)])
+    rep = j.run_resilient(STEPS, policy=fast_policy(max_retries=1),
+                          ckpt_dir=str(tmp_path), faults=plan)
+    assert rep.degradations == ["PpermuteSlab[s=2]"]
+    assert j.dd.exchange_every == 2
+    assert j.dd.dcn_axis == 2 and j.dd.n_slices == 2  # tier survived
+
+
+def test_astaroth_resilient_with_accumulators(tmp_path):
+    """The Astaroth entry point: RK accumulators ride the checkpoint
+    as extras, and recovery from a mid-campaign NaN is bitwise-equal
+    to the fault-free run."""
+    from stencil_tpu.models.astaroth import Astaroth, MhdParams
+
+    prm = MhdParams()
+    steps = 4
+    a = Astaroth(8, 8, 8, params=prm, mesh_shape=(2, 2, 2),
+                 dtype=np.float64)
+    a.init()
+    for _ in range(steps):
+        a.step()
+    want = {q: a.field(q) for q in ("lnrho", "uux", "ss")}
+
+    b = Astaroth(8, 8, 8, params=prm, mesh_shape=(2, 2, 2),
+                 dtype=np.float64)
+    b.init()
+    plan = FaultPlan(nans=[NaNInjection(step=3, quantity="uux")])
+    rep = b.run_resilient(steps, policy=fast_policy(ckpt_every=2),
+                          ckpt_dir=str(tmp_path), faults=plan)
+    assert rep.steps == steps and rep.rollbacks == 1
+    for q in want:
+        np.testing.assert_array_equal(b.field(q), want[q])
+
+
+# ----------------------------------------------------------------------
+# preemption (SIGTERM) and resume
+# ----------------------------------------------------------------------
+def test_preemption_writes_tagged_checkpoint_and_resumes(tmp_path,
+                                                         clean_final):
+    from stencil_tpu.utils.checkpoint import checkpoint_meta
+
+    j = make_jacobi()
+    plan = FaultPlan(preemptions=[Preemption(step=6)])
+    rep = j.run_resilient(STEPS, policy=fast_policy(check_every=2),
+                          ckpt_dir=str(tmp_path), faults=plan)
+    assert rep.preempted and rep.steps == 6
+    meta = checkpoint_meta(str(tmp_path))
+    assert meta["preempted"] is True
+    assert meta["completed_steps"] == 6
+    # the driver restored the previous SIGTERM disposition on exit
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+    k = make_jacobi()
+    rep2 = k.run_resilient(STEPS, policy=fast_policy(check_every=2),
+                           ckpt_dir=str(tmp_path))
+    assert rep2.resumed_from == 6
+    assert rep2.steps == STEPS and not rep2.preempted
+    np.testing.assert_array_equal(k.temperature(), clean_final)
+
+
+def test_preemption_never_persists_poisoned_state(tmp_path, clean_final):
+    """SIGTERM landing right after a fault, before any probe was
+    harvested: the preemption path must drain health first and SKIP
+    the final checkpoint, leaving the older good step as the resume
+    anchor — never a NaN-laden 'latest'."""
+    j = make_jacobi()
+    plan = FaultPlan(nans=[NaNInjection(step=5)],
+                     preemptions=[Preemption(step=5)])
+    # check_every huge: no probe would have caught the NaN before the
+    # preempt branch runs — only its own blocking drain can
+    rep = j.run_resilient(STEPS, policy=fast_policy(check_every=100),
+                          ckpt_dir=str(tmp_path), faults=plan)
+    assert rep.preempted and rep.steps == 5
+    kinds = [e["event"] for e in rep.events]
+    assert "preempt_checkpoint_skipped" in kinds
+    from stencil_tpu.utils.checkpoint import all_steps
+    assert max(all_steps(str(tmp_path))) == 4  # poisoned step 5 absent
+
+    k = make_jacobi()
+    rep2 = k.run_resilient(STEPS, policy=fast_policy(), ckpt_dir=str(tmp_path))
+    assert rep2.resumed_from == 4 and rep2.steps == STEPS
+    np.testing.assert_array_equal(k.temperature(), clean_final)
+
+
+CHILD = Path(__file__).parent / "fixtures" / "resilience_child.py"
+
+
+def test_preemption_subprocess_e2e(tmp_path, clean_final):
+    """The full fleet contract in real processes: a run SIGTERMed
+    mid-loop exits 0 having written the preempted checkpoint; a fresh
+    process resumes from it and the final field is bitwise-equal to an
+    uninterrupted run."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the child sets its own 8-device mesh
+    ckpt = tmp_path / "ckpt"
+    out = tmp_path / "final.npy"
+
+    first = subprocess.run(
+        [sys.executable, str(CHILD), "--ckpt-dir", str(ckpt),
+         "--steps", str(STEPS), "--preempt-at", "6"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert first.returncode == 0, first.stderr
+    assert "PREEMPTED steps=6" in first.stdout, first.stdout
+
+    second = subprocess.run(
+        [sys.executable, str(CHILD), "--ckpt-dir", str(ckpt),
+         "--steps", str(STEPS), "--out", str(out)],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert second.returncode == 0, second.stderr
+    assert f"DONE steps={STEPS} resumed_from=6" in second.stdout, \
+        second.stdout
+    np.testing.assert_array_equal(np.load(out), clean_final)
+
+
+# ----------------------------------------------------------------------
+# checkpoint hardening (integrity + fallback + manager cache)
+# ----------------------------------------------------------------------
+def test_restore_domain_falls_back_past_corrupt_latest(tmp_path):
+    from stencil_tpu.utils.checkpoint import restore_domain, save_domain
+
+    j = make_jacobi()
+    j.step()
+    save_domain(j.dd, str(tmp_path), step=1)
+    want = j.temperature()
+    j.step()
+    save_domain(j.dd, str(tmp_path), step=2)
+    # corrupt the LATEST step on disk
+    CheckpointCorruption(step=2, mode="truncate").fire(
+        str(tmp_path), 2, np.random.default_rng(0), lambda *a, **k: None)
+    k = make_jacobi()
+    step, _ = restore_domain(k.dd, str(tmp_path))
+    assert step == 1
+    np.testing.assert_array_equal(k.temperature(), want)
+
+
+def test_restore_domain_raises_when_no_step_restorable(tmp_path):
+    from stencil_tpu.utils.checkpoint import (CorruptCheckpointError,
+                                              restore_domain,
+                                              save_domain)
+
+    j = make_jacobi()
+    save_domain(j.dd, str(tmp_path), step=1)
+    CheckpointCorruption(step=1, mode="truncate").fire(
+        str(tmp_path), 1, np.random.default_rng(0), lambda *a, **k: None)
+    k = make_jacobi()
+    with pytest.raises(CorruptCheckpointError, match="no restorable"):
+        restore_domain(k.dd, str(tmp_path))
+
+
+def test_array_digest_detects_tampering():
+    from stencil_tpu.utils.checkpoint import array_digest, verify_digests
+
+    a = jnp.arange(16.0, dtype=jnp.float32).reshape(4, 4)
+    digests = {"a": array_digest(a)}
+    assert verify_digests({"a": a}, digests) == []
+    assert verify_digests({"a": a.at[2, 2].set(7.0)}, digests) == ["a"]
+    # arrays without a recorded digest are skipped, not flagged
+    assert verify_digests({"b": a}, digests) == []
+
+
+def test_save_meta_records_integrity_digests(tmp_path):
+    from stencil_tpu.utils.checkpoint import checkpoint_meta, save_domain
+
+    j = make_jacobi()
+    save_domain(j.dd, str(tmp_path), step=0)
+    meta = checkpoint_meta(str(tmp_path), 0)
+    assert set(meta["integrity"]) == {"temp"}
+    assert len(meta["integrity"]["temp"]) == 64  # sha256 hex
+
+
+def test_integrity_skipped_on_multihost(tmp_path, monkeypatch):
+    """Digests need host-addressable arrays; on a multi-host run the
+    save must skip them (with a warning) instead of dying on
+    np.asarray of non-addressable shards — and restore must not flag
+    their absence."""
+    from stencil_tpu.utils import checkpoint as ckpt
+    from stencil_tpu.utils.checkpoint import (checkpoint_meta,
+                                              restore_domain,
+                                              save_domain)
+
+    j = make_jacobi()
+    j.step()
+    monkeypatch.setattr(ckpt, "_single_host", lambda: False)
+    save_domain(j.dd, str(tmp_path), step=1)
+    assert "integrity" not in checkpoint_meta(str(tmp_path), 1)
+    k = make_jacobi()
+    step, _ = restore_domain(k.dd, str(tmp_path))
+    assert step == 1
+    np.testing.assert_array_equal(k.temperature(), j.temperature())
+
+
+def test_step_listing_sees_external_writes(tmp_path):
+    """latest_step/restore must see steps written by ANOTHER process
+    after this process's manager was cached (a monitor polling a
+    campaign's directory) — the step list is read fresh, not from the
+    manager's construction-time snapshot."""
+    import shutil
+
+    from stencil_tpu.utils import checkpoint as ckpt
+
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    j = make_jacobi()
+    j.step()
+    ckpt.save_domain(j.dd, str(src), step=7)
+    assert ckpt.latest_step(str(dst)) is None  # manager cached, empty
+    shutil.copytree(src / "7", dst / "7")      # "another process" saves
+    assert ckpt.latest_step(str(dst)) == 7
+    k = make_jacobi()
+    step, _ = ckpt.restore_domain(k.dd, str(dst))
+    assert step == 7
+    np.testing.assert_array_equal(k.temperature(), j.temperature())
+
+
+def test_checkpoint_manager_cached_per_directory(tmp_path):
+    from stencil_tpu.utils import checkpoint as ckpt
+
+    d = str(tmp_path / "mgrs")
+    m1 = ckpt._manager(d)
+    m2 = ckpt._manager(d)
+    assert m1 is m2
+    ckpt.close_checkpoints(d)
+    m3 = ckpt._manager(d)
+    assert m3 is not m1
+    ckpt.close_checkpoints(d)
+
+
+def test_manager_retention_none_means_keep_all(tmp_path):
+    """max_to_keep=None must rebuild a keep-all manager, not silently
+    inherit a prior caller's pruning retention; read-only callers
+    (no max_to_keep argument) reuse whatever is cached."""
+    from stencil_tpu.utils import checkpoint as ckpt
+
+    d = str(tmp_path / "ret")
+    key = str(Path(d).absolute())
+    m3 = ckpt._manager(d, 3)
+    assert ckpt._MANAGERS[key][1] == 3
+    assert ckpt._manager(d) is m3          # reader: don't care, reuse
+    mall = ckpt._manager(d, None)          # writer: keep-all, rebuild
+    assert mall is not m3
+    assert ckpt._MANAGERS[key][1] is None
+    assert ckpt._manager(d, None) is mall  # stable once rebuilt
+    ckpt.close_checkpoints(d)
+
+
+def test_restore_meta_probe_retries_transient_oserror(tmp_path):
+    """A one-off OSError on the meta probe is backoff-retried, not
+    misclassified as corruption (which would silently discard a good
+    checkpoint or kill the run when it is the only step)."""
+    from stencil_tpu.utils import checkpoint as ckpt
+
+    j = make_jacobi()
+    j.step()
+    ckpt.save_domain(j.dd, str(tmp_path), step=1)
+    want = j.temperature()
+    real = ckpt._manager(str(tmp_path))
+
+    class FlakyMgr:
+        def __init__(self, inner):
+            self._inner = inner
+            self.failures = 1
+
+        def restore(self, *a, **kw):
+            if self.failures:
+                self.failures -= 1
+                raise OSError("injected transient meta-read blip")
+            return self._inner.restore(*a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    k = make_jacobi()
+    arrays, meta = ckpt._restore_step_arrays(k.dd, FlakyMgr(real), 1)
+    assert meta["integrity"]
+    np.testing.assert_array_equal(np.asarray(arrays["temp"]), want)
+
+
+def test_save_state_single_retry_layer(tmp_path, monkeypatch):
+    """attempts=1 (the resilience driver's setting) must make exactly
+    one save attempt — the policy-driven retry outside is the only
+    loop; the default still retries with backoff."""
+    from stencil_tpu.utils import checkpoint as ckpt
+
+    class FakeMgr:
+        def __init__(self):
+            self.calls = 0
+
+        def all_steps(self, read=False):
+            return []
+
+        def save(self, *a, **kw):
+            self.calls += 1
+            raise OSError("disk on fire")
+
+    fake = FakeMgr()
+    monkeypatch.setattr(ckpt, "_manager", lambda *a, **kw: fake)
+    with pytest.raises(OSError):
+        ckpt.save_state(str(tmp_path), 0, {}, attempts=1)
+    assert fake.calls == 1
+    delays = []
+    with pytest.raises(OSError):
+        ckpt.save_state(str(tmp_path), 0, {}, attempts=3,
+                        base_delay=0.25, sleep=delays.append)
+    assert fake.calls == 4 and delays == [0.25, 0.5]
+
+
+def test_domain_close_checkpoints_releases_managers(tmp_path):
+    from stencil_tpu.utils import checkpoint as ckpt
+    from stencil_tpu.utils.checkpoint import save_domain
+
+    j = make_jacobi()
+    d = str(tmp_path / "dom")
+    save_domain(j.dd, d, step=0)
+    key = str(Path(d).absolute())
+    assert key in ckpt._MANAGERS
+    j.dd.close_checkpoints()
+    assert key not in ckpt._MANAGERS
+
+
+# ----------------------------------------------------------------------
+# the sentinel's communication contract (registry targets)
+# ----------------------------------------------------------------------
+def test_health_probe_registry_targets_prove_single_all_reduce():
+    from stencil_tpu.analysis import run_targets
+    from stencil_tpu.analysis.hlo import lowering_supported
+    from stencil_tpu.analysis.registry import default_targets
+
+    if not lowering_supported():
+        pytest.skip("StableHLO lowering unavailable in this JAX")
+    targets = [t for t in default_targets()
+               if t.name.startswith("resilience.health.")]
+    assert len(targets) == 2
+    report = run_targets(targets)
+    assert report.findings == []
+    probe = report.metrics["hlo:resilience.health.probe[hlo]"]
+    assert probe["collectives"] == {
+        "all_reduce": {"count": 1, "bytes_per_shard": 16}}
+    fused = report.metrics["hlo:resilience.health.step+probe[hlo]"]
+    assert fused["collectives"]["all_reduce"]["count"] == 1
+    assert set(fused["collectives"]) == {"collective_permute",
+                                         "all_reduce"}
+
+
+def test_unstacked_probe_fixture_flagged():
+    from stencil_tpu.analysis import run_targets
+    from stencil_tpu.analysis.hlo import lowering_supported
+    from stencil_tpu.analysis.registry import load_targets
+
+    if not lowering_supported():
+        pytest.skip("StableHLO lowering unavailable in this JAX")
+    fixture = Path(__file__).parent / "fixtures" / "lint" / "bad_probe.py"
+    report = run_targets(load_targets(fixture))
+    assert len(report.errors) == 1
+    assert "exactly 1" in report.errors[0].message

@@ -1,0 +1,100 @@
+"""utils/retry: bounded retry + exponential backoff, fake-clocked."""
+
+import pytest
+
+from stencil_tpu.utils.retry import retry
+
+
+class FakeClock:
+    def __init__(self):
+        self.delays = []
+
+    def sleep(self, s):
+        self.delays.append(s)
+
+
+def flaky(failures, exc=OSError):
+    """A callable that raises ``exc`` for its first ``failures`` calls."""
+    state = {"calls": 0}
+
+    def fn():
+        state["calls"] += 1
+        if state["calls"] <= failures:
+            raise exc(f"boom {state['calls']}")
+        return state["calls"]
+
+    fn.state = state
+    return fn
+
+
+def test_success_first_try_never_sleeps():
+    clock = FakeClock()
+    assert retry(lambda: 42, attempts=3, sleep=clock.sleep) == 42
+    assert clock.delays == []
+
+
+def test_exponential_backoff_delays():
+    clock = FakeClock()
+    fn = flaky(2)
+    assert retry(fn, attempts=3, base_delay=0.5, sleep=clock.sleep) == 3
+    assert clock.delays == [0.5, 1.0]  # base * 2**k
+
+
+def test_exhausted_attempts_raise_last_error():
+    clock = FakeClock()
+    fn = flaky(5)
+    with pytest.raises(OSError, match="boom 3"):
+        retry(fn, attempts=3, base_delay=0.1, sleep=clock.sleep)
+    assert fn.state["calls"] == 3
+    assert clock.delays == [0.1, 0.2]  # no sleep after the final failure
+
+
+def test_non_retriable_propagates_immediately():
+    clock = FakeClock()
+    fn = flaky(1, exc=ValueError)
+    with pytest.raises(ValueError):
+        retry(fn, attempts=5, sleep=clock.sleep)
+    assert fn.state["calls"] == 1
+    assert clock.delays == []
+
+
+def test_on_retry_callback_sees_each_failure():
+    seen = []
+    fn = flaky(2)
+    retry(fn, attempts=3, base_delay=1.0, sleep=lambda s: None,
+          on_retry=lambda k, e, d: seen.append((k, str(e), d)))
+    assert [(k, d) for k, _, d in seen] == [(1, 1.0), (2, 2.0)]
+    assert "boom 1" in seen[0][1]
+
+
+def test_attempts_must_be_positive():
+    with pytest.raises(ValueError):
+        retry(lambda: 1, attempts=0)
+
+
+def test_tuning_cache_store_retries_transient_replace(tmp_path,
+                                                     monkeypatch):
+    """A transient os.replace failure must not lose a measured plan."""
+    import os as os_mod
+
+    from stencil_tpu.tuning import cache as cache_mod
+    from stencil_tpu.tuning.plan import Candidate, Plan
+
+    monkeypatch.setattr(cache_mod, "_RETRY_SLEEP", lambda s: None)
+    real_replace = os_mod.replace
+    state = {"calls": 0}
+
+    def flaky_replace(src, dst):
+        state["calls"] += 1
+        if state["calls"] == 1:
+            raise OSError("injected transient rename failure")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(cache_mod.os, "replace", flaky_replace)
+    plan = Plan(config=Candidate("PpermuteSlab", 1, False),
+                fingerprint="f" * 64, coefficients={}, costs={},
+                provenance="tuned", measurements=1)
+    p = cache_mod.store_plan(plan, tmp_path / "plans.json")
+    assert state["calls"] == 2
+    got = cache_mod.load_plan("f" * 64, p)
+    assert got is not None and got.config.method == "PpermuteSlab"
