@@ -1,0 +1,117 @@
+"""Ensemble campaign service front end (stencil_tpu/serving).
+
+Drives the async multi-tenant campaign service: a first wave of
+concurrent fake-tenant campaigns (distinct tenants, one shared problem
+fingerprint) is submitted and served as ONE batched ensemble dispatch
+stream, then a second fingerprint-identical wave proves the warm path:
+zero recompiles (engine cache) and zero tuner measurements (plan
+cache). The event log JSON is the CI service-smoke artifact.
+
+Examples:
+  python serve.py --tenants 3 --steps 6 --fake-cpu 8 \\
+      --events-json events.json --fake-timer --tune-cache plans.json
+  python serve.py --tenants 2 --model astaroth --steps 2 --fake-cpu 8
+"""
+
+import argparse
+import shutil
+import sys
+import tempfile
+
+from _common import add_device_flags, apply_device_flags
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_device_flags(p)
+    p.add_argument("--model", choices=("jacobi", "astaroth"),
+                   default="jacobi")
+    p.add_argument("--x", type=int, default=8)
+    p.add_argument("--y", type=int, default=8)
+    p.add_argument("--z", type=int, default=8)
+    p.add_argument("--tenants", type=int, default=3,
+                   help="concurrent fake tenants in the first wave")
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--width", type=int, default=8,
+                   help="ensemble width (members per dispatch)")
+    p.add_argument("--ckpt-every", type=int, default=2)
+    p.add_argument("--check-every", type=int, default=1)
+    p.add_argument("--snapshot-every", type=int, default=3)
+    p.add_argument("--second-wave", type=int, default=1,
+                   help="fingerprint-identical requests submitted "
+                        "after the first wave (the warm path)")
+    p.add_argument("--chaos-nan", type=int, default=0, metavar="STEP",
+                   help="poison tenant 0's campaign at this member "
+                        "step (proves member-isolated rollback)")
+    p.add_argument("--root", default="",
+                   help="checkpoint namespace root (default: tmpdir)")
+    p.add_argument("--keep-root", action="store_true")
+    p.add_argument("--events-json", default="",
+                   help="write the service event log + stats here")
+    p.add_argument("--fake-timer", action="store_true",
+                   help="tune exchange plans with the deterministic "
+                        "FakeTimer (CI: no hardware dependence)")
+    p.add_argument("--tune-cache", default="",
+                   help="tuning-plan cache path (shared across runs "
+                        "-> the second process is a plan-cache hit)")
+    args = p.parse_args()
+    apply_device_flags(args)
+
+    from stencil_tpu.serving import CampaignRequest, CampaignService
+    from stencil_tpu.tuning import FakeTimer
+
+    root = args.root or tempfile.mkdtemp(prefix="serve_root.")
+    svc = CampaignService(
+        root, width=args.width,
+        tuner_timer=FakeTimer() if args.fake_timer else None,
+        plan_cache_path=args.tune_cache or None)
+
+    def request(tenant: str, campaign: str, seed: int,
+                chaos=None) -> CampaignRequest:
+        params = ({"hot_temp": 1.0 + 0.05 * seed}
+                  if args.model == "jacobi" else
+                  {"nu_visc": 5e-3 * (1.0 + 0.1 * seed)})
+        return CampaignRequest(
+            tenant=tenant, campaign=campaign, model=args.model,
+            grid=(args.x, args.y, args.z), n_steps=args.steps,
+            ckpt_every=args.ckpt_every, check_every=args.check_every,
+            snapshot_every=args.snapshot_every, init_seed=100 + seed,
+            params=params, chaos_nan_step=chaos)
+
+    # submit the whole first wave BEFORE the worker starts so admission
+    # packs it into one fingerprint-compatible ensemble batch
+    handles = [svc.submit(request(
+        f"tenant{i}", "wave1", i,
+        chaos=args.chaos_nan if (args.chaos_nan and i == 0) else None))
+        for i in range(args.tenants)]
+    svc.start()
+    for h in handles:
+        r = h.result(timeout=600)
+        print(f"{r.tenant}/{r.campaign}: steps={r.steps} "
+              f"rollbacks={r.rollbacks} "
+              f"snapshots={[s for s, _ in r.snapshots]}")
+
+    for j in range(args.second_wave):
+        h = svc.submit(request(f"tenant{args.tenants + j}", "wave2",
+                               args.tenants + j))
+        r = h.result(timeout=600)
+        print(f"{r.tenant}/{r.campaign}: steps={r.steps} "
+              f"rollbacks={r.rollbacks} (warm path)")
+    svc.stop()
+
+    s = svc.stats
+    print(f"stats: batches={s.batches} compiles={s.compiles} "
+          f"plan_cache_hits={s.plan_cache_hits} "
+          f"tuner_measurements={s.tuner_measurements} "
+          f"completed={s.completed} failed={s.failed} "
+          f"rollbacks={s.rollbacks}")
+    if args.events_json:
+        svc.write_events(args.events_json)
+        print(f"event log -> {args.events_json}", file=sys.stderr)
+    if not args.root and not args.keep_root:
+        shutil.rmtree(root, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

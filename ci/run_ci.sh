@@ -23,7 +23,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
-echo "== 1/8 lint (stencil-lint + ruff; tier=$TIER) =="
+echo "== 1/9 lint (stencil-lint + ruff; tier=$TIER) =="
 # stencil-lint: all six static checkers — halo-radius footprint, DMA
 # discipline, ppermute sanity, HLO collective-permute-only lowering,
 # analytic-vs-HLO byte cross-check, and the Pallas VMEM/tiling audit
@@ -63,10 +63,10 @@ if [ "$TIER" = "full" ]; then
   fi
 fi
 
-echo "== 2/8 native build =="
+echo "== 2/9 native build =="
 bash ci/build.sh
 
-echo "== 3/8 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+echo "== 3/9 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
 # The full tier is dominated by interpret-mode Pallas parity tests
 # (CPU-bound, independent): fan them out with pytest-xdist when the
 # machine has cores to spare. Each worker process builds its own
@@ -82,7 +82,7 @@ else
   python -m pytest tests/ -q --maxfail=1 -m "not slow"
 fi
 
-echo "== 4/8 app smoke runs =="
+echo "== 4/9 app smoke runs =="
 # overlap app smokes execute remote DMA: possible only on a TPU or
 # with the distributed (mosaic) interpreter — probe, don't assume
 RDMA_OK=$(python -c "from stencil_tpu._compat import remote_dma_runnable
@@ -107,7 +107,7 @@ smoke() { echo "-- $*"; python "$@" > /dev/null; }
   smoke bench_qap.py --sizes 4 6
 )
 
-echo "== 5/8 bench smoke: temporal blocking + autotuned plan =="
+echo "== 5/9 bench smoke: temporal blocking + autotuned plan =="
 # communication-avoiding temporal blocking must not regress steps/s of
 # the REAL blocked hot path (Jacobi3D's fused run loop, redundant ring
 # compute included) on the fake CPU mesh; the amortized byte model
@@ -151,7 +151,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -f "$BENCH_JSON" "$TUNE_CACHE"
 
-echo "== 6/8 exchange autotuner (fake timer: search/fit/plan/cache) =="
+echo "== 6/9 exchange autotuner (fake timer: search/fit/plan/cache) =="
 # the tuner's whole pipeline with deterministic fake measurements (no
 # hardware dependence): first invocation tunes and writes the plan
 # cache, the second MUST be a cache hit performing zero measurements.
@@ -182,7 +182,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -f "$TUNE_CACHE" "$PLAN1" "$PLAN2"
 
-echo "== 7/8 chaos smoke: resilient run loop under injected faults =="
+echo "== 7/9 chaos smoke: resilient run loop under injected faults =="
 # the Jacobi app under run_resilient (stencil_tpu/resilience) with a
 # seeded fault plan: one NaN injection (must trip the health sentinel
 # and roll back to the last good checkpoint) and one transient save
@@ -216,7 +216,63 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -rf "$CHAOS_CKPT" "$CHAOS_EVENTS"
 
-echo "== 8/8 multi-chip certification sweep =="
+echo "== 8/9 service smoke: concurrent multi-tenant ensemble campaigns =="
+# the campaign service (stencil_tpu/serving) on the fake CPU mesh:
+# three concurrent fake tenants share one problem fingerprint and ride
+# ONE batched ensemble dispatch stream (tenant0 gets a chaos NaN that
+# must roll back ONLY its campaign), then a fingerprint-identical
+# second wave must hit the engine cache (zero recompiles) and a second
+# PROCESS on the same tune cache must hit the plan cache (zero tuner
+# measurements). The event log JSON is the CI artifact.
+SERVE_ROOT="$(mktemp -d -t serve_root.XXXXXX)"
+SERVE_CACHE="$(mktemp -t serve_cache.XXXXXX.json)"; rm -f "$SERVE_CACHE"
+SERVE_EVENTS1="$(mktemp -t serve_events1.XXXXXX.json)"
+SERVE_EVENTS2="$(mktemp -t serve_events2.XXXXXX.json)"
+( cd apps
+  python serve.py --tenants 3 --steps 6 --width 8 --fake-cpu 8 \
+        --chaos-nan 3 --fake-timer --tune-cache "$SERVE_CACHE" \
+        --root "$SERVE_ROOT/run1" --events-json "$SERVE_EVENTS1"
+  python serve.py --tenants 1 --second-wave 0 --steps 4 --width 8 \
+        --fake-cpu 8 --fake-timer --tune-cache "$SERVE_CACHE" \
+        --root "$SERVE_ROOT/run2" --events-json "$SERVE_EVENTS2" )
+SERVE_EVENTS1="$SERVE_EVENTS1" SERVE_EVENTS2="$SERVE_EVENTS2" \
+python - <<'EOF'
+import json
+import os
+d1 = json.load(open(os.environ["SERVE_EVENTS1"]))
+d2 = json.load(open(os.environ["SERVE_EVENTS2"]))
+s1, s2 = d1["stats"], d2["stats"]
+# run 1: 3 concurrent tenants + 1 warm-path request, all complete; the
+# chaos NaN rolled back only its campaign
+assert s1["completed"] == 4 and s1["failed"] == 0, s1
+assert s1["rollbacks"] >= 1, s1
+batches = [e for e in d1["events"] if e["event"] == "batch_started"]
+assert batches[0]["compiled"] and batches[0]["measurements"] > 0, batches
+# the fingerprint-identical second wave: zero recompiles, zero
+# measurements (engine cache + in-process plan reuse)
+assert not batches[-1]["compiled"], batches
+assert batches[-1]["measurements"] == 0, batches
+trips = [e for e in d1["events"] if e["event"] == "sentinel_tripped"]
+assert trips and all(e["tenant"] == "tenant0" for e in trips), trips
+done = {e["tenant"] for e in d1["events"]
+        if e["event"] == "campaign_completed"}
+assert done == {"tenant0", "tenant1", "tenant2", "tenant3"}, done
+# run 2 (fresh process, same tune cache): plan-cache hit, zero
+# tuner measurements
+assert s2["completed"] == 1 and s2["plan_cache_hits"] == 1, s2
+assert s2["tuner_measurements"] == 0, s2
+print(f"service smoke OK: {s1['completed']}+{s2['completed']} campaigns"
+      f" completed, {s1['rollbacks']} member-isolated rollback(s), "
+      f"warm path compiled=False/measurements=0, second process "
+      f"plan-cache hit with 0 measurements")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+  mkdir -p "$CI_ARTIFACT_DIR"
+  cp "$SERVE_EVENTS1" "$CI_ARTIFACT_DIR/serve_events.json"
+fi
+rm -rf "$SERVE_ROOT" "$SERVE_CACHE" "$SERVE_EVENTS1" "$SERVE_EVENTS2"
+
+echo "== 9/9 multi-chip certification sweep =="
 python __graft_entry__.py 8 | tail -1
 
 echo "CI PASSED"

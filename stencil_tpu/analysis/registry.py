@@ -610,6 +610,84 @@ def _plan_targets() -> List[Target]:
 
 
 # ---------------------------------------------------------------------------
+# ensemble-serving targets: the batched member axis must be a free
+# ride on the wire — the vmapped exchange lowers to the SAME
+# collective-permutes as one member, each carrying the batch, so wire
+# bytes are EXACTLY n_members x the single-member analytic model, and
+# the batched production step smuggles in no extra collectives.
+
+_ENSEMBLE_N = 4
+
+
+def _ensemble_exchange_spec() -> CollectiveSpec:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.exchange import exchange_shard
+    from ..parallel.mesh import mesh_dim
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = _exchange_radius("r1")
+
+    def shard(batched):
+        return jax.vmap(
+            lambda p: exchange_shard(p, radius, counts))(batched)
+
+    spec = P(None, "z", "y", "x")
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=spec, out_specs=spec,
+                       check_vma=False)
+    return CollectiveSpec(fn=sm,
+                          args=(_f32((_ENSEMBLE_N,) + _EXCHANGE_GLOBAL),),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _ensemble_exchange_cost() -> CostModelSpec:
+    from ..geometry import Dim3
+
+    cs = _ensemble_exchange_spec()
+    # bytes scale EXACTLY xN over the single-member sweep model — the
+    # serving contract: batching multiplies payload, never rounds
+    expected = _ENSEMBLE_N * _sweep_bytes(_exchange_shard_shape(),
+                                          _exchange_radius("r1"),
+                                          Dim3(*_EXCHANGE_MESH), 4)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _ensemble_step_spec() -> HloSpec:
+    """The production batched Jacobi step (serving/ensemble.py): the
+    same 6 collective-permutes as the single-member step — pinned
+    exactly, so a vmap batching regression that unrolled the member
+    axis into per-member collectives fails the gate."""
+    from ..serving.ensemble import EnsembleJacobi
+
+    eng = EnsembleJacobi(_ENSEMBLE_N, 24, 24, 24,
+                         mesh_shape=_EXCHANGE_MESH)
+    hot, cold = eng._param_args()
+    import jax.numpy as jnp
+    args = (eng.state["temp"], hot, cold, jnp.asarray(1, jnp.int32))
+    return HloSpec(fn=eng._step_n, args=args,
+                   allow=("collective_permute",),
+                   exact_counts={"collective_permute": 6})
+
+
+def _ensemble_probe_spec() -> HloSpec:
+    """The per-member health probe: (N, 2, nq) stats via still exactly
+    ONE small all-reduce (the vmapped pmax batches, it does not
+    multiply)."""
+    from ..serving.ensemble import make_ensemble_probe
+
+    mesh = _mesh((2, 2, 2))
+    fn = make_ensemble_probe(mesh, ["a", "b"])
+    fields = {"a": _f32((_ENSEMBLE_N, 16, 16, 16)),
+              "b": _f32((_ENSEMBLE_N, 16, 16, 16))}
+    return HloSpec(fn=fn, args=(fields,), allow=("all_reduce",),
+                   exact_counts={"all_reduce": 1})
+
+
+# ---------------------------------------------------------------------------
 # resilience targets: the health sentinel's in-graph probe. The probe
 # rides the production step loop, so its communication contract is the
 # whole point: exactly ONE small all-reduce (the stacked-stats pmax)
@@ -937,6 +1015,20 @@ def default_targets() -> List[Target]:
     ]
     # every exchange configuration the autotuner can emit (Method.Auto)
     targets += _plan_targets()
+    # ensemble serving: the batched member axis rides existing
+    # collectives (same op count, bytes exactly xN)
+    targets += [
+        CollectiveTarget("serving.ensemble.exchange[N=4]",
+                         _ensemble_exchange_spec),
+        HloTarget("serving.ensemble.exchange[N=4,hlo]",
+                  lambda: _hlo_from_collective(_ensemble_exchange_spec)),
+        CostModelTarget("serving.ensemble.exchange[N=4,cost]",
+                        _ensemble_exchange_cost),
+        HloTarget("serving.ensemble.step[N=4,hlo]",
+                  _ensemble_step_spec),
+        HloTarget("serving.ensemble.probe[N=4,hlo]",
+                  _ensemble_probe_spec),
+    ]
     # the health sentinel's probe: exactly one small all-reduce, alone
     # and fused into the production step (see resilience/health.py)
     targets += [

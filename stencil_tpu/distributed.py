@@ -591,10 +591,16 @@ class DistributedDomain:
     def interior_to_host(self, name: str) -> np.ndarray:
         """Assemble the full global interior (z,y,x-ordered) on host by
         stripping per-shard halo padding."""
+        return self.assemble_interior(np.asarray(self.curr[name]))
+
+    def assemble_interior(self, host: np.ndarray) -> np.ndarray:
+        """Strip per-shard halo padding from a host copy of ANY
+        padded-global array laid out like this domain's fields (the
+        ensemble serving layer reads member lanes through this without
+        routing them through ``curr``)."""
         dim = self.placement.dim()
         pr = raw_size(self.local_size, self.alloc_radius)
         lo = self.alloc_radius.pad_lo()
-        host = np.asarray(self.curr[name])
         out = np.empty(zyx_shape(self.size), dtype=host.dtype)
         for bz in range(dim.z):
             for by in range(dim.y):

@@ -1,0 +1,45 @@
+"""Ensemble serving: batched campaigns behind an async multi-tenant
+service.
+
+Millions of users do not ask for one giant grid — they ask for
+thousands of small-to-medium simulations in flight at once (the
+parameter-scan / ensemble usage that drives production stencil
+frameworks such as PIConGPU, arXiv:1606.02862). This package turns the
+single-simulation stack built by PRs 1-5 into a serving system:
+
+* **Batched ensembles** (:mod:`.ensemble`) — a leading member axis is
+  vmapped through the shard step functions, so ONE compiled executable
+  advances N independent simulations (distinct initial conditions and
+  per-member physics parameters) per dispatch. The halo exchange stays
+  collective-permute-only with the SAME collective count as a single
+  member; each permute simply carries N slabs (wire bytes exactly xN —
+  proven by the ``serving.ensemble.*`` stencil-lint registry targets).
+  Health sentinels are per member: one member's NaN trips only that
+  member (:class:`.ensemble.EnsembleSentinel`).
+
+* **The campaign service** (:mod:`.queue`, :mod:`.service`) — an async
+  multi-tenant front end: requests queue up, admission packs
+  fingerprint-compatible requests (same compiled executable — the
+  :mod:`..tuning` fingerprint) into one ensemble dispatch, the
+  persistent tuning-plan cache supplies the exchange plan with zero
+  re-measurement, checkpoints live in per-tenant namespaces under the
+  hardened checkpoint layer, snapshot readback streams through the
+  non-blocking ``is_ready`` polling pattern, and the resilience ladder
+  (rollback, preempt/resume) applies per campaign, not per process.
+
+``apps/serve.py`` is the runnable front end; the CI service smoke
+drives >= 3 concurrent fake-tenant campaigns through it on CPU.
+"""
+
+from .ensemble import (EnsembleAstaroth, EnsembleHealth, EnsembleJacobi,
+                       EnsembleSentinel, configured_domain,
+                       domain_fingerprint, make_ensemble_probe)
+from .queue import CampaignHandle, CampaignRequest, RequestQueue
+from .service import CampaignResult, CampaignService, ServiceStats
+
+__all__ = [
+    "EnsembleJacobi", "EnsembleAstaroth", "EnsembleSentinel",
+    "EnsembleHealth", "make_ensemble_probe", "configured_domain",
+    "domain_fingerprint", "CampaignRequest", "CampaignHandle",
+    "RequestQueue", "CampaignService", "CampaignResult", "ServiceStats",
+]

@@ -1,0 +1,718 @@
+"""Batched ensembles: one compiled executable, N independent runs.
+
+The member axis is a leading, UNSHARDED dimension: fields are
+``(n_members, Zp, Yp, Xp)`` sharded ``P(None, 'z', 'y', 'x')``, and the
+per-shard step functions of :mod:`..models.jacobi` /
+:mod:`..models.astaroth` are ``jax.vmap``-ped over it inside the same
+``shard_map`` the single-member solvers use. Two properties fall out of
+the vmap batching rules and are pinned by the ``serving.ensemble.*``
+stencil-lint registry targets:
+
+* the halo exchange lowers to the SAME number of collective-permutes
+  as one member (6 for the radius-1 slab sweep) — the batch rides each
+  permute, it does not multiply dispatches;
+* the wire bytes are exactly ``n_members`` x the single-member analytic
+  model (the costmodel checker cross-checks the lowered HLO).
+
+Per-member parameters (Jacobi hot/cold Dirichlet temperatures, MHD
+physics coefficients) enter as ``(n_members,)`` runtime arrays — NOT
+baked constants — so a service can re-dispatch the same compiled
+executable for every fingerprint-compatible request batch with zero
+recompiles.
+
+Health is per member: :func:`make_ensemble_probe` vmaps the
+:func:`..resilience.health.probe_shard` reduction, producing a
+``(n_members, 2, n_quantities)`` stats tensor with still exactly ONE
+small all-reduce; :class:`EnsembleSentinel` evaluates the divergence
+predicate per member, so one member's NaN trips only that member.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..distributed import DistributedDomain
+from ..geometry import Dim3, Radius
+from ..local_domain import zyx_shape
+from ..parallel.exchange import dispatch_exchange, shard_origin
+from ..parallel.mesh import mesh_dim
+from ..parallel.methods import Method, pick_method
+from ..resilience.health import ROW_MAX_ABS, ROW_NONFINITE, HealthStats, \
+    _is_ready, probe_shard
+from ..utils.checkpoint import (CorruptCheckpointError, all_steps,
+                                array_digest, restore_state, save_state,
+                                verify_digests)
+from ..utils.logging import LOG_INFO, LOG_WARN
+
+#: batched field sharding: member axis replicated, space sharded
+ENSEMBLE_SPEC = P(None, "z", "y", "x")
+
+
+# ---------------------------------------------------------------------------
+# problem identity (shared by queue admission and engine construction)
+
+
+def configured_domain(model: str, grid: Sequence[int], dtype=jnp.float32,
+                      methods: Method = Method.Default, boundary=None,
+                      mesh_shape=None, devices=None) -> DistributedDomain:
+    """A configured (NOT realized) domain for ``model`` — the single
+    source of the quantity set / radius / mesh choice, so the queue's
+    admission fingerprint and the engine's compiled program can never
+    disagree about problem identity."""
+    x, y, z = (int(v) for v in grid)
+    dd = DistributedDomain(x, y, z, devices=devices)
+    if model == "jacobi":
+        dd.set_radius(1)
+        dd.add_data("temp", dtype)
+    elif model == "astaroth":
+        from ..models.astaroth import FIELDS
+        from ..ops.fd6 import RADIUS
+        dd.set_radius(Radius.constant(RADIUS))
+        for q in FIELDS:
+            dd.add_data(q, dtype)
+    else:
+        raise ValueError(f"unknown ensemble model {model!r} "
+                         f"(jacobi|astaroth)")
+    dd.set_methods(methods)
+    if boundary is not None:
+        dd.set_boundary(boundary)
+    if mesh_shape is not None:
+        dd.set_mesh_shape(mesh_shape)
+    return dd
+
+
+def domain_fingerprint(dd: DistributedDomain) -> str:
+    """The :mod:`..tuning` problem fingerprint of a configured domain —
+    the admission key: requests sharing it share a compiled executable
+    AND a cached exchange plan."""
+    from ..tuning import fingerprint, inputs_from_domain
+    return fingerprint(inputs_from_domain(dd, dd._choose_partition_dim()))
+
+
+# ---------------------------------------------------------------------------
+# per-member health
+
+
+def make_ensemble_probe(mesh, names: Sequence[str]):
+    """The jitted per-member probe: ``fn(batched_fields) ->
+    (n_members, 2, len(names))`` replicated f32 stats. The vmapped
+    ``pmax`` still lowers to exactly ONE small all-reduce (pinned by
+    the ``serving.ensemble.probe[hlo]`` registry target)."""
+    names = list(names)
+    spec = {q: ENSEMBLE_SPEC for q in names}
+
+    def shard(fields):
+        return jax.vmap(
+            lambda f: probe_shard({q: f[q] for q in names}))(fields)
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(spec,),
+                       out_specs=P(), check_vma=False)
+    return jax.jit(sm)
+
+
+@dataclasses.dataclass
+class EnsembleHealth:
+    """One harvested per-member probe: ``members[k]`` is member k's
+    :class:`~..resilience.health.HealthStats` at ``step``."""
+
+    step: int
+    members: List[HealthStats]
+
+    @property
+    def tripped_members(self) -> List[int]:
+        return [k for k, s in enumerate(self.members) if s.tripped]
+
+
+class EnsembleSentinel:
+    """Per-member watchdog over an ensemble engine: ``probe(step)``
+    enqueues the batched on-device reduction (async), ``poll()``
+    harvests ready results and evaluates the divergence predicate
+    independently per member — a NaN in member k trips member k and
+    nobody else. ``reset_member(k)`` forgets k's history after the
+    service rolls that campaign back (other members' histories and
+    verdicts are untouched)."""
+
+    def __init__(self, engine, window: int = 8,
+                 growth_factor: float = 1e6) -> None:
+        self.engine = engine
+        self.names = list(engine.dd._names)
+        self.window = int(window)
+        self.growth_factor = float(growth_factor)
+        self._pending: Deque[Tuple[int, jnp.ndarray]] = deque()
+        self._history: List[Dict[str, Deque[float]]] = [
+            {q: deque(maxlen=self.window) for q in self.names}
+            for _ in range(engine.n_members)]
+
+    def probe(self, step: int) -> None:
+        self._pending.append(
+            (step, self.engine._probe_fn(dict(self.engine.state))))
+
+    def poll(self, block: bool = False) -> List[EnsembleHealth]:
+        out: List[EnsembleHealth] = []
+        while self._pending:
+            step, arr = self._pending[0]
+            if not block and not _is_ready(arr):
+                break
+            self._pending.popleft()
+            out.append(self._evaluate(step, np.asarray(arr)))
+        return out
+
+    def reset_member(self, k: int) -> None:
+        for h in self._history[k].values():
+            h.clear()
+
+    def reset(self) -> None:
+        self._pending.clear()
+        for k in range(len(self._history)):
+            self.reset_member(k)
+
+    def _evaluate(self, step: int, host: np.ndarray) -> EnsembleHealth:
+        members: List[HealthStats] = []
+        for k in range(host.shape[0]):
+            nonfinite = {q: int(host[k, ROW_NONFINITE, i])
+                         for i, q in enumerate(self.names)}
+            max_abs = {q: float(host[k, ROW_MAX_ABS, i])
+                       for i, q in enumerate(self.names)}
+            stats = HealthStats(step, nonfinite, max_abs)
+            bad = [q for q, n in nonfinite.items() if n > 0]
+            if bad:
+                stats.tripped = True
+                stats.reason = (f"member {k}: non-finite cells in {bad} "
+                                f"({ {q: nonfinite[q] for q in bad} })")
+            else:
+                grown = []
+                for q in self.names:
+                    hist = self._history[k][q]
+                    if hist:
+                        baseline = min(hist)
+                        if baseline > 0 and \
+                                max_abs[q] > self.growth_factor * baseline:
+                            grown.append(q)
+                if grown:
+                    stats.tripped = True
+                    stats.reason = (f"member {k}: max-abs grew more "
+                                    f"than x{self.growth_factor:g} "
+                                    f"over the window for {grown}")
+                else:
+                    for q in self.names:
+                        self._history[k][q].append(max_abs[q])
+            members.append(stats)
+        return EnsembleHealth(step, members)
+
+
+# ---------------------------------------------------------------------------
+# the engines
+
+
+class _EnsembleBase:
+    """Shared machinery of the batched engines: the domain, the batched
+    state allocation, lane get/set, per-member parameters, snapshots,
+    and per-member checkpoint save/restore."""
+
+    MODEL = ""
+    #: per-member runtime parameters, in the order the step consumes
+    PARAM_NAMES: Tuple[str, ...] = ()
+
+    def __init__(self, n_members: int, x: int, y: int, z: int,
+                 dtype=jnp.float32, devices=None,
+                 methods: Method = Method.Default, boundary=None,
+                 mesh_shape=None, plan=None) -> None:
+        if int(n_members) < 1:
+            raise ValueError(f"n_members must be >= 1, got {n_members}")
+        self.n_members = int(n_members)
+        self.dd = configured_domain(self.MODEL, (x, y, z), dtype=dtype,
+                                    methods=methods, boundary=boundary,
+                                    mesh_shape=mesh_shape,
+                                    devices=devices)
+        #: the admission/plan-cache key (computed pre-realize)
+        self.fingerprint = domain_fingerprint(self.dd)
+        if plan is not None:
+            # adopt the plan's transport; temporal blocking depths are
+            # a single-run optimization the batched step does not take
+            self.dd.set_methods(Method[plan.config.method])
+            if plan.config.exchange_every != 1:
+                LOG_INFO(f"ensemble engine ignores plan depth "
+                         f"s={plan.config.exchange_every} (batched "
+                         f"steps exchange every step)")
+            self.dd.plan = plan
+        self.dd.realize()
+        self._dtype = np.dtype(dtype)
+        self.names: List[str] = list(self.dd._names)
+        self._batched_sharding = NamedSharding(self.dd.mesh, ENSEMBLE_SPEC)
+        self._lane_shape = tuple(zyx_shape(self.dd._padded_global))
+        #: batched padded fields: name -> (n_members, Zp, Yp, Xp)
+        self.state: Dict[str, jnp.ndarray] = {
+            q: self._zeros_batched() for q in self.names}
+        self._params: Dict[str, np.ndarray] = {
+            p: np.full(self.n_members, v, dtype=np.float64)
+            for p, v in self.default_params().items()}
+        self._probe_fn = make_ensemble_probe(self.dd.mesh, self.names)
+        self._build_lane_ops()
+        self._build_step()
+
+    # -- subclass contract ---------------------------------------------
+    def default_params(self) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def _build_step(self) -> None:
+        raise NotImplementedError
+
+    def run(self, n_steps: int) -> None:
+        """Advance ALL members ``n_steps`` steps in one dispatch."""
+        raise NotImplementedError
+
+    # -- allocation / lane plumbing ------------------------------------
+    def _zeros_batched(self) -> jnp.ndarray:
+        return jax.device_put(
+            jnp.zeros((self.n_members,) + self._lane_shape,
+                      dtype=self._dtype), self._batched_sharding)
+
+    def _build_lane_ops(self) -> None:
+        def get_lane(state, k):
+            return {q: lax.dynamic_index_in_dim(state[q], k, axis=0,
+                                                keepdims=False)
+                    for q in state}
+
+        self._get_lane = jax.jit(get_lane)
+
+        def set_lane(state, lane, k):
+            zero = jnp.zeros((), dtype=jnp.asarray(k).dtype)
+            out = {}
+            for q in state:
+                if q in lane:
+                    out[q] = lax.dynamic_update_slice(
+                        state[q],
+                        lane[q][None].astype(state[q].dtype),
+                        (k, zero, zero, zero))
+                else:
+                    out[q] = state[q]
+            return out
+
+        self._set_lane = jax.jit(set_lane, donate_argnums=0)
+
+    def _param_args(self) -> Tuple[jnp.ndarray, ...]:
+        return tuple(jnp.asarray(self._params[p], dtype=self._dtype)
+                     for p in self.PARAM_NAMES)
+
+    # -- per-member parameters -----------------------------------------
+    def set_member_params(self, k: int, overrides: Dict[str, float]
+                          ) -> None:
+        for name, v in overrides.items():
+            if name not in self._params:
+                raise KeyError(
+                    f"unknown ensemble parameter {name!r} for "
+                    f"{self.MODEL} (have {sorted(self._params)})")
+            self._params[name][k] = float(v)
+
+    def member_params(self, k: int) -> Dict[str, float]:
+        return {p: float(a[k]) for p, a in self._params.items()}
+
+    # -- member state access -------------------------------------------
+    def set_member_interior(self, name: str, k: int,
+                            values: np.ndarray) -> None:
+        """Scatter a global (z,y,x) interior into member ``k``'s lane
+        of quantity ``name`` (initial conditions / restore)."""
+        self.dd.set_interior(name, np.asarray(values, dtype=self._dtype))
+        self.state = self._set_lane(self.state,
+                                    {name: self.dd.curr[name]},
+                                    jnp.int32(k))
+
+    def member_interior(self, name: str, k: int) -> np.ndarray:
+        """Member ``k``'s global interior of ``name`` on host
+        (blocking)."""
+        lane = self._get_lane(dict(self.state), jnp.int32(k))[name]
+        return self.dd.assemble_interior(np.asarray(lane))
+
+    def member_interiors(self, k: int) -> Dict[str, np.ndarray]:
+        """All of member ``k``'s global interiors on host with ONE
+        lane gather (checkpoints and completions want every quantity —
+        per-quantity :meth:`member_interior` calls would re-slice the
+        whole lane set each time)."""
+        lanes = self._get_lane(dict(self.state), jnp.int32(k))
+        return {q: self.dd.assemble_interior(np.asarray(v))
+                for q, v in lanes.items()}
+
+    def member_snapshot_async(self, k: int, step: int
+                              ) -> "EnsembleSnapshot":
+        """Enqueue a snapshot of member ``k``: the lane slice rides the
+        device queue; poll :meth:`EnsembleSnapshot.ready` and call
+        :meth:`~EnsembleSnapshot.get` once true — the step pipeline is
+        never stalled by readback."""
+        lanes = self._get_lane(dict(self.state), jnp.int32(k))
+        return EnsembleSnapshot(self, k, step, lanes)
+
+    def reset_member(self, k: int) -> None:
+        """Benign (zero) state + default parameters for lane ``k`` —
+        idle lanes of a partially-filled service batch, and poisoned
+        lanes of failed campaigns, must not trip the sentinel."""
+        zero = {q: jnp.zeros(self._lane_shape, dtype=self._dtype)
+                for q in self.names}
+        self.state = self._set_lane(self.state, zero, jnp.int32(k))
+        for p, v in self.default_params().items():
+            self._params[p][k] = v
+
+    # -- per-member checkpoints (hardened layer) -----------------------
+    def _member_extra_arrays(self, k: int) -> Dict[str, jnp.ndarray]:
+        """Model-specific auxiliary state to checkpoint with a lane
+        (the Astaroth RK accumulator)."""
+        return {}
+
+    def _member_extra_targets(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        """Restore targets (shape/dtype only) for the extras — no
+        device gather, just the contract."""
+        return {}
+
+    def _restore_member_extras(self, k: int,
+                               extras: Dict[str, jnp.ndarray]) -> None:
+        pass
+
+    def save_member(self, directory: str, step: int, k: int,
+                    meta_extra: Optional[Dict] = None,
+                    max_to_keep: Optional[int] = 3) -> None:
+        """Checkpoint member ``k`` at campaign step ``step`` into
+        ``directory`` (a tenant-namespace path): mesh-independent
+        interiors + sha256 integrity digests in the meta record,
+        through the retrying :func:`..utils.checkpoint.save_state`."""
+        arrays: Dict[str, jnp.ndarray] = {
+            q: jnp.asarray(v)
+            for q, v in self.member_interiors(k).items()}
+        for name, v in self._member_extra_arrays(k).items():
+            arrays[f"extra:{name}"] = v
+        meta = {"size": list(self.dd.size),
+                "quantities": self.names,
+                "dtypes": {q: str(self._dtype) for q in self.names},
+                "member_params": self.member_params(k),
+                "integrity": {q: array_digest(v)
+                              for q, v in arrays.items()}}
+        for key, v in (meta_extra or {}).items():
+            meta[key] = v
+        save_state(directory, step, arrays, meta=meta,
+                   max_to_keep=max_to_keep)
+
+    def restore_member(self, directory: str, k: int,
+                       step: Optional[int] = None) -> int:
+        """Restore member ``k`` from the newest restorable checkpoint
+        in ``directory`` (or ``step``), verifying integrity digests and
+        walking back past corrupt steps exactly like
+        :func:`..utils.checkpoint.restore_domain`. Returns the restored
+        step."""
+        candidates = ([step] if step is not None
+                      else sorted(all_steps(directory), reverse=True))
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoint in {directory}")
+        repl = NamedSharding(self.dd.mesh, P())
+        last_err: Optional[Exception] = None
+        targets = {q: jax.ShapeDtypeStruct(
+            zyx_shape(self.dd.size), self._dtype, sharding=repl)
+            for q in self.names}
+        for name, s in self._member_extra_targets().items():
+            targets[f"extra:{name}"] = jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=repl)
+        for cand in candidates:
+            try:
+                got, arrays, meta = restore_state(directory, targets,
+                                                  step=cand)
+                bad = verify_digests(arrays,
+                                     meta.get("integrity") or {})
+                if bad:
+                    raise CorruptCheckpointError(
+                        f"step {cand}: integrity sha256 mismatch for "
+                        f"{bad}")
+            except Exception as e:  # noqa: BLE001 - orbax raises many
+                # (json.JSONDecodeError from truncated metadata blobs
+                # is a ValueError subclass — every failure here is a
+                # walk-back candidate, there is no compat gate to
+                # re-raise through)
+                last_err = e
+                LOG_WARN(f"member checkpoint {directory} step {cand} "
+                         f"unrestorable ({type(e).__name__}: {e}); "
+                         f"falling back to an older step")
+                continue
+            for q in self.names:
+                self.set_member_interior(q, k, np.asarray(arrays[q]))
+            self._restore_member_extras(
+                k, {key[len("extra:"):]: v for key, v in arrays.items()
+                    if key.startswith("extra:")})
+            if meta.get("member_params"):
+                self.set_member_params(k, meta["member_params"])
+            return got
+        raise CorruptCheckpointError(
+            f"no restorable member checkpoint in {directory} "
+            f"(tried steps {candidates}): {last_err}")
+
+
+class EnsembleSnapshot:
+    """A streaming snapshot in flight: device lane slices enqueued by
+    :meth:`_EnsembleBase.member_snapshot_async`."""
+
+    def __init__(self, engine, member: int, step: int,
+                 lanes: Dict[str, jnp.ndarray]) -> None:
+        self.engine = engine
+        self.member = member
+        self.step = step
+        self._lanes = lanes
+
+    def ready(self) -> bool:
+        return all(_is_ready(v) for v in self._lanes.values())
+
+    def get(self) -> Dict[str, np.ndarray]:
+        """Host interiors (blocks only if :meth:`ready` is False)."""
+        return {q: self.engine.dd.assemble_interior(np.asarray(v))
+                for q, v in self._lanes.items()}
+
+
+# ---------------------------------------------------------------------------
+
+
+class EnsembleJacobi(_EnsembleBase):
+    """N independent Jacobi-3D heat runs per dispatch, with per-member
+    hot/cold Dirichlet sphere temperatures (the "boundary values" of a
+    parameter scan)."""
+
+    MODEL = "jacobi"
+    PARAM_NAMES = ("hot_temp", "cold_temp")
+
+    def default_params(self) -> Dict[str, float]:
+        from ..models.jacobi import COLD_TEMP, HOT_TEMP
+        return {"hot_temp": HOT_TEMP, "cold_temp": COLD_TEMP}
+
+    def init(self) -> None:
+        """Every member starts at its own mean temperature
+        ``(hot + cold) / 2`` (the reference's init, per member)."""
+        means = (self._params["hot_temp"]
+                 + self._params["cold_temp"]) / 2.0
+        full = jnp.broadcast_to(
+            jnp.asarray(means, self._dtype)[:, None, None, None],
+            (self.n_members,) + self._lane_shape)
+        self.state = {"temp": jax.device_put(jnp.array(full),
+                                             self._batched_sharding)}
+
+    def init_member(self, k: int, seed: int = 0) -> None:
+        """Initial conditions for lane ``k`` alone: the member's mean
+        temperature, plus a small seeded perturbation when ``seed`` is
+        nonzero (distinct initial conditions per campaign)."""
+        mean = (self._params["hot_temp"][k]
+                + self._params["cold_temp"][k]) / 2.0
+        interior = np.full(zyx_shape(self.dd.size), mean)
+        if int(seed):
+            rng = np.random.default_rng(int(seed))
+            interior = interior + 0.01 * rng.standard_normal(
+                interior.shape)
+        self.set_member_interior("temp", k, interior)
+
+    def _build_step(self) -> None:
+        from ..models.jacobi import sphere_geometry
+        from ..ops.stencil_kernels import (global_coords, jacobi7,
+                                           write_interior)
+        from ..topology import Boundary
+
+        dd = self.dd
+        radius = dd.radius
+        counts = mesh_dim(dd.mesh)
+        local = dd.local_size
+        gsize = dd.size
+        method = pick_method(dd.methods)
+        rem = dd.rem
+        nonper = dd.boundary == Boundary.NONE
+        hot_c, cold_c, sph_r = sphere_geometry(gsize)
+
+        def member_step(p, hot, cold, origin):
+            p = dispatch_exchange({"temp": p}, radius, counts, method,
+                                  rem=rem, nonperiodic=nonper)["temp"]
+            new = jacobi7(p, radius, local)
+            gz, gy, gx = global_coords(origin, local)
+
+            def dist2(c: Dim3):
+                return ((gx - c.x) ** 2 + (gy - c.y) ** 2
+                        + (gz - c.z) ** 2)
+
+            new = jnp.where(dist2(hot_c) <= sph_r * sph_r,
+                            hot.astype(new.dtype), new)
+            new = jnp.where(dist2(cold_c) <= sph_r * sph_r,
+                            cold.astype(new.dtype), new)
+            return write_interior(p, new, radius)
+
+        def shard_steps(batched, hot, cold, n):
+            origin = shard_origin(local, rem)
+
+            def one(q):
+                return jax.vmap(
+                    lambda p, h, c: member_step(p, h, c, origin))(
+                        q, hot, cold)
+
+            return lax.fori_loop(0, n, lambda _, q: one(q), batched)
+
+        sm = jax.shard_map(
+            shard_steps, mesh=dd.mesh,
+            in_specs=(ENSEMBLE_SPEC, P(), P(), P()),
+            out_specs=ENSEMBLE_SPEC, check_vma=False)
+        self._step_n = jax.jit(sm, donate_argnums=0)
+
+    def run(self, n_steps: int) -> None:
+        hot, cold = self._param_args()
+        self.state = {"temp": self._step_n(
+            self.state["temp"], hot, cold,
+            jnp.asarray(n_steps, jnp.int32))}
+
+
+class EnsembleAstaroth(_EnsembleBase):
+    """N independent MHD runs per dispatch, with per-member physics
+    coefficients (viscosity / resistivity / bulk viscosity / sound
+    speed — the PIConGPU-style parameter scan)."""
+
+    MODEL = "astaroth"
+    PARAM_NAMES = ("nu_visc", "eta", "zeta", "cs_sound")
+
+    def __init__(self, *args, params=None, **kw) -> None:
+        from ..models.astaroth import MhdParams
+        self.prm = params or MhdParams()
+        super().__init__(*args, **kw)
+
+    def default_params(self) -> Dict[str, float]:
+        return {p: float(getattr(self.prm, p)) for p in self.PARAM_NAMES}
+
+    def init(self, seeds: Optional[Sequence[int]] = None) -> None:
+        """Per-member initial conditions: member ``k`` draws its noise
+        fields from ``seeds[k]`` (default ``k``) — distinct
+        trajectories even under identical physics."""
+        seeds = (list(seeds) if seeds is not None
+                 else list(range(self.n_members)))
+        if len(seeds) != self.n_members:
+            raise ValueError(f"{len(seeds)} seeds for "
+                             f"{self.n_members} members")
+        for k, seed in enumerate(seeds):
+            self.init_member(k, seed)
+        self.w = {q: jax.device_put(
+            jnp.zeros((self.n_members,) + zyx_shape(self.dd.size),
+                      dtype=self._dtype),
+            self._batched_sharding) for q in self.names}
+
+    def init_member(self, k: int, seed: int = 0) -> None:
+        """Initial conditions for lane ``k`` alone: seeded noise in the
+        potential/entropy fields, constant lnrho, and the radial
+        explosion shell velocity (the reference's init with a per-
+        member random draw). Zeroes k's RK accumulator lane."""
+        from ..models.astaroth import _radial_explosion
+        size = self.dd.size
+        shape = zyx_shape(size)
+        rng = np.random.default_rng(int(seed))
+        for q in ("ax", "ay", "az", "ss"):
+            self.set_member_interior(q, k,
+                                     rng.uniform(-1.0, 1.0, size=shape))
+        self.set_member_interior("lnrho", k, np.full(shape, 0.5))
+        ux, uy, uz = _radial_explosion(size, self.prm)
+        self.set_member_interior("uux", k, ux)
+        self.set_member_interior("uuy", k, uy)
+        self.set_member_interior("uuz", k, uz)
+        zero = {q: jnp.zeros(zyx_shape(size), dtype=self._dtype)
+                for q in self.names}
+        self.w = self._set_lane(self.w, zero, jnp.int32(k))
+
+    def _build_step(self) -> None:
+        from ..models.astaroth import (FIELDS, RK3_ALPHA, RK3_BETA,
+                                       mhd_rates)
+        from ..ops.fd6 import FieldData
+        from ..ops.pallas_mhd import compute_dtype
+        from ..topology import Boundary
+
+        dd = self.dd
+        radius = dd.radius
+        counts = mesh_dim(dd.mesh)
+        local = dd.local_size
+        prm = self.prm
+        pad_lo = radius.pad_lo()
+        inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
+        method = pick_method(dd.methods)
+        dt = prm.dt
+        rem = dd.rem
+        nonper = dd.boundary == Boundary.NONE
+        comp = compute_dtype(self._dtype)
+        store = jnp.dtype(self._dtype)
+
+        #: RK accumulators ride interior-shaped, like the solver's xla
+        #: path; init() allocates them batched
+        self.w: Dict[str, jnp.ndarray] = {
+            q: jax.device_put(
+                jnp.zeros((self.n_members,) + zyx_shape(dd.size),
+                          dtype=self._dtype), self._batched_sharding)
+            for q in FIELDS}
+
+        def member_iter(fields, w, pvals):
+            mprm = dataclasses.replace(
+                prm, **{p: pvals[p].astype(comp)
+                        for p in self.PARAM_NAMES})
+            for s in range(3):
+                fields = dispatch_exchange(fields, radius, counts,
+                                           method, rem=rem,
+                                           nonperiodic=nonper)
+                data = {q: FieldData(fields[q].astype(comp), inv_ds,
+                                     pad_lo, local)
+                        for q in FIELDS}
+                rates = mhd_rates(data, mprm, comp)
+                alpha = jnp.asarray(RK3_ALPHA[s], comp)
+                beta = jnp.asarray(RK3_BETA[s], comp)
+                dt_ = jnp.asarray(dt, comp)
+                new_f = {}
+                new_w = {}
+                for q in FIELDS:
+                    wq = alpha * w[q].astype(comp) + dt_ * rates[q]
+                    uq = data[q].value + beta * wq
+                    new_w[q] = wq.astype(store)
+                    new_f[q] = lax.dynamic_update_slice(
+                        fields[q], uq.astype(store),
+                        (pad_lo.z, pad_lo.y, pad_lo.x))
+                fields, w = new_f, new_w
+            return fields, w
+
+        def shard_iters(fields, w, pvals, n):
+            def one(fw):
+                return jax.vmap(member_iter)(fw[0], fw[1], pvals)
+
+            return lax.fori_loop(0, n, lambda _, fw: one(fw),
+                                 (fields, w))
+
+        fspec = {q: ENSEMBLE_SPEC for q in FIELDS}
+        pspec = {p: P() for p in self.PARAM_NAMES}
+        sm = jax.shard_map(shard_iters, mesh=dd.mesh,
+                           in_specs=(fspec, fspec, pspec, P()),
+                           out_specs=(fspec, fspec), check_vma=False)
+        self._iter_n = jax.jit(sm, donate_argnums=(0, 1))
+
+    def run(self, n_steps: int) -> None:
+        pvals = {p: jnp.asarray(self._params[p], dtype=self._dtype)
+                 for p in self.PARAM_NAMES}
+        self.state, self.w = self._iter_n(
+            dict(self.state), dict(self.w), pvals,
+            jnp.asarray(n_steps, jnp.int32))
+
+    # RK accumulators are campaign state: a lane rollback without its
+    # w would resume mid-RK-iteration with a zeroed accumulator
+    def _member_extra_arrays(self, k: int) -> Dict[str, jnp.ndarray]:
+        lanes = self._get_lane(dict(self.w), jnp.int32(k))
+        return {f"w:{q}": jnp.asarray(np.asarray(v))
+                for q, v in lanes.items()}
+
+    def _member_extra_targets(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        return {f"w:{q}": jax.ShapeDtypeStruct(
+            zyx_shape(self.dd.size), self._dtype) for q in self.names}
+
+    def _restore_member_extras(self, k: int,
+                               extras: Dict[str, jnp.ndarray]) -> None:
+        lane = {q: jnp.asarray(extras[f"w:{q}"]) for q in self.names
+                if f"w:{q}" in extras}
+        if lane:
+            self.w = self._set_lane(self.w, lane, jnp.int32(k))
+
+    def reset_member(self, k: int) -> None:
+        super().reset_member(k)
+        zero = {q: jnp.zeros(zyx_shape(self.dd.size),
+                             dtype=self._dtype) for q in self.names}
+        self.w = self._set_lane(self.w, zero, jnp.int32(k))

@@ -1,0 +1,171 @@
+"""Campaign requests and the admission queue.
+
+A :class:`CampaignRequest` describes one tenant's simulation: model,
+grid, step budget, per-member physics parameters, and the tenant's
+resilience policy knobs. At submit time the request is fingerprinted
+with the SAME problem fingerprint the autotuner caches plans under
+(:func:`..serving.ensemble.domain_fingerprint`), so "these requests can
+share a compiled executable" and "this request can reuse a cached
+exchange plan" are one question with one answer.
+
+:class:`RequestQueue` is the admission structure:
+``pop_batch(width)`` removes the oldest request plus every younger
+request with the SAME fingerprint (up to ``width``) — the batch a
+single ensemble dispatch serves. Requests with other fingerprints keep
+their queue order for later batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class CampaignRequest:
+    """One tenant's simulation campaign."""
+
+    tenant: str
+    campaign: str
+    model: str = "jacobi"               # jacobi | astaroth
+    grid: Tuple[int, int, int] = (8, 8, 8)
+    n_steps: int = 4
+    dtype: str = "float32"
+    boundary: str = "PERIODIC"
+    mesh_shape: Optional[Tuple[int, int, int]] = None
+    #: per-member physics parameters (e.g. jacobi hot_temp/cold_temp,
+    #: astaroth nu_visc/eta/zeta/cs_sound); unset keys use defaults
+    params: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: seeds the member's initial conditions (model-specific)
+    init_seed: int = 0
+    # -- per-tenant policy knobs (the resilience ladder, per campaign)
+    check_every: int = 1        # sentinel probe cadence (member steps)
+    ckpt_every: int = 0         # 0 = anchor checkpoint at step 0 only
+    snapshot_every: int = 0     # 0 = final snapshot only
+    max_retries: int = 2        # rollbacks before the campaign fails
+    #: test/chaos hook: poison this member at the given member-step
+    #: (None = no injection); fires once
+    chaos_nan_step: Optional[int] = None
+
+    def validate(self) -> None:
+        from ..utils.checkpoint import validate_checkpoint_component
+        validate_checkpoint_component(self.tenant, kind="tenant id")
+        validate_checkpoint_component(self.campaign, kind="campaign id")
+        if self.model not in ("jacobi", "astaroth"):
+            raise ValueError(f"unknown model {self.model!r}")
+        if int(self.n_steps) < 1:
+            raise ValueError(f"n_steps must be >= 1, got {self.n_steps}")
+        if int(self.check_every) < 1:
+            raise ValueError("check_every must be >= 1")
+
+
+def request_fingerprint(req: CampaignRequest, devices=None) -> str:
+    """The problem fingerprint of a request — the admission AND
+    plan-cache key (requests sharing it share a compiled executable and
+    a tuned exchange plan)."""
+    import jax.numpy as jnp
+
+    from ..topology import Boundary
+    from .ensemble import configured_domain, domain_fingerprint
+
+    dd = configured_domain(
+        req.model, req.grid, dtype=jnp.dtype(req.dtype),
+        boundary=Boundary[req.boundary], mesh_shape=req.mesh_shape,
+        devices=devices)
+    return domain_fingerprint(dd)
+
+
+class CampaignHandle:
+    """The submitter's side of a campaign: wait on :meth:`result`."""
+
+    def __init__(self, request: CampaignRequest) -> None:
+        self.request = request
+        #: set at submit time (the admission/plan-cache key)
+        self.fingerprint: Optional[str] = None
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    # -- service side ---------------------------------------------------
+    def _resolve(self, result) -> None:
+        self._result = result
+        self._done.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+    # -- client side ----------------------------------------------------
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the campaign completes (or fails, re-raising its
+        error; or ``TimeoutError`` after ``timeout`` seconds)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"campaign {self.request.tenant}/{self.request.campaign}"
+                f" not done after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclasses.dataclass
+class _Entry:
+    request: CampaignRequest
+    handle: CampaignHandle
+    fingerprint: str
+    seq: int
+
+
+class RequestQueue:
+    """Thread-safe FIFO with fingerprint-compatible batch admission."""
+
+    def __init__(self, devices=None) -> None:
+        self._devices = devices
+        self._entries: List[_Entry] = []
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._seq = 0
+
+    def submit(self, req: CampaignRequest) -> CampaignHandle:
+        req.validate()
+        fp = request_fingerprint(req, devices=self._devices)
+        handle = CampaignHandle(req)
+        handle.fingerprint = fp
+        with self._lock:
+            self._entries.append(_Entry(req, handle, fp, self._seq))
+            self._seq += 1
+            self._not_empty.notify_all()
+        return handle
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def wait_nonempty(self, timeout: Optional[float] = None) -> bool:
+        with self._lock:
+            if self._entries:
+                return True
+            return self._not_empty.wait_for(
+                lambda: bool(self._entries), timeout)
+
+    def pop_batch(self, width: int) -> List[_Entry]:
+        """The next admission batch: the oldest request and every
+        younger fingerprint-identical request, up to ``width`` members.
+        Other fingerprints keep their positions."""
+        with self._lock:
+            if not self._entries:
+                return []
+            head_fp = self._entries[0].fingerprint
+            batch: List[_Entry] = []
+            rest: List[_Entry] = []
+            for e in self._entries:
+                if e.fingerprint == head_fp and len(batch) < int(width):
+                    batch.append(e)
+                else:
+                    rest.append(e)
+            self._entries = rest
+            return batch

@@ -1,0 +1,501 @@
+"""The async multi-tenant campaign service.
+
+One :class:`CampaignService` owns a request queue, a cache of compiled
+ensemble engines keyed by problem fingerprint, and the persistent
+tuning-plan cache. The worker loop:
+
+1. **admit** — pop the oldest request plus every fingerprint-identical
+   one (:meth:`..serving.queue.RequestQueue.pop_batch`) into one batch
+   of at most ``width`` members;
+2. **plan** — a plan-cache hit supplies the exchange configuration
+   with ZERO measurements; a miss tunes once (injectable timer; depth
+   pinned to 1 — the batched step exchanges every step) and persists
+   the plan for every later fingerprint-identical request;
+3. **compile** — the engine cache returns the already-built executable
+   for a known fingerprint (zero recompiles); only a brand-new
+   fingerprint constructs (and therefore compiles) an engine;
+4. **run** — the segment loop advances ALL lanes per dispatch,
+   probing per-member health, streaming snapshots through non-blocking
+   ``is_ready`` polling, checkpointing each campaign into its tenant
+   namespace, and rolling back ONLY the tripped member's lane on a
+   fault (bounded retries per campaign, then the campaign fails while
+   its batch-mates keep running);
+5. **preempt/resume** — :meth:`CampaignService.preempt` checkpoints
+   every active campaign (tagged ``preempted``) and stops; resubmitting
+   a campaign whose namespace holds checkpoints resumes it from the
+   newest restorable step.
+
+Everything lands in a JSON-serializable event log (the CI service-smoke
+artifact) plus :class:`ServiceStats` counters the smoke asserts on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.checkpoint import all_steps, validate_checkpoint_component
+from ..utils.logging import LOG_INFO, LOG_WARN
+from .ensemble import EnsembleAstaroth, EnsembleJacobi, EnsembleSentinel
+from .queue import CampaignRequest, RequestQueue
+
+
+class CampaignFailed(RuntimeError):
+    """A campaign exhausted its per-tenant retry budget."""
+
+
+@dataclasses.dataclass
+class ServiceStats:
+    """Counters the CI service smoke asserts on."""
+
+    batches: int = 0
+    compiles: int = 0            # engine constructions (new fingerprint)
+    plan_cache_hits: int = 0
+    tuner_measurements: int = 0  # total timer invocations
+    completed: int = 0
+    failed: int = 0
+    rollbacks: int = 0
+
+    def to_record(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CampaignResult:
+    """What a completed campaign hands back to its tenant."""
+
+    tenant: str
+    campaign: str
+    steps: int
+    rollbacks: int = 0
+    resumed_from: Optional[int] = None
+    preempted: bool = False
+    #: (member_step, {quantity: global interior}) in step order
+    snapshots: List = dataclasses.field(default_factory=list)
+    #: {quantity: global interior} at the final step
+    final: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class _Lane:
+    """One campaign's slot in a running batch."""
+
+    entry: object                # queue._Entry
+    index: int                   # lane index in the ensemble
+    ckpt_dir: str
+    counter: int = 0             # member steps completed
+    rollbacks: int = 0
+    resumed_from: Optional[int] = None
+    active: bool = True
+    chaos_fired: bool = False
+    snapshots: Dict[int, Dict[str, np.ndarray]] = \
+        dataclasses.field(default_factory=dict)
+
+    @property
+    def request(self) -> CampaignRequest:
+        return self.entry.request
+
+
+class CampaignService:
+    """Batched multi-tenant campaign server over one device set."""
+
+    def __init__(self, root_dir: str, devices=None, width: int = 8,
+                 tuner_timer=None, plan_cache_path=None,
+                 window: int = 8, growth_factor: float = 1e6,
+                 max_to_keep: int = 3) -> None:
+        if int(width) < 1:
+            raise ValueError(f"width must be >= 1, got {width}")
+        self.root = Path(root_dir)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.width = int(width)
+        self._devices = devices
+        self._tuner_timer = tuner_timer
+        self._plan_cache_path = plan_cache_path
+        self._window = int(window)
+        self._growth_factor = float(growth_factor)
+        self._max_to_keep = int(max_to_keep)
+        self.queue = RequestQueue(devices)
+        self.stats = ServiceStats()
+        self.events: List[Dict] = []
+        self._events_lock = threading.Lock()
+        self._engines: Dict[str, object] = {}
+        self._sentinels: Dict[str, EnsembleSentinel] = {}
+        self._preempt = False
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # client API
+    # ------------------------------------------------------------------
+    def submit(self, req: CampaignRequest):
+        """Queue a campaign; returns its :class:`~.queue.
+        CampaignHandle`. If the campaign's tenant namespace already
+        holds checkpoints (a preempted earlier run), it resumes from
+        the newest restorable step."""
+        handle = self.queue.submit(req)
+        self._log("submitted", tenant=req.tenant, campaign=req.campaign,
+                  fingerprint=handle.fingerprint)
+        return handle
+
+    def drain(self) -> None:
+        """Synchronously serve batches until the queue is empty (the
+        test/CLI entry; :meth:`start` is the async one)."""
+        while len(self.queue) and not self._stop:
+            batch = self.queue.pop_batch(self.width)
+            if not batch:
+                break
+            self._run_batch(batch)
+
+    def start(self) -> None:
+        """Serve from a background worker thread until :meth:`stop`."""
+        if self._thread is not None:
+            raise RuntimeError("service already started")
+        self._stop = False
+
+        def worker():
+            while not self._stop:
+                if not self.queue.wait_nonempty(timeout=0.05):
+                    continue
+                batch = self.queue.pop_batch(self.width)
+                if batch:
+                    self._run_batch(batch)
+
+        self._thread = threading.Thread(target=worker,
+                                        name="campaign-service",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def preempt(self) -> None:
+        """Fleet reclaim: the current batch checkpoints every active
+        campaign (tagged ``preempted``) at the next segment boundary
+        and the worker stops; resubmitting the campaigns resumes them
+        from those checkpoints."""
+        self._preempt = True
+        self._stop = True
+
+    def namespace(self, tenant: str, campaign: str) -> Path:
+        """``root/<tenant>/<campaign>`` — both components validated
+        against path traversal before they touch the filesystem."""
+        t = validate_checkpoint_component(tenant, kind="tenant id")
+        c = validate_checkpoint_component(campaign, kind="campaign id")
+        return self.root / t / c
+
+    def write_events(self, path: str) -> None:
+        with self._events_lock:
+            payload = {"stats": self.stats.to_record(),
+                       "events": list(self.events)}
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _log(self, kind: str, **kw) -> None:
+        with self._events_lock:
+            self.events.append({"event": kind, "time": time.time(),
+                                **kw})
+
+    def _plan_for(self, fingerprint: str, req: CampaignRequest):
+        """The exchange plan for a fingerprint: cache hit (zero
+        measurements) or a one-time tune when a timer is configured
+        (depth pinned to 1 — see module docstring)."""
+        from ..tuning import load_plan
+        plan = load_plan(fingerprint, self._plan_cache_path)
+        if plan is not None:
+            plan.provenance = "cached"
+            plan.measurements = 0
+            self.stats.plan_cache_hits += 1
+            return plan
+        if self._tuner_timer is None:
+            return None
+        import jax.numpy as jnp
+
+        from ..topology import Boundary
+        from ..tuning import autotune_domain
+        from .ensemble import configured_domain
+        dd = configured_domain(req.model, req.grid,
+                               dtype=jnp.dtype(req.dtype),
+                               boundary=Boundary[req.boundary],
+                               mesh_shape=req.mesh_shape,
+                               devices=self._devices)
+        plan = autotune_domain(dd, timer=self._tuner_timer,
+                               cache_path=self._plan_cache_path,
+                               depths=(1,))
+        assert plan.fingerprint == fingerprint, \
+            (plan.fingerprint, fingerprint)
+        self.stats.tuner_measurements += plan.measurements
+        return plan
+
+    def _engine_for(self, fingerprint: str, req: CampaignRequest):
+        """The compiled ensemble engine for a fingerprint — built once,
+        reused for every later fingerprint-identical batch."""
+        eng = self._engines.get(fingerprint)
+        if eng is not None:
+            return eng, False, None
+        import jax.numpy as jnp
+
+        from ..topology import Boundary
+        plan = self._plan_for(fingerprint, req)
+        cls = EnsembleJacobi if req.model == "jacobi" else EnsembleAstaroth
+        eng = cls(self.width, *req.grid, dtype=jnp.dtype(req.dtype),
+                  boundary=Boundary[req.boundary],
+                  mesh_shape=req.mesh_shape, devices=self._devices,
+                  plan=plan)
+        assert eng.fingerprint == fingerprint, \
+            (eng.fingerprint, fingerprint)
+        self._engines[fingerprint] = eng
+        self._sentinels[fingerprint] = EnsembleSentinel(
+            eng, window=self._window,
+            growth_factor=self._growth_factor)
+        self.stats.compiles += 1
+        return eng, True, plan
+
+    def _admit_lane(self, eng, lane: _Lane) -> None:
+        """Set up one lane: parameters, resume-or-init, and the step-0
+        rollback anchor checkpoint."""
+        req = lane.request
+        k = lane.index
+        eng.reset_member(k)
+        if req.params:
+            eng.set_member_params(k, req.params)
+        if all_steps(lane.ckpt_dir):
+            step = eng.restore_member(lane.ckpt_dir, k)
+            lane.counter = step
+            lane.resumed_from = step
+            self._log("resumed", tenant=req.tenant,
+                      campaign=req.campaign, step=step)
+            LOG_INFO(f"campaign {req.tenant}/{req.campaign} resumes "
+                     f"from step {step}")
+        else:
+            eng.init_member(k, req.init_seed)
+            eng.save_member(lane.ckpt_dir, 0, k,
+                            max_to_keep=self._max_to_keep)
+            self._log("checkpoint", tenant=req.tenant,
+                      campaign=req.campaign, step=0)
+
+    @staticmethod
+    def _steps_to_boundary(lane: _Lane) -> int:
+        """Member steps until lane's next event: completion, probe,
+        checkpoint, snapshot, or chaos injection."""
+        req = lane.request
+        c = lane.counter
+        cands = [req.n_steps - c]
+        for cad in (req.check_every, req.ckpt_every,
+                    req.snapshot_every):
+            if cad and cad > 0:
+                cands.append(cad - (c % cad))
+        if req.chaos_nan_step is not None and not lane.chaos_fired \
+                and req.chaos_nan_step > c:
+            cands.append(req.chaos_nan_step - c)
+        return max(1, min(x for x in cands if x > 0))
+
+    def _inject_nan(self, eng, lane: _Lane) -> None:
+        q = eng.names[0]
+        host = eng.member_interior(q, lane.index)
+        host[tuple(0 for _ in host.shape)] = np.nan
+        eng.set_member_interior(q, lane.index, host)
+        lane.chaos_fired = True
+        self._log("fault_injected", tenant=lane.request.tenant,
+                  campaign=lane.request.campaign, step=lane.counter,
+                  quantity=q)
+
+    def _handle_trip(self, eng, sentinel, lane: _Lane,
+                     reason: str) -> None:
+        req = lane.request
+        self._log("sentinel_tripped", tenant=req.tenant,
+                  campaign=req.campaign, member=lane.index,
+                  step=lane.counter, reason=reason,
+                  attempt=lane.rollbacks + 1)
+        LOG_WARN(f"campaign {req.tenant}/{req.campaign}: sentinel "
+                 f"tripped at member step {lane.counter} ({reason}), "
+                 f"attempt {lane.rollbacks + 1}/{req.max_retries}")
+        sentinel.reset_member(lane.index)
+        # rollback counters count RESTORES performed, not trips — a
+        # campaign that fails on its first trip reports zero rollbacks
+        if lane.rollbacks >= req.max_retries:
+            lane.active = False
+            eng.reset_member(lane.index)
+            self.stats.failed += 1
+            self._log("campaign_failed", tenant=req.tenant,
+                      campaign=req.campaign, reason=reason)
+            lane.entry.handle._fail(CampaignFailed(
+                f"{req.tenant}/{req.campaign}: retries exhausted "
+                f"({req.max_retries}) at step {lane.counter}: "
+                f"{reason}"))
+            return
+        step = eng.restore_member(lane.ckpt_dir, lane.index)
+        lane.counter = step
+        lane.rollbacks += 1
+        self.stats.rollbacks += 1
+        self._log("rollback", tenant=req.tenant, campaign=req.campaign,
+                  member=lane.index, restored_step=step)
+
+    def _complete_lane(self, eng, lane: _Lane,
+                       preempted: bool = False) -> None:
+        req = lane.request
+        final = eng.member_interiors(lane.index)
+        result = CampaignResult(
+            tenant=req.tenant, campaign=req.campaign,
+            steps=lane.counter, rollbacks=lane.rollbacks,
+            resumed_from=lane.resumed_from, preempted=preempted,
+            snapshots=sorted(lane.snapshots.items()), final=final)
+        lane.active = False
+        if preempted:
+            self._log("campaign_preempted", tenant=req.tenant,
+                      campaign=req.campaign, step=lane.counter)
+        else:
+            self.stats.completed += 1
+            self._log("campaign_completed", tenant=req.tenant,
+                      campaign=req.campaign, steps=lane.counter,
+                      rollbacks=lane.rollbacks)
+        lane.entry.handle._resolve(result)
+
+    def _run_batch(self, batch) -> None:
+        fp = batch[0].fingerprint
+        req0 = batch[0].request
+        eng, compiled, plan = self._engine_for(fp, req0)
+        sentinel = self._sentinels[fp]
+        sentinel.reset()
+        self.stats.batches += 1
+        self._log(
+            "batch_started", fingerprint=fp, members=len(batch),
+            width=eng.n_members, compiled=compiled,
+            plan_provenance=(eng.dd.plan_provenance),
+            measurements=(plan.measurements if plan is not None
+                          and plan.provenance == "tuned" else 0),
+            tenants=[e.request.tenant for e in batch])
+        lanes = [
+            _Lane(entry=e, index=k,
+                  ckpt_dir=str(self.namespace(e.request.tenant,
+                                              e.request.campaign)))
+            for k, e in enumerate(batch)]
+        for lane in lanes:
+            try:
+                self._admit_lane(eng, lane)
+            except Exception as err:  # noqa: BLE001 - admission faults
+                lane.active = False
+                self.stats.failed += 1
+                self._log("campaign_failed",
+                          tenant=lane.request.tenant,
+                          campaign=lane.request.campaign,
+                          reason=f"admission: {err}")
+                lane.entry.handle._fail(err)
+        # idle lanes of a partially-filled batch stay benign
+        for k in range(len(batch), eng.n_members):
+            eng.reset_member(k)
+        # a resubmitted campaign whose restored checkpoint already
+        # meets the requested budget completes immediately — it must
+        # not run past n_steps
+        for lane in lanes:
+            if lane.active and lane.counter >= lane.request.n_steps:
+                self._complete_lane(eng, lane)
+
+        pending_snaps: List = []
+
+        def poll_snapshots(block: bool = False) -> None:
+            remaining = []
+            for lane, snap in pending_snaps:
+                if block or snap.ready():
+                    if lane.active and snap.step <= \
+                            lane.request.n_steps:
+                        lane.snapshots[snap.step] = snap.get()
+                else:
+                    remaining.append((lane, snap))
+            pending_snaps[:] = remaining
+
+        while any(lane.active for lane in lanes):
+            if self._preempt:
+                # drain in-flight probes; never persist poisoned state
+                for health in sentinel.poll(block=True):
+                    for k in health.tripped_members:
+                        lane = next((ln for ln in lanes
+                                     if ln.index == k and ln.active),
+                                    None)
+                        if lane is not None:
+                            self._handle_trip(
+                                eng, sentinel, lane,
+                                health.members[k].reason)
+                # harvest in-flight snapshots BEFORE materializing the
+                # preempted results — completion deactivates the lane
+                # and would silently drop them
+                poll_snapshots(block=True)
+                for lane in lanes:
+                    if lane.active:
+                        eng.save_member(lane.ckpt_dir, lane.counter,
+                                        lane.index,
+                                        meta_extra={"preempted": True},
+                                        max_to_keep=self._max_to_keep)
+                        self._log("checkpoint",
+                                  tenant=lane.request.tenant,
+                                  campaign=lane.request.campaign,
+                                  step=lane.counter, preempted=True)
+                        self._complete_lane(eng, lane, preempted=True)
+                self._log("preempted", fingerprint=fp)
+                return
+            seg = min(self._steps_to_boundary(lane)
+                      for lane in lanes if lane.active)
+            eng.run(seg)
+            for lane in lanes:
+                if lane.active:
+                    lane.counter += seg
+            # chaos injections land AFTER the step that reaches them
+            for lane in lanes:
+                req = lane.request
+                if (lane.active and req.chaos_nan_step is not None
+                        and not lane.chaos_fired
+                        and lane.counter >= req.chaos_nan_step):
+                    self._inject_nan(eng, lane)
+            sentinel.probe(max(lane.counter for lane in lanes))
+            poll_snapshots()
+            # blocking drain BEFORE any checkpoint/completion below —
+            # the same invariant as the resilience driver: poisoned
+            # state is never persisted or handed back
+            tripped: Dict[int, str] = {}
+            for health in sentinel.poll(block=True):
+                for k in health.tripped_members:
+                    tripped.setdefault(k, health.members[k].reason)
+            for lane in list(lanes):
+                if not lane.active:
+                    continue
+                req = lane.request
+                if lane.index in tripped:
+                    self._handle_trip(eng, sentinel, lane,
+                                      tripped[lane.index])
+                    continue
+                if (req.snapshot_every and lane.counter
+                        and lane.counter % req.snapshot_every == 0
+                        and lane.counter < req.n_steps):
+                    pending_snaps.append(
+                        (lane, eng.member_snapshot_async(
+                            lane.index, lane.counter)))
+                    self._log("snapshot_enqueued", tenant=req.tenant,
+                              campaign=req.campaign, step=lane.counter)
+                if (req.ckpt_every and lane.counter
+                        and lane.counter % req.ckpt_every == 0
+                        and lane.counter < req.n_steps):
+                    eng.save_member(lane.ckpt_dir, lane.counter,
+                                    lane.index,
+                                    max_to_keep=self._max_to_keep)
+                    self._log("checkpoint", tenant=req.tenant,
+                              campaign=req.campaign, step=lane.counter)
+                if lane.counter >= req.n_steps:
+                    eng.save_member(lane.ckpt_dir, lane.counter,
+                                    lane.index,
+                                    meta_extra={"completed": True},
+                                    max_to_keep=self._max_to_keep)
+                    poll_snapshots(block=True)
+                    self._complete_lane(eng, lane)
+        poll_snapshots(block=True)
+        self._log("batch_finished", fingerprint=fp)

@@ -14,15 +14,29 @@ Location: ``$STENCIL_TUNE_CACHE`` when set, else
 at that path (or point the env var at a read-only shipped plan) so no
 job ever pays the measurement cost — the README "Autotuning" section
 documents the recipe.
+
+Concurrency: the campaign service runs several workers against ONE
+cache file, so :func:`store_plan` is a read-merge-write under an
+exclusive ``flock`` on a ``<cache>.lock`` sidecar (two writers storing
+different fingerprints both land; last-writer-wins only on the SAME
+fingerprint). Readers stay lock-free — they see either the old or the
+new file thanks to the atomic tmp+rename publish.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import tempfile
+import threading
 from pathlib import Path
 from typing import Dict, Optional, Union
+
+try:
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX: thread lock only
+    fcntl = None
 
 from ..utils.logging import LOG_DEBUG, LOG_WARN
 from ..utils.retry import retry
@@ -96,16 +110,58 @@ def load_plan(fingerprint: str,
     return plan
 
 
+# in-process serialization, PER cache path (flock excludes other
+# PROCESSES; threads of one process sharing the lock file need this
+# too — and a flock blocked on one hung cache path must not stall
+# stores to unrelated paths)
+_PATH_LOCKS: Dict[str, threading.Lock] = {}
+_PATH_LOCKS_GUARD = threading.Lock()
+
+
+def _thread_lock_for(p: Path) -> threading.Lock:
+    key = str(p.absolute())
+    with _PATH_LOCKS_GUARD:
+        lock = _PATH_LOCKS.get(key)
+        if lock is None:
+            lock = _PATH_LOCKS[key] = threading.Lock()
+        return lock
+
+
+@contextlib.contextmanager
+def _write_lock(p: Path):
+    """Exclusive writer lock for cache file ``p``: a ``flock`` on the
+    ``<p>.lock`` sidecar (never the data file itself — the atomic
+    rename publish replaces that inode) plus an in-process per-path
+    mutex. On platforms without ``fcntl`` only the mutex applies."""
+    with _thread_lock_for(p):
+        if fcntl is None:  # pragma: no cover - non-POSIX
+            yield
+            return
+        lock_path = p.with_name(p.name + ".lock")
+        fd = os.open(lock_path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            finally:
+                os.close(fd)
+
+
 def store_plan(plan: Plan, path: Union[str, Path, None] = None) -> Path:
-    """Insert/replace ``plan`` under its fingerprint (atomic tmp+rename
-    write; concurrent writers last-win whole-file, never interleave)."""
+    """Insert/replace ``plan`` under its fingerprint. The whole
+    read-merge-write runs under :func:`_write_lock`, so two concurrent
+    service workers storing DIFFERENT fingerprints cannot drop each
+    other's records; the publish itself stays an atomic tmp+rename so
+    lock-free readers never observe a torn file."""
     p = _resolve(path)
-    plans = load_cache(p)
-    plans[plan.fingerprint] = plan.to_record()
-    payload = {"schema": SCHEMA_VERSION, "plans": plans}
     p.parent.mkdir(parents=True, exist_ok=True)
 
-    def write_once():
+    def merge_and_publish():
+        plans = load_cache(p)
+        plans[plan.fingerprint] = plan.to_record()
+        payload = {"schema": SCHEMA_VERSION, "plans": plans}
         fd, tmp = tempfile.mkstemp(dir=str(p.parent),
                                    prefix=p.name, suffix=".tmp")
         try:
@@ -118,6 +174,10 @@ def store_plan(plan: Plan, path: Union[str, Path, None] = None) -> Path:
             except OSError:
                 pass
             raise
+
+    def write_once():
+        with _write_lock(p):
+            merge_and_publish()
 
     retry(write_once, attempts=_RETRY_ATTEMPTS,
           base_delay=_RETRY_BASE_DELAY, sleep=_RETRY_SLEEP)

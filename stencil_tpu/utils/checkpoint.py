@@ -46,6 +46,32 @@ class CorruptCheckpointError(RuntimeError):
 
 
 # ----------------------------------------------------------------------
+# namespace-component hygiene (multi-tenant checkpoint trees)
+# ----------------------------------------------------------------------
+def validate_checkpoint_component(component: str,
+                                  kind: str = "component") -> str:
+    """Validate a string that will become ONE directory component of a
+    checkpoint namespace (a tenant or campaign id in the serving
+    layer). Tenant ids come from untrusted requests; an id like
+    ``../other-tenant`` must never escape its namespace. Rejects empty
+    strings, path separators (``/`` and ``\\``), the traversal names
+    ``.`` / ``..``, NUL, and other control characters. Returns the
+    component unchanged when valid; raises ``ValueError`` otherwise."""
+    if not isinstance(component, str) or not component:
+        raise ValueError(f"{kind} must be a non-empty string, "
+                         f"got {component!r}")
+    if component in (".", ".."):
+        raise ValueError(f"{kind} {component!r} is a path traversal "
+                         f"name")
+    bad = [ch for ch in component
+           if ch in ("/", "\\", "\x00") or ord(ch) < 0x20]
+    if bad:
+        raise ValueError(f"{kind} {component!r} contains path "
+                         f"separators or control characters {bad!r}")
+    return component
+
+
+# ----------------------------------------------------------------------
 # manager cache: one CheckpointManager per directory
 # ----------------------------------------------------------------------
 # directory (absolute) -> (manager, max_to_keep it was built with)

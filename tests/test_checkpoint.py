@@ -118,3 +118,24 @@ def test_checkpoint_bf16_cross_mesh_roundtrip(tmp_path):
     b.step()
     got = np.asarray(b.temperature(), np.float32)
     np.testing.assert_array_equal(got, want)
+
+
+def test_validate_checkpoint_component():
+    """Tenant/campaign ids become checkpoint directory components — an
+    id like ``../other-tenant`` must be rejected before it touches the
+    filesystem (multi-tenant serving, stencil_tpu/serving)."""
+    from stencil_tpu.utils.checkpoint import validate_checkpoint_component
+
+    for ok in ("tenant0", "a-b_c.d", "run..01", "UPPER", "0"):
+        assert validate_checkpoint_component(ok) == ok
+    for bad in ("", ".", "..", "a/b", "/abs", "a\\b", "..\\up",
+                "x\x00y", "a\nb", "tab\tid", None, 7):
+        with pytest.raises(ValueError):
+            validate_checkpoint_component(bad)
+
+
+def test_validate_checkpoint_component_names_the_kind():
+    from stencil_tpu.utils.checkpoint import validate_checkpoint_component
+
+    with pytest.raises(ValueError, match="tenant id"):
+        validate_checkpoint_component("../up", kind="tenant id")

@@ -1,0 +1,293 @@
+"""Ensemble numerics: N batched members == N independent runs.
+
+The serving contract (stencil_tpu/serving/ensemble.py): the vmapped
+member axis changes THROUGHPUT, never results — every member of a
+batched dispatch must match the standalone solver bitwise (Jacobi) or
+at pinned tolerance (Astaroth), including when another member is
+faulted mid-run.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from stencil_tpu.models.astaroth import FIELDS, Astaroth, \
+    _radial_explosion
+from stencil_tpu.models.jacobi import Jacobi3D
+from stencil_tpu.serving.ensemble import (EnsembleAstaroth,
+                                          EnsembleJacobi,
+                                          EnsembleSentinel)
+
+MESH = (2, 2, 2)
+GRID = (8, 8, 8)
+
+
+def _jacobi_ics(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [0.5 + 0.01 * rng.standard_normal(GRID[::-1])
+            .astype(np.float32) for _ in range(n)]
+
+
+def _poison(eng, k):
+    host = eng.member_interior(eng.names[0], k)
+    host[0, 0, 0] = np.nan
+    eng.set_member_interior(eng.names[0], k, host)
+
+
+# ---------------------------------------------------------------------------
+# Jacobi: bitwise
+
+
+def test_ensemble_jacobi_bitwise_vs_independent_runs():
+    """An N=8 batched dispatch (one compiled executable) is bitwise-
+    equal, member by member, to 8 independent Jacobi3D runs with the
+    same distinct initial conditions."""
+    n = 8
+    ics = _jacobi_ics(n)
+    eng = EnsembleJacobi(n, *GRID, mesh_shape=MESH)
+    eng.init()
+    for k in range(n):
+        eng.set_member_interior("temp", k, ics[k])
+    eng.run(4)
+
+    ref = Jacobi3D(*GRID, mesh_shape=MESH, kernel="xla")
+    for k in range(n):
+        ref.init()
+        ref.dd.set_interior("temp", ics[k])
+        ref.run(4)
+        np.testing.assert_array_equal(
+            ref.temperature(), eng.member_interior("temp", k),
+            err_msg=f"member {k}")
+
+
+def test_ensemble_jacobi_per_member_params():
+    """Per-member hot/cold Dirichlet temperatures: each member of a
+    mixed batch is bitwise-equal to a single-member ensemble run with
+    that member's parameters (one executable, many parameter points)."""
+    n = 4
+    temps = [(1.0, 0.0), (2.0, -1.0), (0.75, 0.25), (1.5, 0.5)]
+    eng = EnsembleJacobi(n, *GRID, mesh_shape=MESH)
+    for k, (hot, cold) in enumerate(temps):
+        eng.set_member_params(k, {"hot_temp": hot, "cold_temp": cold})
+    eng.init()
+    eng.run(3)
+    for k, (hot, cold) in enumerate(temps):
+        solo = EnsembleJacobi(1, *GRID, mesh_shape=MESH)
+        solo.set_member_params(0, {"hot_temp": hot, "cold_temp": cold})
+        solo.init()
+        solo.run(3)
+        np.testing.assert_array_equal(
+            solo.member_interior("temp", 0),
+            eng.member_interior("temp", k), err_msg=f"member {k}")
+
+
+def test_ensemble_jacobi_fault_isolated():
+    """A NaN injected into one member mid-run corrupts ONLY that lane:
+    every other member stays bitwise-equal to the fault-free batch, and
+    the per-member sentinel trips only the faulted member."""
+    n = 8
+    ics = _jacobi_ics(n, seed=3)
+
+    def build():
+        eng = EnsembleJacobi(n, *GRID, mesh_shape=MESH)
+        eng.init()
+        for k in range(n):
+            eng.set_member_interior("temp", k, ics[k])
+        return eng
+
+    faulted, clean = build(), build()
+    faulted.run(2)
+    clean.run(2)
+    _poison(faulted, 5)
+    faulted.run(2)
+    clean.run(2)
+
+    sentinel = EnsembleSentinel(faulted)
+    sentinel.probe(4)
+    health = sentinel.poll(block=True)[0]
+    assert health.tripped_members == [5]
+    assert "member 5" in health.members[5].reason
+
+    assert np.isnan(faulted.member_interior("temp", 5)).any()
+    for k in range(n):
+        if k == 5:
+            continue
+        np.testing.assert_array_equal(
+            clean.member_interior("temp", k),
+            faulted.member_interior("temp", k), err_msg=f"member {k}")
+
+
+def test_ensemble_sentinel_reset_member():
+    eng = EnsembleJacobi(2, *GRID, mesh_shape=MESH)
+    eng.init()
+    _poison(eng, 1)
+    s = EnsembleSentinel(eng)
+    s.probe(0)
+    assert s.poll(block=True)[0].tripped_members == [1]
+    eng.reset_member(1)
+    s.reset_member(1)
+    s.probe(1)
+    assert s.poll(block=True)[0].tripped_members == []
+
+
+# ---------------------------------------------------------------------------
+# Jacobi: per-member checkpoints
+
+
+def test_member_checkpoint_roundtrip(tmp_path):
+    eng = EnsembleJacobi(3, *GRID, mesh_shape=MESH)
+    eng.init()
+    for k, ic in enumerate(_jacobi_ics(3, seed=7)):
+        eng.set_member_interior("temp", k, ic)
+    eng.run(2)
+    want = eng.member_interior("temp", 1)
+    eng.save_member(str(tmp_path), 2, 1)
+
+    eng.run(3)  # diverge
+    assert not np.array_equal(want, eng.member_interior("temp", 1))
+    other = eng.member_interior("temp", 2)
+    step = eng.restore_member(str(tmp_path), 1)
+    assert step == 2
+    np.testing.assert_array_equal(want, eng.member_interior("temp", 1))
+    # restoring member 1 never touches member 2's lane
+    np.testing.assert_array_equal(other, eng.member_interior("temp", 2))
+
+
+def test_member_checkpoint_corrupt_falls_back(tmp_path):
+    import glob
+    import os
+
+    eng = EnsembleJacobi(2, *GRID, mesh_shape=MESH)
+    eng.init()
+    eng.run(1)
+    eng.save_member(str(tmp_path), 1, 0)
+    want = eng.member_interior("temp", 0)
+    eng.run(1)
+    eng.save_member(str(tmp_path), 2, 0)
+    # truncate the newest step's array blobs on disk
+    for f in glob.glob(str(tmp_path / "2" / "state" / "**"),
+                       recursive=True):
+        if os.path.isfile(f) and os.path.getsize(f) > 8:
+            with open(f, "r+b") as fh:
+                fh.truncate(4)
+    from stencil_tpu.utils.checkpoint import close_checkpoints
+    close_checkpoints(str(tmp_path))
+    step = eng.restore_member(str(tmp_path), 0)
+    assert step == 1
+    np.testing.assert_array_equal(want, eng.member_interior("temp", 0))
+
+
+# ---------------------------------------------------------------------------
+# Astaroth: pinned tolerance, including per-member physics
+
+
+ASTAROTH_RTOL = 1e-12
+ASTAROTH_ATOL = 1e-15
+
+
+def _astaroth_ref(seed, iters, overrides=None):
+    ref = Astaroth(*GRID, mesh_shape=MESH, kernel="xla",
+                   dtype=np.float64)
+    if overrides:
+        ref.prm = dataclasses.replace(ref.prm, **overrides)
+        ref._build_step()
+    rng = np.random.default_rng(seed)
+    for q in ("ax", "ay", "az", "ss"):
+        ref.dd.set_interior(q, rng.uniform(-1.0, 1.0, size=GRID[::-1]))
+    ref.dd.set_interior("lnrho", np.full(GRID[::-1], 0.5))
+    ux, uy, uz = _radial_explosion(ref.dd.size, ref.prm)
+    ref.dd.set_interior("uux", ux)
+    ref.dd.set_interior("uuy", uy)
+    ref.dd.set_interior("uuz", uz)
+    ref.run(iters)
+    return ref
+
+
+def test_ensemble_astaroth_matches_independent_runs():
+    """A batched MHD dispatch with distinct initial conditions AND one
+    member running different physics (viscosity/resistivity) matches
+    the standalone solver at pinned float64 tolerance."""
+    n = 4
+    overrides = {"nu_visc": 7e-3, "eta": 6e-3}
+    eng = EnsembleAstaroth(n, *GRID, mesh_shape=MESH, dtype=np.float64)
+    eng.init(seeds=[20, 21, 22, 23])
+    eng.set_member_params(2, overrides)
+    eng.run(2)
+    for k in (0, 2):
+        ref = _astaroth_ref(20 + k, 2,
+                            overrides if k == 2 else None)
+        for q in FIELDS:
+            np.testing.assert_allclose(
+                ref.field(q), eng.member_interior(q, k),
+                rtol=ASTAROTH_RTOL, atol=ASTAROTH_ATOL,
+                err_msg=f"member {k} field {q}")
+
+
+def test_ensemble_astaroth_fault_isolated():
+    n = 3
+    eng = EnsembleAstaroth(n, *GRID, mesh_shape=MESH, dtype=np.float64)
+    eng.init(seeds=[30, 31, 32])
+    eng.run(1)
+    _poison(eng, 0)
+    eng.run(1)
+    sentinel = EnsembleSentinel(eng)
+    sentinel.probe(2)
+    health = sentinel.poll(block=True)[0]
+    assert health.tripped_members == [0]
+    # untouched members still match the standalone solver
+    ref = _astaroth_ref(31, 2)
+    for q in FIELDS:
+        np.testing.assert_allclose(
+            ref.field(q), eng.member_interior(q, 1),
+            rtol=ASTAROTH_RTOL, atol=ASTAROTH_ATOL, err_msg=q)
+
+
+def test_member_checkpoint_restores_rk_accumulator(tmp_path):
+    """An Astaroth lane rollback must restore the RK accumulator with
+    the fields — resuming with a zeroed w would silently change the
+    trajectory."""
+    eng = EnsembleAstaroth(2, *GRID, mesh_shape=MESH, dtype=np.float64)
+    eng.init(seeds=[40, 41])
+    eng.run(1)
+    eng.save_member(str(tmp_path), 1, 0)
+    want = {q: eng.member_interior(q, 0) for q in FIELDS}
+    eng.run(2)
+    eng.restore_member(str(tmp_path), 0)
+    for q in FIELDS:
+        np.testing.assert_array_equal(want[q],
+                                      eng.member_interior(q, 0))
+    eng.run(1)
+    # the restored trajectory continues exactly like an uninterrupted
+    # one: fields AND accumulator must have come back
+    ref = _astaroth_ref(40, 2)
+    for q in FIELDS:
+        np.testing.assert_allclose(
+            ref.field(q), eng.member_interior(q, 0),
+            rtol=ASTAROTH_RTOL, atol=ASTAROTH_ATOL, err_msg=q)
+
+
+# ---------------------------------------------------------------------------
+# engine hygiene
+
+
+def test_ensemble_rejects_bad_member_count():
+    with pytest.raises(ValueError):
+        EnsembleJacobi(0, *GRID, mesh_shape=MESH)
+
+
+def test_unknown_param_rejected():
+    eng = EnsembleJacobi(2, *GRID, mesh_shape=MESH)
+    with pytest.raises(KeyError):
+        eng.set_member_params(0, {"viscosity": 1.0})
+
+
+def test_snapshot_async_roundtrip():
+    eng = EnsembleJacobi(2, *GRID, mesh_shape=MESH)
+    eng.init()
+    eng.run(1)
+    snap = eng.member_snapshot_async(1, step=1)
+    data = snap.get()  # blocks if needed
+    assert snap.ready()
+    np.testing.assert_array_equal(data["temp"],
+                                  eng.member_interior("temp", 1))

@@ -41,7 +41,8 @@ def test_shipped_registry_is_clean():
 def test_checker_filter():
     report = run_targets(default_targets(), checkers=["collectives"])
     assert report.ok
-    assert all(t.startswith(("parallel.exchange", "parallel.temporal"))
+    assert all(t.startswith(("parallel.exchange", "parallel.temporal",
+                             "serving.ensemble"))
                for t in report.targets_checked)
     with pytest.raises(ValueError):
         run_targets([], checkers=["nope"])
@@ -85,10 +86,12 @@ def test_hlo_registry_collective_permute_only():
     for key, kinds in kinds_by_target.items():
         if "allgather" in key.lower():
             assert kinds == {"all_gather"}, (key, kinds)
-        elif "resilience.health" in key:
-            # the health sentinel's contract is different by design:
+        elif ("resilience.health" in key
+              or "serving.ensemble.probe" in key):
+            # the health sentinels' contract is different by design:
             # exactly ONE small all-reduce (pinned via exact_counts on
-            # its HloSpec and by tests/test_resilience.py)
+            # their HloSpecs; the ensemble probe batches per-member
+            # stats through the same single reduce)
             assert kinds <= {"collective_permute", "all_reduce"}, \
                 (key, kinds)
         else:

@@ -443,3 +443,57 @@ def test_pick_method_rejects_bare_auto():
         pick_method(Method.Auto)
     with pytest.raises(ValueError):
         pick_method(Method.NONE)
+
+
+def test_cache_concurrent_writers_drop_no_records(tmp_path):
+    """Two service workers storing plans for DIFFERENT fingerprints
+    concurrently must both land: store_plan is a read-merge-write
+    under the cache's writer lock, not a blind whole-file overwrite."""
+    import threading
+
+    from stencil_tpu.tuning.cache import load_cache, store_plan
+    from stencil_tpu.tuning.plan import Candidate, Plan
+
+    path = tmp_path / "plans.json"
+    n = 16
+
+    def mkplan(i):
+        return Plan(config=Candidate("PpermuteSlab", 1, False),
+                    fingerprint=f"{i:02d}" * 16, coefficients={},
+                    costs={}, provenance="tuned", measurements=1)
+
+    start = threading.Barrier(n)
+    errors = []
+
+    def worker(i):
+        try:
+            start.wait()
+            store_plan(mkplan(i), path)
+        except BaseException as e:  # noqa: BLE001 - surface in main
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    plans = load_cache(path)
+    assert sorted(plans) == sorted(f"{i:02d}" * 16 for i in range(n))
+
+
+def test_cache_lock_released_after_store(tmp_path):
+    """The writer lock is released even when the publish raises — a
+    poisoned lock would deadlock every later tune."""
+    from stencil_tpu.tuning import cache as cache_mod
+    from stencil_tpu.tuning.plan import Candidate, Plan
+
+    plan = Plan(config=Candidate("PpermuteSlab", 1, False),
+                fingerprint="a" * 32, coefficients={}, costs={},
+                provenance="tuned", measurements=1)
+    path = tmp_path / "nested" / "plans.json"
+    cache_mod.store_plan(plan, path)
+    # immediately storable again (no held flock / thread mutex)
+    cache_mod.store_plan(plan, path)
+    assert cache_mod.load_plan("a" * 32, path) is not None
