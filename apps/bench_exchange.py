@@ -53,6 +53,13 @@ def main() -> None:
     ap.add_argument("--json-out", default="", metavar="PATH",
                     help="write the steps/s + byte-model comparison "
                          "as a JSON artifact")
+    ap.add_argument("--metrics-json", default="", metavar="PATH",
+                    help="also record the measured numbers as a "
+                         "telemetry metrics snapshot (gauges "
+                         "stencil_bench_steps_per_s{exchange_every=}, "
+                         "stencil_bench_bytes_per_step_model{...}) so "
+                         "BENCH_*.json and the metrics surface agree "
+                         "on one figure")
     ap.add_argument("--autotune", action="store_true",
                     help="run the exchange autotuner (measured plan, "
                          "stencil_tpu/tuning) and compare tuned vs "
@@ -218,6 +225,37 @@ def main() -> None:
         with open(args.json_out, "w") as f:
             json.dump(comparison, f, indent=2)
         print(f"bench_exchange: wrote {args.json_out}", file=sys.stderr)
+
+    if args.metrics_json:
+        # one number, two artifacts: the SAME steps/s measured above
+        # lands in a telemetry metrics snapshot, so dashboards scraped
+        # from the metrics surface and the committed BENCH_*.json can
+        # never disagree
+        from stencil_tpu.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        g_sps = reg.gauge("stencil_bench_steps_per_s",
+                          "measured steps/s of the blocked Jacobi "
+                          "loop, by temporal depth")
+        g_bps = reg.gauge("stencil_bench_bytes_per_step_model",
+                          "amortized exchange B/step (analytic model, "
+                          "HLO-cross-checked)")
+        for r in results:
+            s_label = str(r["exchange_every"])
+            g_sps.set(r["steps_per_s"], exchange_every=s_label)
+            g_bps.set(r["amortized_bytes_per_step_model"],
+                      exchange_every=s_label)
+        if autotune_cmp is not None:
+            g_tuned = reg.gauge("stencil_bench_tuned_steps_per_s",
+                                "steps/s of the measured tuned plan "
+                                "vs Method.Default")
+            g_tuned.set(autotune_cmp["tuned_steps_per_s"],
+                        config="tuned")
+            g_tuned.set(autotune_cmp["default_steps_per_s"],
+                        config="default")
+        reg.write_snapshot(args.metrics_json)
+        print(f"bench_exchange: metrics snapshot -> "
+              f"{args.metrics_json}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -43,11 +43,32 @@ def main() -> int:
     p.add_argument("--chaos-nan", type=int, default=0, metavar="STEP",
                    help="poison tenant 0's campaign at this member "
                         "step (proves member-isolated rollback)")
+    p.add_argument("--max-retries", type=int, default=None,
+                   metavar="N",
+                   help="per-campaign rollback budget before it fails "
+                        "(default: the service default; 0 + "
+                        "--chaos-nan drives the failure path, which "
+                        "still exports every telemetry artifact)")
     p.add_argument("--root", default="",
                    help="checkpoint namespace root (default: tmpdir)")
     p.add_argument("--keep-root", action="store_true")
     p.add_argument("--events-json", default="",
                    help="write the service event log + stats here")
+    p.add_argument("--metrics-port", type=int, default=-1,
+                   metavar="PORT",
+                   help="serve Prometheus /metrics (and /metrics.json)"
+                        " on this port while running (0 = ephemeral; "
+                        "default: disabled)")
+    p.add_argument("--metrics-host", default="127.0.0.1",
+                   metavar="HOST",
+                   help="bind address for --metrics-port (default "
+                        "loopback; 0.0.0.0 for a remote scraper)")
+    p.add_argument("--metrics-json", default="", metavar="PATH",
+                   help="write the final metrics snapshot JSON here "
+                        "(the CI telemetry artifact)")
+    p.add_argument("--trace-json", default="", metavar="PATH",
+                   help="write the Chrome trace-event JSON of the "
+                        "service spans here (load in Perfetto)")
     p.add_argument("--fake-timer", action="store_true",
                    help="tune exchange plans with the deterministic "
                         "FakeTimer (CI: no hardware dependence)")
@@ -66,50 +87,91 @@ def main() -> int:
         tuner_timer=FakeTimer() if args.fake_timer else None,
         plan_cache_path=args.tune_cache or None)
 
+    metrics_server = None
+    if args.metrics_port >= 0:
+        from stencil_tpu.telemetry import MetricsServer
+
+        metrics_server = MetricsServer(svc.metrics,
+                                       port=args.metrics_port,
+                                       host=args.metrics_host)
+        port = metrics_server.start()
+        print(f"metrics: http://{args.metrics_host}:{port}/metrics",
+              file=sys.stderr)
+
     def request(tenant: str, campaign: str, seed: int,
                 chaos=None) -> CampaignRequest:
         params = ({"hot_temp": 1.0 + 0.05 * seed}
                   if args.model == "jacobi" else
                   {"nu_visc": 5e-3 * (1.0 + 0.1 * seed)})
+        kw = {} if args.max_retries is None \
+            else {"max_retries": args.max_retries}
         return CampaignRequest(
             tenant=tenant, campaign=campaign, model=args.model,
             grid=(args.x, args.y, args.z), n_steps=args.steps,
             ckpt_every=args.ckpt_every, check_every=args.check_every,
             snapshot_every=args.snapshot_every, init_seed=100 + seed,
-            params=params, chaos_nan_step=chaos)
+            params=params, chaos_nan_step=chaos, **kw)
 
-    # submit the whole first wave BEFORE the worker starts so admission
-    # packs it into one fingerprint-compatible ensemble batch
-    handles = [svc.submit(request(
-        f"tenant{i}", "wave1", i,
-        chaos=args.chaos_nan if (args.chaos_nan and i == 0) else None))
-        for i in range(args.tenants)]
-    svc.start()
-    for h in handles:
-        r = h.result(timeout=600)
-        print(f"{r.tenant}/{r.campaign}: steps={r.steps} "
-              f"rollbacks={r.rollbacks} "
-              f"snapshots={[s for s, _ in r.snapshots]}")
+    # artifacts export on the FAILURE path too — a failed campaign is
+    # exactly when the metrics/trace/event log are needed
+    try:
+        # submit the whole first wave BEFORE the worker starts so
+        # admission packs it into one fingerprint-compatible batch
+        handles = [svc.submit(request(
+            f"tenant{i}", "wave1", i,
+            chaos=args.chaos_nan if (args.chaos_nan and i == 0)
+            else None))
+            for i in range(args.tenants)]
+        svc.start()
+        for h in handles:
+            r = h.result(timeout=600)
+            print(f"{r.tenant}/{r.campaign}: steps={r.steps} "
+                  f"rollbacks={r.rollbacks} "
+                  f"snapshots={[s for s, _ in r.snapshots]}")
 
-    for j in range(args.second_wave):
-        h = svc.submit(request(f"tenant{args.tenants + j}", "wave2",
-                               args.tenants + j))
-        r = h.result(timeout=600)
-        print(f"{r.tenant}/{r.campaign}: steps={r.steps} "
-              f"rollbacks={r.rollbacks} (warm path)")
-    svc.stop()
+        for j in range(args.second_wave):
+            h = svc.submit(request(f"tenant{args.tenants + j}",
+                                   "wave2", args.tenants + j))
+            r = h.result(timeout=600)
+            print(f"{r.tenant}/{r.campaign}: steps={r.steps} "
+                  f"rollbacks={r.rollbacks} (warm path)")
 
-    s = svc.stats
-    print(f"stats: batches={s.batches} compiles={s.compiles} "
-          f"plan_cache_hits={s.plan_cache_hits} "
-          f"tuner_measurements={s.tuner_measurements} "
-          f"completed={s.completed} failed={s.failed} "
-          f"rollbacks={s.rollbacks}")
-    if args.events_json:
-        svc.write_events(args.events_json)
-        print(f"event log -> {args.events_json}", file=sys.stderr)
-    if not args.root and not args.keep_root:
-        shutil.rmtree(root, ignore_errors=True)
+        s = svc.stats
+        print(f"stats: batches={s.batches} compiles={s.compiles} "
+              f"plan_cache_hits={s.plan_cache_hits} "
+              f"tuner_measurements={s.tuner_measurements} "
+              f"completed={s.completed} failed={s.failed} "
+              f"rollbacks={s.rollbacks}")
+    finally:
+        # each step is best-effort: one unwritable artifact must not
+        # mask the CampaignFailed being raised nor skip the others
+        def attempt(what, fn) -> None:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - report, don't mask
+                print(f"warning: {what} failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+
+        attempt("service stop", svc.stop)
+        if args.events_json:
+            attempt("event log export", lambda: (
+                svc.write_events(args.events_json),
+                print(f"event log -> {args.events_json}",
+                      file=sys.stderr)))
+        if args.metrics_json:
+            attempt("metrics snapshot export", lambda: (
+                svc.metrics.write_snapshot(args.metrics_json),
+                print(f"metrics snapshot -> {args.metrics_json}",
+                      file=sys.stderr)))
+        if args.trace_json:
+            attempt("span trace export", lambda: (
+                svc.export_trace(args.trace_json),
+                print(f"span trace -> {args.trace_json}",
+                      file=sys.stderr)))
+        if metrics_server is not None:
+            attempt("metrics server stop", metrics_server.stop)
+        if not args.root and not args.keep_root:
+            shutil.rmtree(root, ignore_errors=True)
     return 0
 
 

@@ -23,7 +23,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
-echo "== 1/9 lint (stencil-lint + ruff; tier=$TIER) =="
+echo "== 1/10 lint (stencil-lint + ruff; tier=$TIER) =="
 # stencil-lint: all six static checkers — halo-radius footprint, DMA
 # discipline, ppermute sanity, HLO collective-permute-only lowering,
 # analytic-vs-HLO byte cross-check, and the Pallas VMEM/tiling audit
@@ -63,10 +63,10 @@ if [ "$TIER" = "full" ]; then
   fi
 fi
 
-echo "== 2/9 native build =="
+echo "== 2/10 native build =="
 bash ci/build.sh
 
-echo "== 3/9 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+echo "== 3/10 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
 # The full tier is dominated by interpret-mode Pallas parity tests
 # (CPU-bound, independent): fan them out with pytest-xdist when the
 # machine has cores to spare. Each worker process builds its own
@@ -82,7 +82,7 @@ else
   python -m pytest tests/ -q --maxfail=1 -m "not slow"
 fi
 
-echo "== 4/9 app smoke runs =="
+echo "== 4/10 app smoke runs =="
 # overlap app smokes execute remote DMA: possible only on a TPU or
 # with the distributed (mosaic) interpreter — probe, don't assume
 RDMA_OK=$(python -c "from stencil_tpu._compat import remote_dma_runnable
@@ -107,7 +107,7 @@ smoke() { echo "-- $*"; python "$@" > /dev/null; }
   smoke bench_qap.py --sizes 4 6
 )
 
-echo "== 5/9 bench smoke: temporal blocking + autotuned plan =="
+echo "== 5/10 bench smoke: temporal blocking + autotuned plan =="
 # communication-avoiding temporal blocking must not regress steps/s of
 # the REAL blocked hot path (Jacobi3D's fused run loop, redundant ring
 # compute included) on the fake CPU mesh; the amortized byte model
@@ -120,15 +120,25 @@ echo "== 5/9 bench smoke: temporal blocking + autotuned plan =="
 # every CI run) and archived to $CI_ARTIFACT_DIR when a trigger
 # provides one.
 BENCH_JSON="$(mktemp -t BENCH_pr4.XXXXXX.json)"
+BENCH_METRICS="$(mktemp -t BENCH_metrics.XXXXXX.json)"
 TUNE_CACHE="$(mktemp -t tune_cache.XXXXXX.json)"; rm -f "$TUNE_CACHE"
 ( cd apps
   python bench_exchange.py --x 8 --y 8 --z 8 --iters 20 --fake-cpu 8 \
         --exchange-every 1,4 --autotune --tune-cache "$TUNE_CACHE" \
-        --json-out "$BENCH_JSON" )
-BENCH_JSON="$BENCH_JSON" python - <<'EOF'
+        --json-out "$BENCH_JSON" --metrics-json "$BENCH_METRICS" )
+BENCH_JSON="$BENCH_JSON" BENCH_METRICS="$BENCH_METRICS" python - <<'EOF'
 import json
 import os
 d = json.load(open(os.environ["BENCH_JSON"]))
+# telemetry parity: the metrics snapshot records the SAME steps/s the
+# BENCH json pins — one number, two artifacts, no drift
+from stencil_tpu.telemetry import snapshot_value
+snap = json.load(open(os.environ["BENCH_METRICS"]))
+for cfg in d["configs"]:
+    s = str(cfg["exchange_every"])
+    got = snapshot_value(snap, "stencil_bench_steps_per_s",
+                         exchange_every=s)
+    assert got == cfg["steps_per_s"], (s, got, cfg["steps_per_s"])
 rounds = d["rounds_per_step_ratio"]
 speed = d["steps_per_s_ratio"]
 assert abs(rounds["4"] - 0.25) < 1e-9, rounds
@@ -148,10 +158,11 @@ EOF
 if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
   mkdir -p "$CI_ARTIFACT_DIR"
   cp "$BENCH_JSON" "$CI_ARTIFACT_DIR/BENCH_pr4.json"
+  cp "$BENCH_METRICS" "$CI_ARTIFACT_DIR/bench_metrics.json"
 fi
-rm -f "$BENCH_JSON" "$TUNE_CACHE"
+rm -f "$BENCH_JSON" "$BENCH_METRICS" "$TUNE_CACHE"
 
-echo "== 6/9 exchange autotuner (fake timer: search/fit/plan/cache) =="
+echo "== 6/10 exchange autotuner (fake timer: search/fit/plan/cache) =="
 # the tuner's whole pipeline with deterministic fake measurements (no
 # hardware dependence): first invocation tunes and writes the plan
 # cache, the second MUST be a cache hit performing zero measurements.
@@ -182,7 +193,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -f "$TUNE_CACHE" "$PLAN1" "$PLAN2"
 
-echo "== 7/9 chaos smoke: resilient run loop under injected faults =="
+echo "== 7/10 chaos smoke: resilient run loop under injected faults =="
 # the Jacobi app under run_resilient (stencil_tpu/resilience) with a
 # seeded fault plan: one NaN injection (must trip the health sentinel
 # and roll back to the last good checkpoint) and one transient save
@@ -210,13 +221,15 @@ print(f"chaos smoke OK: {d['steps']} steps completed with "
       f"{d['rollbacks']} rollback(s), {d['save_retries']} save "
       f"retr(ies), final config {d['final_config']}")
 EOF
+# the resilience report speaks the unified telemetry event schema
+python -m stencil_tpu.telemetry validate-events "$CHAOS_EVENTS"
 if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
   mkdir -p "$CI_ARTIFACT_DIR"
   cp "$CHAOS_EVENTS" "$CI_ARTIFACT_DIR/chaos_events.json"
 fi
 rm -rf "$CHAOS_CKPT" "$CHAOS_EVENTS"
 
-echo "== 8/9 service smoke: concurrent multi-tenant ensemble campaigns =="
+echo "== 8/10 service smoke: concurrent multi-tenant ensemble campaigns =="
 # the campaign service (stencil_tpu/serving) on the fake CPU mesh:
 # three concurrent fake tenants share one problem fingerprint and ride
 # ONE batched ensemble dispatch stream (tenant0 gets a chaos NaN that
@@ -272,7 +285,78 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -rf "$SERVE_ROOT" "$SERVE_CACHE" "$SERVE_EVENTS1" "$SERVE_EVENTS2"
 
-echo "== 9/9 multi-chip certification sweep =="
+echo "== 9/10 telemetry: metrics surface, span trace, unified events =="
+# the observability acceptance gate (stencil_tpu/telemetry): a first
+# service process (cold: tunes once) and a second process on the same
+# plan cache (warm) each export their metrics snapshot, span trace,
+# and unified event log. The warm-path invariants are asserted from
+# the EXPORTED metrics — recompiles_total == 0 (the in-process warm
+# wave re-used the cached engine) and, in the second process,
+# tuner_measurements_total == 0 with plan_cache_hits_total == 1 — not
+# from internal fields. The Perfetto trace and both event logs are
+# schema-validated by the telemetry CLI and archived.
+TM_ROOT="$(mktemp -d -t tm_root.XXXXXX)"
+TM_CACHE="$(mktemp -t tm_cache.XXXXXX.json)"; rm -f "$TM_CACHE"
+TM_EVENTS1="$(mktemp -t tm_events1.XXXXXX.json)"
+TM_EVENTS2="$(mktemp -t tm_events2.XXXXXX.json)"
+TM_METRICS1="$(mktemp -t tm_metrics1.XXXXXX.json)"
+TM_METRICS2="$(mktemp -t tm_metrics2.XXXXXX.json)"
+TM_TRACE="$(mktemp -t tm_trace.XXXXXX.json)"
+( cd apps
+  python serve.py --tenants 2 --steps 4 --width 8 --fake-cpu 8 \
+        --fake-timer --tune-cache "$TM_CACHE" --root "$TM_ROOT/run1" \
+        --events-json "$TM_EVENTS1" --metrics-json "$TM_METRICS1" \
+        --trace-json "$TM_TRACE"
+  python serve.py --tenants 1 --second-wave 0 --steps 4 --width 8 \
+        --fake-cpu 8 --fake-timer --tune-cache "$TM_CACHE" \
+        --root "$TM_ROOT/run2" --events-json "$TM_EVENTS2" \
+        --metrics-json "$TM_METRICS2" )
+# the trace loads (Perfetto format) and both event logs are schema-valid
+python -m stencil_tpu.telemetry validate-trace "$TM_TRACE"
+python -m stencil_tpu.telemetry validate-events "$TM_EVENTS1"
+python -m stencil_tpu.telemetry validate-events "$TM_EVENTS2"
+TM_METRICS1="$TM_METRICS1" TM_METRICS2="$TM_METRICS2" python - <<'EOF'
+import json
+import os
+from stencil_tpu.telemetry import snapshot_value as v
+m1 = json.load(open(os.environ["TM_METRICS1"]))
+m2 = json.load(open(os.environ["TM_METRICS2"]))
+# the "== 0" gates below must test series that EXIST in the export
+# (counters are seeded to 0 at registration) — a renamed or deleted
+# metric must fail here, not read back as an absent-series 0.0
+for snap, which in ((m1, "cold"), (m2, "warm")):
+    for n in ("stencil_service_recompiles_total",
+              "stencil_service_tuner_measurements_total"):
+        assert snap["metrics"][n]["samples"], f"{n} absent ({which})"
+# run 1 (cold + in-process warm wave): one compile, zero REcompiles,
+# the warm wave hit the engine cache; the tuner measured exactly once
+assert v(m1, "stencil_service_compiles_total") == 1, m1
+assert v(m1, "stencil_service_recompiles_total") == 0, m1
+assert v(m1, "stencil_service_engine_cache_hits_total") >= 1, m1
+assert v(m1, "stencil_service_tuner_measurements_total") > 0, m1
+assert v(m1, "stencil_service_campaigns_total",
+         tenant="tenant0", outcome="completed") == 1, m1
+# run 2 (fresh process, same plan cache): the warm path verbatim —
+# zero recompiles, zero tuner measurements, one plan-cache hit
+assert v(m2, "stencil_service_recompiles_total") == 0, m2
+assert v(m2, "stencil_service_tuner_measurements_total") == 0, m2
+assert v(m2, "stencil_service_plan_cache_hits_total") == 1, m2
+assert v(m2, "stencil_service_member_steps_total") >= 4, m2
+print("telemetry smoke OK: warm path proven from exported metrics "
+      "(recompiles=0, tuner_measurements=0, plan_cache_hits=1), "
+      "trace + event logs schema-valid")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+  mkdir -p "$CI_ARTIFACT_DIR"
+  cp "$TM_METRICS1" "$CI_ARTIFACT_DIR/telemetry_metrics_cold.json"
+  cp "$TM_METRICS2" "$CI_ARTIFACT_DIR/telemetry_metrics_warm.json"
+  cp "$TM_TRACE" "$CI_ARTIFACT_DIR/telemetry_trace.json"
+  cp "$TM_EVENTS1" "$CI_ARTIFACT_DIR/telemetry_events.json"
+fi
+rm -rf "$TM_ROOT" "$TM_CACHE" "$TM_EVENTS1" "$TM_EVENTS2" \
+       "$TM_METRICS1" "$TM_METRICS2" "$TM_TRACE"
+
+echo "== 10/10 multi-chip certification sweep =="
 python __graft_entry__.py 8 | tail -1
 
 echo "CI PASSED"

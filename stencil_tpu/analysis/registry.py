@@ -753,6 +753,96 @@ def _health_step_probe_spec() -> HloSpec:
 
 
 # ---------------------------------------------------------------------------
+# telemetry targets: the in-graph step-metrics instrumentation
+# (stencil_tpu/telemetry/probe.py). Its license to ride the production
+# loop is the acceptance contract verbatim: metric columns piggyback
+# on the health probe's ONE existing all-reduce, so the instrumented
+# production step lowers to the SAME collectives as the bare step —
+# 6 collective-permutes + exactly 1 all-reduce, with the exchange's
+# byte cross-check still exact (telemetry adds zero wire bytes).
+
+
+def _telemetry_probe_spec() -> HloSpec:
+    """The metrics-carrying probe alone: still exactly ONE small
+    all-reduce — the extra columns ride the stacked-stats pmax."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..resilience.health import make_probe
+    from ..telemetry.probe import STEP_METRIC_NAMES
+
+    mesh = _mesh((2, 2, 2))
+    fn = make_probe(mesh, ["a", "b"], extra_names=STEP_METRIC_NAMES)
+    fields = {"a": _f32((16, 16, 16)), "b": _f32((16, 16, 16))}
+    vec = jax.ShapeDtypeStruct((len(STEP_METRIC_NAMES),), jnp.float32)
+    return HloSpec(fn=fn, args=(fields, vec), allow=("all_reduce",),
+                   exact_counts={"all_reduce": 1})
+
+
+def _telemetry_step_probe_fn():
+    """The INSTRUMENTED production jacobi step: step + metrics-carrying
+    probe fused, exactly as the resilient run loop dispatches it on
+    probe steps when telemetry is on. Shared by the hlo gate and the
+    byte cross-check so the two audit one program."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3
+    from ..models.jacobi import jacobi_shard_step
+    from ..parallel.exchange import shard_origin
+    from ..parallel.mesh import mesh_dim
+    from ..parallel.methods import Method
+    from ..resilience.health import probe_shard
+    from ..telemetry.probe import STEP_METRIC_NAMES
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = _exchange_radius("r1")
+    local = Dim3(12, 12, 12)
+    gsize = Dim3(24, 24, 24)
+
+    def shard(p, vec):
+        origin = shard_origin(local, Dim3(0, 0, 0))
+        stepped = jacobi_shard_step(p, radius, counts, local, gsize,
+                                    origin, Method.PpermuteSlab)
+        extra = {m: vec[i] for i, m in enumerate(STEP_METRIC_NAMES)}
+        return stepped, probe_shard({"temp": stepped}, extra=extra)
+
+    spec = P("z", "y", "x")
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(spec, P()),
+                       out_specs=(spec, P()), check_vma=False)
+    import jax.numpy as jnp
+    vec = jax.ShapeDtypeStruct((len(STEP_METRIC_NAMES),), jnp.float32)
+    return sm, (_f32(_EXCHANGE_GLOBAL), vec)
+
+
+def _telemetry_step_probe_spec() -> HloSpec:
+    fn, args = _telemetry_step_probe_fn()
+    # identical pins to resilience.health.step+probe[hlo]: telemetry
+    # must not change the production step's collective bill at all
+    return HloSpec(fn=fn, args=args,
+                   allow=("collective_permute", "all_reduce"),
+                   exact_counts={"all_reduce": 1,
+                                 "collective_permute": 6})
+
+
+def _telemetry_step_probe_cost() -> CostModelSpec:
+    """Zero extra wire bytes: the instrumented step's exchange still
+    moves exactly the analytic halo bytes (the all-reduce is outside
+    ``count_kinds`` by the package's byte convention — its count is
+    pinned by the hlo target above)."""
+    from ..geometry import Dim3
+
+    fn, args = _telemetry_step_probe_fn()
+    expected = _sweep_bytes(_exchange_shard_shape(),
+                            _exchange_radius("r1"),
+                            Dim3(*_EXCHANGE_MESH), 4)
+    return CostModelSpec(fn=fn, args=args,
+                         expected_bytes_per_shard=expected,
+                         count_kinds=("collective_permute",))
+
+
+# ---------------------------------------------------------------------------
 # VMEM targets: every shipped Pallas kernel's static memory/tiling
 # audit. The overlap/RDMA builders are shared with the dma targets;
 # the single-chip wrap/halo fast-path kernels (previously outside the
@@ -1035,6 +1125,18 @@ def default_targets() -> List[Target]:
         HloTarget("resilience.health.probe[hlo]", _health_probe_spec),
         HloTarget("resilience.health.step+probe[hlo]",
                   _health_step_probe_spec),
+    ]
+    # the telemetry step-metrics instrumentation: metric columns ride
+    # the probe's one all-reduce — the instrumented production step
+    # keeps the bare step's exact collective counts and exact exchange
+    # bytes (see stencil_tpu/telemetry/probe.py)
+    targets += [
+        HloTarget("telemetry.probe+metrics[hlo]",
+                  _telemetry_probe_spec),
+        HloTarget("telemetry.step+probe+metrics[hlo]",
+                  _telemetry_step_probe_spec),
+        CostModelTarget("telemetry.step+probe+metrics[cost]",
+                        _telemetry_step_probe_cost),
     ]
     # static VMEM/tiling audit: every shipped Pallas kernel
     targets += [

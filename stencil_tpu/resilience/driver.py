@@ -104,7 +104,14 @@ def degradation_ladder(method: Method, exchange_every: int,
 
 @dataclasses.dataclass
 class ResilienceReport:
-    """What happened, machine-readable (the chaos-smoke CI artifact)."""
+    """What happened, machine-readable (the chaos-smoke CI artifact).
+
+    Events flow through the unified telemetry schema
+    (:class:`~stencil_tpu.telemetry.EventLog`): every record carries
+    the run id, a monotonic sequence number, and the schema version —
+    the same shape the campaign service logs, so one scraper reads
+    both. The serializable ``events`` list is fed by a ``ListSink``;
+    ``sinks`` (e.g. a ``JsonlSink``) fan out the same records live."""
 
     steps: int = 0
     rollbacks: int = 0
@@ -113,10 +120,29 @@ class ResilienceReport:
     preempted: bool = False
     resumed_from: Optional[int] = None
     final_config: str = ""
+    run_id: str = ""
     events: List[Dict] = dataclasses.field(default_factory=list)
 
+    def __post_init__(self) -> None:
+        from ..telemetry import EventLog, ListSink
+        self._elog = EventLog(run_id=self.run_id or None,
+                              sinks=(ListSink(self.events),))
+        self.run_id = self._elog.run_id
+        self._tracer = None
+
+    def add_sink(self, sink) -> None:
+        self._elog.add_sink(sink)
+
+    def bind_tracer(self, tracer) -> None:
+        """Span-correlate report events: records emitted inside a span
+        of ``tracer`` carry its id (the same run-id/span-id identity
+        the campaign service logs — one scraper joins both)."""
+        self._tracer = tracer
+
     def log(self, kind: str, **kw) -> None:
-        self.events.append({"event": kind, "time": time.time(), **kw})
+        span = (self._tracer.current_span_id()
+                if self._tracer is not None else None)
+        self._elog.emit(kind, span=span, **kw)
 
     def to_record(self) -> Dict:
         return dataclasses.asdict(self)
@@ -150,14 +176,81 @@ class _ResilientRun:
         self.report = ResilienceReport()
         if faults is not None:
             faults.bind(self.report.log)
-        self.sentinel = HealthSentinel(
-            dd, window=self.policy.window,
-            growth_factor=self.policy.growth_factor)
+        self.sentinel = self._make_sentinel(dd)
         self.step = 0
         self.attempts = 0
         self.last_saved: Optional[int] = None
         self.ladder: Optional[List[StepConfig]] = None
         self._preempt = False
+        # run-loop metrics (stable names, README "Observability"):
+        # exported through the process-default telemetry registry
+        from ..telemetry import get_registry, get_tracer
+        reg = get_registry()
+        self._tracer = get_tracer()
+        # report events carry the span id of the enclosing run-loop
+        # span, mirroring the service's span-correlated event log
+        self.report.bind_tracer(self._tracer)
+        self._m_steps = reg.counter(
+            "stencil_run_steps_total",
+            "step dispatches by resilient run loops (replayed "
+            "rollback windows included — work done, not net progress)")
+        self._m_rollbacks = reg.counter(
+            "stencil_run_rollbacks_total",
+            "sentinel-tripped rollbacks")
+        self._m_save_retries = reg.counter(
+            "stencil_run_save_retries_total",
+            "transient checkpoint-save retries")
+        self._m_checkpoints = reg.counter(
+            "stencil_run_checkpoints_total", "checkpoints written")
+        self._m_degradations = reg.counter(
+            "stencil_run_degradations_total",
+            "configuration degradations taken")
+        self._m_steps_per_s = reg.gauge(
+            "stencil_run_steps_per_s",
+            "steps/s of the last resilient run")
+        self._m_bytes_per_step = reg.gauge(
+            "stencil_run_bytes_per_step",
+            "amortized exchange B/step (source=model: the analytic "
+            "model the HLO cross-check pins; source=probe: harvested "
+            "from the in-graph probe counters)")
+        # seed the unlabeled counters so the exported surface carries
+        # an explicit 0 baseline from birth (prometheus_client
+        # semantics); "== 0" assertions then test a series that exists
+        for c in (self._m_steps, self._m_rollbacks,
+                  self._m_save_retries, self._m_checkpoints,
+                  self._m_degradations):
+            c.inc(0)
+
+    def _make_sentinel(self, dd,
+                       rebase_step: Optional[int] = None,
+                       prev=None) -> HealthSentinel:
+        """A sentinel whose probe also carries the telemetry step
+        metrics (sub-steps + model-exact wire bytes) on its ONE
+        all-reduce — when the domain prices its exchange; plain
+        otherwise. A degradation rebuild rebases the byte counter at
+        ``rebase_step`` (the restore anchor, not the trip step: the
+        rolled-back window re-executes under the NEW configuration and
+        must be priced at its rate) so the new configuration's price
+        applies only to steps it actually runs, never retroactively to
+        traffic already sent. ``prev`` overrides the metrics block the
+        rebase derives from (the finalize-after-restore path must
+        rebase from the PRE-degrade block, not compound the
+        provisional rebase)."""
+        from ..telemetry.probe import step_metrics_for
+        if prev is None:
+            prev = getattr(self, "_step_metrics", None)
+        if prev is not None:
+            if rebase_step is None:
+                rebase_step = getattr(self, "step", 0)
+            try:
+                self._step_metrics = prev.rebased(dd, rebase_step)
+            except Exception:  # noqa: BLE001 - new config unpriceable
+                self._step_metrics = step_metrics_for(dd)
+        else:
+            self._step_metrics = step_metrics_for(dd)
+        return HealthSentinel(dd, window=self.policy.window,
+                              growth_factor=self.policy.growth_factor,
+                              metrics=self._step_metrics)
 
     # -- helpers --------------------------------------------------------
     def _fields(self):
@@ -184,13 +277,18 @@ class _ResilientRun:
 
         def on_retry(k, e, delay):
             self.report.save_retries += 1
+            self._m_save_retries.inc()
             self.report.log("save_retry", step=step, attempt=k,
                             error=f"{type(e).__name__}: {e}",
                             delay=delay)
 
-        retry(attempt, attempts=self.policy.save_attempts,
-              base_delay=self.policy.base_delay, retriable=(OSError,),
-              sleep=self.policy.sleep, on_retry=on_retry)
+        with self._tracer.span("checkpoint", step=step,
+                               preempted=preempted):
+            retry(attempt, attempts=self.policy.save_attempts,
+                  base_delay=self.policy.base_delay,
+                  retriable=(OSError,),
+                  sleep=self.policy.sleep, on_retry=on_retry)
+        self._m_checkpoints.inc()
         if self.faults is not None:
             self.faults.after_save(self.ckpt_dir, step)
         self.last_saved = step
@@ -208,19 +306,48 @@ class _ResilientRun:
         if not self.sentinel.has_pending(self.step):
             self.sentinel.probe(self._fields(), self.step)
         results = self.sentinel.poll(block=True)
+        self._observe_probes(results)
         return [s for s in results if s.tripped]
 
+    def _observe_probes(self, results: List[HealthStats]) -> None:
+        """Export the in-graph counters the probes carried: the
+        probe-observed amortized B/step next to the model's figure.
+        They agree while one configuration runs (the probe's counter
+        IS the model-exact byte price — the costmodel checker pins it
+        against HLO); after a degradation the probe figure is the
+        campaign-average across the configurations actually run."""
+        if self._step_metrics is None:
+            return
+        for stats in results:
+            if not stats.metrics:
+                continue
+            decoded = self._step_metrics.decode(stats.metrics)
+            self._m_bytes_per_step.set(decoded["bytes_per_step_probe"],
+                                       source="probe")
+
     def _restore(self) -> None:
-        step, extras = restore_domain(self.dd, self.ckpt_dir)
+        with self._tracer.span("restore"):
+            step, extras = restore_domain(self.dd, self.ckpt_dir)
         if self.on_restore is not None:
             self.on_restore(extras)
         self.step = step
+        pre_degrade = getattr(self, "_rebase_from", None)
+        if pre_degrade is not None:
+            # finalize the post-degradation byte rebase at the step the
+            # restore ACTUALLY landed on: restore_domain may have
+            # walked back past a corrupt last_saved checkpoint, and the
+            # whole re-executed window must be priced at the degraded
+            # configuration's rate
+            self._rebase_from = None
+            self.sentinel = self._make_sentinel(
+                self.dd, rebase_step=step, prev=pre_degrade)
         self.sentinel.reset()
         self.report.log("restored", step=step)
 
     def _handle_trip(self, tripped: List[HealthStats]) -> None:
         stats = tripped[0]
         self.report.rollbacks += 1
+        self._m_rollbacks.inc()
         self.attempts += 1
         self.report.log("sentinel_tripped", step=stats.step,
                         reason=stats.reason,
@@ -264,11 +391,27 @@ class _ResilientRun:
                 LOG_WARN(f"degradation rung {cfg.key()} is infeasible "
                          f"for this domain ({e}); trying the next")
                 continue
-            self.sentinel = HealthSentinel(
-                self.dd, window=self.policy.window,
-                growth_factor=self.policy.growth_factor)
+            # rebase at the restore anchor: _handle_trip restores right
+            # after this, and every step past the restored checkpoint
+            # re-runs under the degraded configuration's byte price.
+            # last_saved is the provisional anchor; _restore finalizes
+            # it from the PRE-degrade metrics stashed here, because a
+            # corrupt last_saved checkpoint can make the restore walk
+            # back further
+            self._rebase_from = self._step_metrics
+            anchor = (self.last_saved if self.last_saved is not None
+                      else getattr(self, "step", 0))
+            self.sentinel = self._make_sentinel(self.dd,
+                                                rebase_step=anchor)
+            if self._step_metrics is not None:
+                # the degraded configuration has a new per-step byte
+                # price — keep the exported model figure current so the
+                # model-vs-probe comparison stays honest mid-run
+                self._m_bytes_per_step.set(
+                    self._step_metrics.bytes_per_step, source="model")
             self.attempts = 0
             self.report.degradations.append(cfg.key())
+            self._m_degradations.inc()
             self.report.log("degraded", config=cfg.key())
             return
         raise ResilienceError(
@@ -278,7 +421,17 @@ class _ResilientRun:
 
     # -- the loop -------------------------------------------------------
     def run(self) -> ResilienceReport:
+        with self._tracer.span("resilience.run", run=self.report.run_id,
+                               n_steps=self.n_steps):
+            return self._run()
+
+    def _run(self) -> ResilienceReport:
         policy = self.policy
+        if self._step_metrics is not None:
+            self._m_bytes_per_step.set(
+                self._step_metrics.bytes_per_step, source="model")
+        t_start = time.perf_counter()
+        steps_at_start = self.step
         if self.ckpt_dir is not None:
             try:
                 self._restore()
@@ -332,6 +485,7 @@ class _ResilientRun:
                 self.step_fn()
                 self.step += 1
                 self.report.steps = self.step
+                self._m_steps.inc()
                 if self.faults is not None:
                     # faults hit the LIVE fields — the same dict the
                     # sentinel probes (interior-resident fast paths
@@ -346,8 +500,9 @@ class _ResilientRun:
                     # checkpoint boundaries probe via the blocking
                     # drain below — one reduction per step, not two
                     self.sentinel.probe(self._fields(), self.step)
-                tripped = [s for s in self.sentinel.poll()
-                           if s.tripped]
+                results = self.sentinel.poll()
+                self._observe_probes(results)
+                tripped = [s for s in results if s.tripped]
                 if tripped:
                     self._handle_trip(tripped)
                     continue
@@ -364,6 +519,12 @@ class _ResilientRun:
                               else signal.SIG_DFL)
         self.report.steps = self.step
         self.report.final_config = _current_config(self.dd).key()
+        elapsed = time.perf_counter() - t_start
+        # steps THIS invocation advanced (a resume starts mid-campaign)
+        done = self.step - max(steps_at_start,
+                               self.report.resumed_from or 0)
+        if done > 0 and elapsed > 0:
+            self._m_steps_per_s.set(done / elapsed)
         return self.report
 
 

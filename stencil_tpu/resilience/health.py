@@ -48,13 +48,24 @@ ROW_MAX_ABS = 1
 
 
 def probe_shard(fields: Dict[str, jnp.ndarray],
-                axis_names: Sequence[str] = ("z", "y", "x")
+                axis_names: Sequence[str] = ("z", "y", "x"),
+                extra: Optional[Dict[str, jnp.ndarray]] = None
                 ) -> jnp.ndarray:
     """Per-shard health stats inside ``shard_map``: a ``(2, n)`` f32
     vector — row 0 the non-finite cell count, row 1 the max |finite|
     value — made globally consistent by ONE ``pmax`` over
     ``axis_names`` (one small all-reduce on the wire, nothing else).
-    Quantity order is the dict's iteration order."""
+    Quantity order is the dict's iteration order.
+
+    ``extra`` (telemetry): named scalar step metrics appended as
+    additional columns (the scalar in BOTH rows) BEFORE the single
+    pmax, so in-graph counters ride the probe's existing all-reduce —
+    the instrumented vector is ``(2, n + len(extra))`` and the
+    collective count is unchanged (pinned by the ``telemetry.*``
+    stencil-lint registry targets; ``bad_probe_metrics.py`` is the
+    reduce-it-separately negative control). Max-reduction semantics:
+    replicated metrics come back exact; per-shard metrics come back as
+    the mesh max."""
     cols = []
     for q in fields:
         p = fields[q]
@@ -64,19 +75,39 @@ def probe_shard(fields: Dict[str, jnp.ndarray],
             jnp.where(finite, jnp.abs(p),
                       jnp.zeros_like(p))).astype(jnp.float32)
         cols.append(jnp.stack([nonfinite, max_abs]))
+    for m in (extra or {}):
+        v = jnp.asarray(extra[m]).astype(jnp.float32).reshape(())
+        cols.append(jnp.stack([v, v]))
     vec = jnp.stack(cols, axis=1)
     if axis_names:
         vec = jax.lax.pmax(vec, tuple(axis_names))
     return vec
 
 
-def make_probe(mesh, names: Sequence[str]):
+def make_probe(mesh, names: Sequence[str],
+               extra_names: Sequence[str] = ()):
     """The jitted whole-mesh probe: ``fn(fields) -> (2, len(names))``
     replicated f32 stats for the named quantities (order pinned by
     ``names``). Shape-polymorphic across retraces, so padded and
-    interior-resident field sets both work."""
+    interior-resident field sets both work.
+
+    With ``extra_names``, the probe becomes ``fn(fields, metrics_vec)
+    -> (2, len(names) + len(extra_names))``: ``metrics_vec`` is a
+    replicated f32 ``(len(extra_names),)`` vector of step metrics that
+    ride the same single all-reduce (see :func:`probe_shard`)."""
     names = list(names)
+    extras = list(extra_names)
     spec = {q: P("z", "y", "x") for q in names}
+
+    if extras:
+        def shard_m(fields, vec):
+            return probe_shard(
+                {q: fields[q] for q in names},
+                extra={m: vec[i] for i, m in enumerate(extras)})
+
+        sm = jax.shard_map(shard_m, mesh=mesh, in_specs=(spec, P()),
+                           out_specs=P(), check_vma=False)
+        return jax.jit(sm)
 
     def shard(fields):
         return probe_shard({q: fields[q] for q in names})
@@ -88,18 +119,25 @@ def make_probe(mesh, names: Sequence[str]):
 
 @dataclasses.dataclass
 class HealthStats:
-    """One harvested probe result plus the divergence verdict."""
+    """One harvested probe result plus the divergence verdict.
+
+    ``metrics`` holds any telemetry step-metric columns that rode the
+    probe (empty on uninstrumented probes)."""
 
     step: int
     nonfinite: Dict[str, int]
     max_abs: Dict[str, float]
     tripped: bool = False
     reason: str = ""
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def to_record(self) -> Dict:
-        return {"step": self.step, "nonfinite": dict(self.nonfinite),
-                "max_abs": dict(self.max_abs), "tripped": self.tripped,
-                "reason": self.reason}
+        rec = {"step": self.step, "nonfinite": dict(self.nonfinite),
+               "max_abs": dict(self.max_abs), "tripped": self.tripped,
+               "reason": self.reason}
+        if self.metrics:
+            rec["metrics"] = dict(self.metrics)
+        return rec
 
 
 def _is_ready(arr) -> bool:
@@ -120,11 +158,19 @@ class HealthSentinel:
     """
 
     def __init__(self, dd, window: int = 8,
-                 growth_factor: float = 1e6) -> None:
+                 growth_factor: float = 1e6, metrics=None) -> None:
         self.names = list(dd._names)
         self.window = int(window)
         self.growth_factor = float(growth_factor)
-        self._probe_fn = make_probe(dd.mesh, self.names)
+        #: telemetry step-metrics provider (``.names`` +
+        #: ``.values(step) -> (k,) f32``), e.g. :class:`~stencil_tpu.
+        #: telemetry.probe.StepMetrics` — its counters ride the probe's
+        #: one all-reduce (no extra collectives)
+        self._metrics = metrics
+        self._probe_fn = make_probe(
+            dd.mesh, self.names,
+            extra_names=tuple(metrics.names) if metrics is not None
+            else ())
         self._pending: Deque[Tuple[int, jnp.ndarray]] = deque()
         self._history: Dict[str, Deque[float]] = {
             q: deque(maxlen=self.window) for q in self.names}
@@ -134,6 +180,11 @@ class HealthSentinel:
     def probe(self, fields: Dict[str, jnp.ndarray], step: int) -> None:
         """Enqueue one health probe of ``fields`` at ``step`` (does not
         block; the reduction rides the device queue)."""
+        if self._metrics is not None:
+            self._pending.append(
+                (step, self._probe_fn(dict(fields),
+                                      self._metrics.values(step))))
+            return
         self._pending.append((step, self._probe_fn(dict(fields))))
 
     def has_pending(self, step: int) -> bool:
@@ -174,6 +225,10 @@ class HealthSentinel:
         max_abs = {q: float(host[ROW_MAX_ABS, i])
                    for i, q in enumerate(self.names)}
         stats = HealthStats(step, nonfinite, max_abs)
+        if self._metrics is not None:
+            n = len(self.names)
+            stats.metrics = {m: float(host[ROW_NONFINITE, n + i])
+                             for i, m in enumerate(self._metrics.names)}
         bad_nf = [q for q, n in nonfinite.items() if n > 0]
         if bad_nf:
             stats.tripped = True

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 
@@ -118,6 +119,9 @@ class _Entry:
     handle: CampaignHandle
     fingerprint: str
     seq: int
+    #: submit wall time — the service's admission-latency metric
+    #: (time from submit to batch start) reads it
+    submitted: float = dataclasses.field(default_factory=time.time)
 
 
 class RequestQueue:

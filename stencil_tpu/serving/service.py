@@ -25,8 +25,19 @@ tuning-plan cache. The worker loop:
    a campaign whose namespace holds checkpoints resumes it from the
    newest restorable step.
 
-Everything lands in a JSON-serializable event log (the CI service-smoke
-artifact) plus :class:`ServiceStats` counters the smoke asserts on.
+Observability is the unified telemetry layer (:mod:`..telemetry`):
+events flow through the versioned :class:`~..telemetry.EventLog` into
+a BOUNDED in-memory ring (flat memory over millions of requests) and
+out to the JSON artifact; spans (campaign.batch -> segment ->
+compile/tune/checkpoint/rollback) export as Perfetto-loadable Chrome
+trace JSON via :meth:`CampaignService.export_trace`; and the metric
+surface (:meth:`CampaignService.metrics_text` Prometheus text /
+:meth:`~CampaignService.metrics_snapshot` JSON, served over HTTP by
+``apps/serve.py --metrics-port``) is what the warm-path CI gates
+assert on — zero ``stencil_service_recompiles_total``, zero
+``stencil_service_tuner_measurements_total`` on cache hits — instead
+of internal fields. :class:`ServiceStats` remains as the legacy
+in-process counter block.
 """
 
 from __future__ import annotations
@@ -108,7 +119,9 @@ class CampaignService:
     def __init__(self, root_dir: str, devices=None, width: int = 8,
                  tuner_timer=None, plan_cache_path=None,
                  window: int = 8, growth_factor: float = 1e6,
-                 max_to_keep: int = 3) -> None:
+                 max_to_keep: int = 3, events_capacity: int = 4096,
+                 run_id: Optional[str] = None, registry=None,
+                 tracer=None) -> None:
         if int(width) < 1:
             raise ValueError(f"width must be >= 1, got {width}")
         self.root = Path(root_dir)
@@ -122,13 +135,122 @@ class CampaignService:
         self._max_to_keep = int(max_to_keep)
         self.queue = RequestQueue(devices)
         self.stats = ServiceStats()
-        self.events: List[Dict] = []
-        self._events_lock = threading.Lock()
+        # unified telemetry: events through the versioned EventLog into
+        # a BOUNDED ring (a long-running service holds flat memory over
+        # millions of requests; `dropped` in the payload makes the
+        # truncation visible), metrics through a per-service registry,
+        # spans through a per-service tracer sharing the run id
+        from ..telemetry import (EventLog, MetricsRegistry, RingSink,
+                                 Tracer)
+        self._ring = RingSink(events_capacity)
+        self._elog = EventLog(run_id=run_id, sinks=(self._ring,))
+        self.run_id = self._elog.run_id
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self.tracer = tracer if tracer is not None \
+            else Tracer(run_id=self.run_id)
+        self._register_metrics()
         self._engines: Dict[str, object] = {}
+        #: fingerprints EVER built — a construction for a known
+        #: fingerprint is a recompile (warm-path regression)
+        self._built: set = set()
         self._sentinels: Dict[str, EnsembleSentinel] = {}
         self._preempt = False
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+
+    def _register_metrics(self) -> None:
+        """Declare the service metric surface (names and labels are a
+        stable contract — README "Observability")."""
+        m = self.metrics
+        self._m_requests = m.counter(
+            "stencil_service_requests_total",
+            "campaign requests submitted, by tenant")
+        self._m_queue_depth = m.gauge(
+            "stencil_service_queue_depth",
+            "requests waiting for admission")
+        self._m_admission = m.histogram(
+            "stencil_service_admission_latency_seconds",
+            "submit-to-batch-start latency")
+        self._m_batches = m.counter(
+            "stencil_service_batches_total", "ensemble batches served")
+        self._m_occupancy = m.gauge(
+            "stencil_service_batch_occupancy_ratio",
+            "members / width of the last admitted batch")
+        self._m_compiles = m.counter(
+            "stencil_service_compiles_total",
+            "engine constructions (every build; recompiles_total "
+            "counts the already-seen-fingerprint subset)")
+        self._m_recompiles = m.counter(
+            "stencil_service_recompiles_total",
+            "engine constructions for an ALREADY-SEEN fingerprint — "
+            "warm-path regressions; 0 on a healthy service")
+        self._m_engine_hits = m.counter(
+            "stencil_service_engine_cache_hits_total",
+            "batches served by an already-built engine")
+        self._m_engine_size = m.gauge(
+            "stencil_service_engine_cache_size", "engines resident")
+        self._m_plan_hits = m.counter(
+            "stencil_service_plan_cache_hits_total",
+            "exchange plans served from the persistent cache")
+        self._m_plan_misses = m.counter(
+            "stencil_service_plan_cache_misses_total",
+            "fingerprints that had to tune (or run untuned)")
+        self._m_tuner = m.counter(
+            "stencil_service_tuner_measurements_total",
+            "tuner timer invocations; 0 on the warm path")
+        self._m_rollbacks = m.counter(
+            "stencil_service_rollbacks_total",
+            "member-isolated rollbacks, by tenant")
+        self._m_campaigns = m.counter(
+            "stencil_service_campaigns_total",
+            "campaign outcomes, by tenant and outcome "
+            "(completed|failed|preempted)")
+        self._m_steps = m.counter(
+            "stencil_service_member_steps_total",
+            "member steps advanced across all lanes")
+        self._m_steps_per_s = m.gauge(
+            "stencil_service_member_steps_per_s",
+            "member steps/s of the last served batch")
+        self._m_checkpoints = m.counter(
+            "stencil_service_checkpoints_total",
+            "member checkpoints written")
+        self._m_snapshots = m.counter(
+            "stencil_service_snapshots_total",
+            "streaming snapshots enqueued")
+        # unlabeled counters export an explicit 0 sample from birth
+        # (prometheus_client semantics): the warm-path gates assert
+        # recompiles/tuner-measurements == 0 against a series that
+        # EXISTS, and a scraper sees the 0 baseline before the first
+        # increment; labeled counters appear on first labeled inc
+        for c in (self._m_batches, self._m_compiles,
+                  self._m_recompiles, self._m_engine_hits,
+                  self._m_plan_hits, self._m_plan_misses,
+                  self._m_tuner, self._m_steps, self._m_checkpoints,
+                  self._m_snapshots):
+            c.inc(0)
+
+    # ------------------------------------------------------------------
+    # telemetry surfaces
+    # ------------------------------------------------------------------
+    @property
+    def events(self) -> List[Dict]:
+        """The newest events (bounded ring — see ``events_capacity``)."""
+        return self._ring.records()
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the service metrics — the
+        surface the warm-path CI gates and tests assert on (external
+        contract, not internal fields)."""
+        return self.metrics.to_prometheus_text()
+
+    def metrics_snapshot(self) -> Dict:
+        """JSON-serializable metrics snapshot (the CI artifact)."""
+        return self.metrics.snapshot()
+
+    def export_trace(self, path: str) -> None:
+        """Chrome trace-event JSON of this service's spans (Perfetto)."""
+        self.tracer.export_chrome_trace(path)
 
     # ------------------------------------------------------------------
     # client API
@@ -139,6 +261,8 @@ class CampaignService:
         holds checkpoints (a preempted earlier run), it resumes from
         the newest restorable step."""
         handle = self.queue.submit(req)
+        self._m_requests.inc(tenant=req.tenant)
+        self._m_queue_depth.set(len(self.queue))
         self._log("submitted", tenant=req.tenant, campaign=req.campaign,
                   fingerprint=handle.fingerprint)
         return handle
@@ -151,6 +275,7 @@ class CampaignService:
             if not batch:
                 break
             self._run_batch(batch)
+        self._m_queue_depth.set(len(self.queue))
 
     def start(self) -> None:
         """Serve from a background worker thread until :meth:`stop`."""
@@ -193,9 +318,11 @@ class CampaignService:
         return self.root / t / c
 
     def write_events(self, path: str) -> None:
-        with self._events_lock:
-            payload = {"stats": self.stats.to_record(),
-                       "events": list(self.events)}
+        from ..telemetry import EVENT_SCHEMA_VERSION
+        payload = {"schema": EVENT_SCHEMA_VERSION, "run": self.run_id,
+                   "dropped_events": self._ring.dropped,
+                   "stats": self.stats.to_record(),
+                   "events": self.events}
         with open(path, "w") as f:
             json.dump(payload, f, indent=1)
 
@@ -203,9 +330,8 @@ class CampaignService:
     # internals
     # ------------------------------------------------------------------
     def _log(self, kind: str, **kw) -> None:
-        with self._events_lock:
-            self.events.append({"event": kind, "time": time.time(),
-                                **kw})
+        # events correlate with the enclosing telemetry span (if any)
+        self._elog.emit(kind, span=self.tracer.current_span_id(), **kw)
 
     def _plan_for(self, fingerprint: str, req: CampaignRequest):
         """The exchange plan for a fingerprint: cache hit (zero
@@ -217,7 +343,9 @@ class CampaignService:
             plan.provenance = "cached"
             plan.measurements = 0
             self.stats.plan_cache_hits += 1
+            self._m_plan_hits.inc()
             return plan
+        self._m_plan_misses.inc()
         if self._tuner_timer is None:
             return None
         import jax.numpy as jnp
@@ -230,12 +358,14 @@ class CampaignService:
                                boundary=Boundary[req.boundary],
                                mesh_shape=req.mesh_shape,
                                devices=self._devices)
-        plan = autotune_domain(dd, timer=self._tuner_timer,
-                               cache_path=self._plan_cache_path,
-                               depths=(1,))
+        with self.tracer.span("tune", fingerprint=fingerprint):
+            plan = autotune_domain(dd, timer=self._tuner_timer,
+                                   cache_path=self._plan_cache_path,
+                                   depths=(1,))
         assert plan.fingerprint == fingerprint, \
             (plan.fingerprint, fingerprint)
         self.stats.tuner_measurements += plan.measurements
+        self._m_tuner.inc(plan.measurements)
         return plan
 
     def _engine_for(self, fingerprint: str, req: CampaignRequest):
@@ -243,16 +373,20 @@ class CampaignService:
         reused for every later fingerprint-identical batch."""
         eng = self._engines.get(fingerprint)
         if eng is not None:
+            self._m_engine_hits.inc()
             return eng, False, None
         import jax.numpy as jnp
 
         from ..topology import Boundary
         plan = self._plan_for(fingerprint, req)
         cls = EnsembleJacobi if req.model == "jacobi" else EnsembleAstaroth
-        eng = cls(self.width, *req.grid, dtype=jnp.dtype(req.dtype),
-                  boundary=Boundary[req.boundary],
-                  mesh_shape=req.mesh_shape, devices=self._devices,
-                  plan=plan)
+        with self.tracer.span("compile", fingerprint=fingerprint,
+                              model=req.model):
+            eng = cls(self.width, *req.grid,
+                      dtype=jnp.dtype(req.dtype),
+                      boundary=Boundary[req.boundary],
+                      mesh_shape=req.mesh_shape, devices=self._devices,
+                      plan=plan)
         assert eng.fingerprint == fingerprint, \
             (eng.fingerprint, fingerprint)
         self._engines[fingerprint] = eng
@@ -260,6 +394,14 @@ class CampaignService:
             eng, window=self._window,
             growth_factor=self._growth_factor)
         self.stats.compiles += 1
+        self._m_compiles.inc()
+        if fingerprint in self._built:
+            # the engine cache dropped a fingerprint it had already
+            # built — the warm-path regression the CI counter gate is
+            # for (stencil_service_recompiles_total stays 0 normally)
+            self._m_recompiles.inc()
+        self._built.add(fingerprint)
+        self._m_engine_size.set(len(self._engines))
         return eng, True, plan
 
     def _admit_lane(self, eng, lane: _Lane) -> None:
@@ -282,6 +424,7 @@ class CampaignService:
             eng.init_member(k, req.init_seed)
             eng.save_member(lane.ckpt_dir, 0, k,
                             max_to_keep=self._max_to_keep)
+            self._m_checkpoints.inc()
             self._log("checkpoint", tenant=req.tenant,
                       campaign=req.campaign, step=0)
 
@@ -328,6 +471,7 @@ class CampaignService:
             lane.active = False
             eng.reset_member(lane.index)
             self.stats.failed += 1
+            self._m_campaigns.inc(tenant=req.tenant, outcome="failed")
             self._log("campaign_failed", tenant=req.tenant,
                       campaign=req.campaign, reason=reason)
             lane.entry.handle._fail(CampaignFailed(
@@ -335,10 +479,13 @@ class CampaignService:
                 f"({req.max_retries}) at step {lane.counter}: "
                 f"{reason}"))
             return
-        step = eng.restore_member(lane.ckpt_dir, lane.index)
+        with self.tracer.span("rollback", tenant=req.tenant,
+                              member=lane.index):
+            step = eng.restore_member(lane.ckpt_dir, lane.index)
         lane.counter = step
         lane.rollbacks += 1
         self.stats.rollbacks += 1
+        self._m_rollbacks.inc(tenant=req.tenant)
         self._log("rollback", tenant=req.tenant, campaign=req.campaign,
                   member=lane.index, restored_step=step)
 
@@ -353,10 +500,14 @@ class CampaignService:
             snapshots=sorted(lane.snapshots.items()), final=final)
         lane.active = False
         if preempted:
+            self._m_campaigns.inc(tenant=req.tenant,
+                                  outcome="preempted")
             self._log("campaign_preempted", tenant=req.tenant,
                       campaign=req.campaign, step=lane.counter)
         else:
             self.stats.completed += 1
+            self._m_campaigns.inc(tenant=req.tenant,
+                                  outcome="completed")
             self._log("campaign_completed", tenant=req.tenant,
                       campaign=req.campaign, steps=lane.counter,
                       rollbacks=lane.rollbacks)
@@ -364,11 +515,25 @@ class CampaignService:
 
     def _run_batch(self, batch) -> None:
         fp = batch[0].fingerprint
+        with self.tracer.span("campaign.batch", fingerprint=fp,
+                              members=len(batch)):
+            self._serve_batch(batch)
+
+    def _serve_batch(self, batch) -> None:
+        fp = batch[0].fingerprint
         req0 = batch[0].request
+        now = time.time()
+        for e in batch:
+            self._m_admission.observe(max(0.0, now - e.submitted))
+        self._m_queue_depth.set(len(self.queue))
+        self._m_occupancy.set(len(batch) / self.width)
         eng, compiled, plan = self._engine_for(fp, req0)
         sentinel = self._sentinels[fp]
         sentinel.reset()
         self.stats.batches += 1
+        self._m_batches.inc()
+        t_batch = time.perf_counter()
+        steps_advanced = 0
         self._log(
             "batch_started", fingerprint=fp, members=len(batch),
             width=eng.n_members, compiled=compiled,
@@ -437,6 +602,7 @@ class CampaignService:
                                         lane.index,
                                         meta_extra={"preempted": True},
                                         max_to_keep=self._max_to_keep)
+                        self._m_checkpoints.inc()
                         self._log("checkpoint",
                                   tenant=lane.request.tenant,
                                   campaign=lane.request.campaign,
@@ -446,10 +612,15 @@ class CampaignService:
                 return
             seg = min(self._steps_to_boundary(lane)
                       for lane in lanes if lane.active)
-            eng.run(seg)
+            with self.tracer.span("segment", steps=seg):
+                eng.run(seg)
+            n_active = 0
             for lane in lanes:
                 if lane.active:
                     lane.counter += seg
+                    n_active += 1
+            self._m_steps.inc(seg * n_active)
+            steps_advanced += seg * n_active
             # chaos injections land AFTER the step that reaches them
             for lane in lanes:
                 req = lane.request
@@ -480,14 +651,19 @@ class CampaignService:
                     pending_snaps.append(
                         (lane, eng.member_snapshot_async(
                             lane.index, lane.counter)))
+                    self._m_snapshots.inc()
                     self._log("snapshot_enqueued", tenant=req.tenant,
                               campaign=req.campaign, step=lane.counter)
                 if (req.ckpt_every and lane.counter
                         and lane.counter % req.ckpt_every == 0
                         and lane.counter < req.n_steps):
-                    eng.save_member(lane.ckpt_dir, lane.counter,
-                                    lane.index,
-                                    max_to_keep=self._max_to_keep)
+                    with self.tracer.span("checkpoint",
+                                          tenant=req.tenant,
+                                          step=lane.counter):
+                        eng.save_member(lane.ckpt_dir, lane.counter,
+                                        lane.index,
+                                        max_to_keep=self._max_to_keep)
+                    self._m_checkpoints.inc()
                     self._log("checkpoint", tenant=req.tenant,
                               campaign=req.campaign, step=lane.counter)
                 if lane.counter >= req.n_steps:
@@ -495,7 +671,11 @@ class CampaignService:
                                     lane.index,
                                     meta_extra={"completed": True},
                                     max_to_keep=self._max_to_keep)
+                    self._m_checkpoints.inc()
                     poll_snapshots(block=True)
                     self._complete_lane(eng, lane)
         poll_snapshots(block=True)
+        elapsed = time.perf_counter() - t_batch
+        if steps_advanced and elapsed > 0:
+            self._m_steps_per_s.set(steps_advanced / elapsed)
         self._log("batch_finished", fingerprint=fp)

@@ -1,0 +1,60 @@
+"""Unified telemetry: spans, in-graph step metrics, and a metrics
+surface.
+
+The ROADMAP north star is serving heavy traffic; you cannot operate a
+fleet you cannot see. This package makes observability a subsystem
+instead of a convention, in three layers that share one identity (run
+id, span id, schema version):
+
+* **Structured spans** (:mod:`.spans`) — a thread-safe tracer whose
+  spans (campaign -> segment -> exchange/compute/checkpoint/tune) are
+  simultaneously ``jax.named_scope`` + ``TraceAnnotation`` ranges
+  (correlating with XLA profiler output, via
+  ``utils/profiling.scope``) and exportable records, dumped as Chrome
+  trace-event JSON for Perfetto.
+
+* **In-graph step metrics** (:mod:`.probe`) — cheap on-device counters
+  (sub-steps, model-exact wire bytes) that ride the health sentinel's
+  ONE existing all-reduce; the ``telemetry.*`` stencil-lint registry
+  targets prove the instrumented production step adds zero collectives
+  and zero wire bytes.
+
+* **Metrics registry** (:mod:`.metrics`, :mod:`.http`) — labeled
+  counters/gauges/histograms with Prometheus text exposition and JSON
+  snapshots; ``CampaignService.metrics_text()``, the stdlib
+  ``/metrics`` endpoint (``apps/serve.py --metrics-port``), and the
+  ``python -m stencil_tpu.telemetry`` CLI are the surfaces.
+
+* **One event schema** (:mod:`.events`) — the resilience driver and
+  the campaign service emit through the same versioned
+  :class:`EventLog` (run id, monotonic seq, span id) with pluggable
+  sinks: bounded in-memory ring, JSONL file, caller-owned list.
+
+Metric names, labels, and the event schema version are a stable
+contract — see README "Observability".
+"""
+
+from .events import (EVENT_SCHEMA_VERSION, EventLog, JsonlSink,
+                     ListSink, RingSink, StreamJsonSink, new_run_id,
+                     validate_events)
+from .http import MetricsServer
+from .metrics import (DEFAULT_BUCKETS, METRICS_SCHEMA_VERSION, Counter,
+                      Gauge, Histogram, MetricsRegistry, get_registry,
+                      metric_value, parse_prometheus_text,
+                      render_snapshot_text, snapshot_value)
+from .probe import STEP_METRIC_NAMES, StepMetrics, step_metrics_for
+from .spans import (Span, Tracer, get_tracer, set_tracer,
+                    validate_chrome_trace)
+
+__all__ = [
+    "EVENT_SCHEMA_VERSION", "METRICS_SCHEMA_VERSION",
+    "EventLog", "ListSink", "RingSink", "JsonlSink", "StreamJsonSink",
+    "new_run_id", "validate_events",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "DEFAULT_BUCKETS", "metric_value", "parse_prometheus_text",
+    "snapshot_value", "render_snapshot_text",
+    "MetricsServer",
+    "Span", "Tracer", "get_tracer", "set_tracer",
+    "validate_chrome_trace",
+    "STEP_METRIC_NAMES", "StepMetrics", "step_metrics_for",
+]

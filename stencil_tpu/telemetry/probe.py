@@ -1,0 +1,109 @@
+"""In-graph step metrics: counters that ride the health probe.
+
+The resilient run loop already pays for exactly ONE small all-reduce
+per probe (``resilience/health.py``, pinned at the StableHLO level).
+Telemetry must not add a second one — TEMPI-style (arXiv:2012.14363),
+it interposes on the communication that already exists instead of
+issuing its own. :class:`StepMetrics` packs cheap on-device counters
+into extra columns of the probe's stacked stats vector, so the one
+existing all-reduce carries them for free:
+
+* ``substeps``   — cumulative member steps completed at probe time;
+* ``wire_bytes`` — cumulative exchanged wire bytes, priced by the same
+  calibrated byte model the static analyzer cross-checks EXACTLY
+  against lowered HLO (``analysis/costmodel.py``), amortized across
+  temporal blocking — so "bytes on the wire so far" is the HLO-exact
+  figure, not an estimate.
+
+Proven contracts (``telemetry.*`` stencil-lint registry targets):
+the instrumented probe still lowers to exactly 1 all_reduce; the
+instrumented PRODUCTION Jacobi step still lowers to 6 collective
+permutes + exactly 1 all_reduce; and its exchange bytes still match
+the analytic model exactly — instrumentation adds zero collectives and
+zero wire bytes. ``tests/fixtures/lint/bad_probe_metrics.py`` is the
+negative control (a metrics probe that pays its own all-reduce).
+
+Values travel as f32 (the probe vector's dtype): exact up to 2**24,
+documented rounding beyond — fine for smoke-scale counters; fleet
+dashboards track rates, not 53-bit totals.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import numpy as np
+
+#: the step-metric columns, in probe-vector order
+STEP_METRIC_NAMES: Tuple[str, ...] = ("substeps", "wire_bytes")
+
+
+class StepMetrics:
+    """The on-device counter block for one realized domain.
+
+    Plug into :class:`~stencil_tpu.resilience.health.HealthSentinel`
+    via its ``metrics=`` argument: the sentinel appends
+    ``values(step)`` as extra probe columns and decodes them back into
+    ``HealthStats.metrics``.
+
+    Counters are keyed to the campaign position ``step``: wire bytes
+    for steps up to ``base_step`` were priced at the configuration(s)
+    in force when they ran (carried in ``base_bytes``); steps beyond it
+    are priced at this domain's current per-step figure. A mid-run
+    reconfiguration (degradation ladder) must hand the old counter to
+    :meth:`rebased` so the new price applies only to future steps —
+    never retroactively. Steps re-executed after a rollback are not
+    double-counted by design: the counter tracks campaign progress,
+    not dispatch count."""
+
+    names: Tuple[str, ...] = STEP_METRIC_NAMES
+
+    def __init__(self, dd, base_step: int = 0,
+                 base_bytes: float = 0.0) -> None:
+        #: whole-mesh modeled wire bytes per STEP (amortized across
+        #: temporal blocking) — the figure the costmodel checker
+        #: proves equals the lowered HLO's bytes
+        self.bytes_per_step = float(dd.exchange_bytes_amortized_per_step())
+        self.base_step = int(base_step)
+        self.base_bytes = float(base_bytes)
+
+    def cumulative_bytes(self, step: int) -> float:
+        """Modeled wire bytes for the campaign's first ``step`` steps."""
+        return self.base_bytes + \
+            max(0, int(step) - self.base_step) * self.bytes_per_step
+
+    def rebased(self, dd, step: int) -> "StepMetrics":
+        """The counter block for a reconfigured domain, carrying the
+        bytes already accounted at ``step`` so the new configuration's
+        price applies only from here on."""
+        return StepMetrics(dd, base_step=step,
+                           base_bytes=self.cumulative_bytes(step))
+
+    def values(self, step: int):
+        """The replicated f32 metrics vector for a probe of ``step``."""
+        import jax.numpy as jnp
+
+        step = int(step)
+        return jnp.asarray([float(step), self.cumulative_bytes(step)],
+                           dtype=jnp.float32)
+
+    def decode(self, metrics: Dict[str, float]) -> Dict[str, float]:
+        """Derived figures from harvested probe metrics: the raw
+        cumulative counters plus the amortized B/step they imply (the
+        model-vs-probe agreement the run-loop metrics export)."""
+        out = dict(metrics)
+        steps = out.get("substeps", 0.0)
+        out["bytes_per_step_probe"] = (out.get("wire_bytes", 0.0) / steps
+                                       if steps else 0.0)
+        out["bytes_per_step_model"] = self.bytes_per_step
+        return out
+
+
+def step_metrics_for(dd):
+    """A :class:`StepMetrics` for ``dd``, or None when the domain has
+    no exchange byte model to ride (never raises — telemetry must not
+    take down the loop it observes)."""
+    try:
+        return StepMetrics(dd)
+    except Exception:  # noqa: BLE001 - absent model/engine -> no metrics
+        return None

@@ -6,15 +6,37 @@ The analog of the reference's compile-time-leveled macros
 info); messages are prefixed with the jax process index the way the
 reference prefixes the MPI rank. LOG_FATAL raises instead of exit(1) —
 fail-fast, but catchable.
+
+Format selected by ``STENCIL_TPU_LOG_FORMAT`` (alias
+``STENCIL_LOG_FORMAT``): ``text`` (default, unchanged) or ``json`` —
+each record routed through the unified telemetry event schema
+(:mod:`..telemetry.events`: run id, monotonic seq, schema version) and
+printed as one JSON line to stderr, so fleet log scrapers read logs
+and service/resilience event streams in ONE format:
+``{"event": "log", "time": ..., "run": ..., "seq": ..., "schema": 1,
+"level": "info", "rank": 0, "message": ...}``.
 """
 
 from __future__ import annotations
 
 import os
 import sys
+import threading
 
 _LEVELS = {"spew": 0, "debug": 1, "info": 2, "warn": 3, "error": 4, "fatal": 5}
 _level = _LEVELS.get(os.environ.get("STENCIL_TPU_LOG", "info").lower(), 2)
+
+_FORMATS = ("text", "json")
+_format = (os.environ.get("STENCIL_TPU_LOG_FORMAT")
+           or os.environ.get("STENCIL_LOG_FORMAT", "text")).lower()
+if _format not in _FORMATS:
+    _format = "text"
+
+#: lazily-built process-wide EventLog for json-format records (one run
+#: id, one monotonic sequence for every LOG_* line this process emits;
+#: the lock keeps first-use races from minting two run ids)
+_json_log = None
+_json_log_lock = threading.Lock()
 
 
 def _rank() -> int:
@@ -25,9 +47,24 @@ def _rank() -> int:
         return 0
 
 
+def _emit_json(tag: str, msg: str) -> None:
+    global _json_log
+    log = _json_log
+    if log is None:
+        with _json_log_lock:
+            if _json_log is None:
+                from ..telemetry.events import EventLog, StreamJsonSink
+                _json_log = EventLog(sinks=(StreamJsonSink(),))
+            log = _json_log
+    log.emit("log", level=tag.lower(), rank=_rank(), message=msg)
+
+
 def _emit(tag: str, lvl: int, msg: str) -> None:
     if lvl >= _level:
-        print(f"[{_rank()}] {tag}: {msg}", file=sys.stderr)
+        if _format == "json":
+            _emit_json(tag, msg)
+        else:
+            print(f"[{_rank()}] {tag}: {msg}", file=sys.stderr)
 
 
 def LOG_SPEW(msg: str) -> None:
@@ -62,3 +99,13 @@ def LOG_FATAL(msg: str) -> None:
 def set_level(name: str) -> None:
     global _level
     _level = _LEVELS[name.lower()]
+
+
+def set_format(name: str) -> None:
+    """Switch the record format at runtime (``text`` | ``json``)."""
+    global _format
+    name = name.lower()
+    if name not in _FORMATS:
+        raise ValueError(f"log format must be one of {_FORMATS}, "
+                         f"got {name!r}")
+    _format = name

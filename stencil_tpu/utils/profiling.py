@@ -8,6 +8,13 @@ src/stencil.cu:36-48,1174-1181). On TPU: ``jax.named_scope`` labels ops
 in the XLA profile the way NVTX labels CUDA streams, and
 ``jax.profiler`` produces the nsys-equivalent trace viewable in
 TensorBoard/Perfetto.
+
+:func:`scope` is also the substrate of the structured-span layer:
+``stencil_tpu.telemetry.Tracer.span`` wraps it, so every telemetry
+span is simultaneously a ``named_scope``/``TraceAnnotation`` range
+(correlating with XLA profiler output) AND an exportable record with a
+stable id — dumped as Perfetto-loadable Chrome trace JSON without a
+profiler session (see README "Observability").
 """
 
 from __future__ import annotations

@@ -1,0 +1,61 @@
+"""Negative control for the telemetry step-metrics contract.
+
+Telemetry's license to instrument the production step loop is that its
+counters PIGGYBACK on the health probe's one existing all-reduce
+(``stencil_tpu/telemetry/probe.py``): extra columns in the stacked
+stats vector, one pmax, zero additional collectives — pinned by
+``exact_counts`` on the ``telemetry.*`` registry targets. This fixture
+is the tempting shortcut that breaks the contract without changing any
+*result*: reducing the metrics vector with its OWN ``pmax`` instead of
+stacking it into the health vector first — numerically identical
+metrics, but every instrumented probe step now pays a second
+all-reduce launch on the fabric the telemetry is supposed to be
+observing, not taxing. ``python -m stencil_tpu.analysis
+tests/fixtures/lint/bad_probe_metrics.py`` MUST exit nonzero.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stencil_tpu.analysis import HloSpec, HloTarget
+from stencil_tpu.resilience.health import probe_shard
+
+
+def _f32(shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _separate_metrics_reduce_spec() -> HloSpec:
+    """Health stats reduced once, metrics reduced AGAIN separately: 2
+    all-reduces where the shipped instrumentation does 1. Sold under
+    the shipped contract (exactly one all_reduce) — the checker must
+    flag it."""
+    import numpy as np
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("z", "y", "x"))
+    axes = ("z", "y", "x")
+
+    def shard(a, b, metrics_vec):
+        # the health stats still reduce correctly in one pmax...
+        stats = probe_shard({"a": a, "b": b})
+        # ...but the bug pays a SECOND all-reduce for the metrics
+        # instead of stacking them into the probe vector first
+        reduced_metrics = jax.lax.pmax(metrics_vec, axes)
+        return stats, reduced_metrics
+
+    spec = P("z", "y", "x")
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(spec, spec, P()),
+                       out_specs=(P(), P()), check_vma=False)
+    return HloSpec(fn=sm,
+                   args=(_f32((16, 16, 16)), _f32((16, 16, 16)),
+                         _f32((2,))),
+                   allow=("all_reduce",),
+                   exact_counts={"all_reduce": 1})
+
+
+TARGETS = [
+    HloTarget("bad_probe_metrics.separate_reduce[hlo]",
+              _separate_metrics_reduce_spec),
+]

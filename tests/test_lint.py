@@ -87,11 +87,14 @@ def test_hlo_registry_collective_permute_only():
         if "allgather" in key.lower():
             assert kinds == {"all_gather"}, (key, kinds)
         elif ("resilience.health" in key
-              or "serving.ensemble.probe" in key):
+              or "serving.ensemble.probe" in key
+              or "telemetry." in key):
             # the health sentinels' contract is different by design:
             # exactly ONE small all-reduce (pinned via exact_counts on
             # their HloSpecs; the ensemble probe batches per-member
-            # stats through the same single reduce)
+            # stats through the same single reduce, and the telemetry
+            # step-metrics columns ride that same reduce — never a
+            # second one)
             assert kinds <= {"collective_permute", "all_reduce"}, \
                 (key, kinds)
         else:
@@ -304,13 +307,15 @@ def test_cli_list_and_only(capsys, tmp_path):
 @pytest.mark.parametrize("fixture", ["bad_footprint.py", "bad_dma.py",
                                      "bad_collective.py", "bad_hlo.py",
                                      "bad_vmem.py", "bad_temporal.py",
-                                     "bad_plan.py", "bad_probe.py"])
+                                     "bad_plan.py", "bad_probe.py",
+                                     "bad_probe_metrics.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""
     from stencil_tpu.analysis.__main__ import main
 
-    if fixture in ("bad_hlo.py", "bad_plan.py", "bad_probe.py"):
+    if fixture in ("bad_hlo.py", "bad_plan.py", "bad_probe.py",
+                   "bad_probe_metrics.py"):
         from stencil_tpu.analysis.hlo import lowering_supported
 
         if not lowering_supported():

@@ -1,0 +1,165 @@
+"""utils/profiling: scopes, phase timers, and report renderers.
+
+Previously untested (ISSUE 7 satellite): ``scope`` must nest
+``named_scope`` without breaking tracing (it is the substrate every
+telemetry span stands on), ``PhaseTimer`` must accumulate repeated
+phases, and the report renderers must produce their documented lines
+against golden inputs.
+"""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.utils.profiling import (PhaseTimer, autotune_report,
+                                         exchange_stats_report, scope,
+                                         setup_stats_report)
+
+
+# ---------------------------------------------------------------------------
+# scope
+
+
+def test_scope_nests_without_breaking_tracing():
+    def fn(x):
+        with scope("outer"):
+            y = x + 1.0
+            with scope("inner"):
+                y = y * 2.0
+        return y
+
+    out = jax.jit(fn)(jnp.asarray([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(out), [4.0, 6.0])
+
+
+def test_scope_labels_reach_traced_ops():
+    """The named_scope half of ``scope``: traced ops inside the block
+    carry the label on their name stack (what XLA turns into op
+    metadata in the profile)."""
+    def fn(x):
+        with scope("golden-scope-name"):
+            return jnp.sin(x)
+
+    closed = jax.make_jaxpr(fn)(jnp.ones(4))
+    stacks = [str(eqn.source_info.name_stack)
+              for eqn in closed.jaxpr.eqns]
+    assert any("golden-scope-name" in s for s in stacks), stacks
+
+
+def test_scope_works_outside_tracing():
+    with scope("host-only"):
+        assert 1 + 1 == 2
+
+
+# ---------------------------------------------------------------------------
+# PhaseTimer
+
+
+def test_phase_timer_accumulates_repeated_phases(monkeypatch):
+    import stencil_tpu.utils.profiling as prof
+
+    ticks = iter([0.0, 1.0, 10.0, 12.5, 20.0, 20.25])
+    monkeypatch.setattr(prof.time, "perf_counter", lambda: next(ticks))
+    t = PhaseTimer()
+    with t.phase("exchange"):
+        pass  # 1.0s
+    with t.phase("exchange"):
+        pass  # +2.5s
+    with t.phase("compute"):
+        pass  # 0.25s
+    assert t.seconds["exchange"] == pytest.approx(3.5)
+    assert t.seconds["compute"] == pytest.approx(0.25)
+
+
+def test_phase_timer_accumulates_across_exceptions(monkeypatch):
+    import stencil_tpu.utils.profiling as prof
+
+    ticks = iter([0.0, 2.0])
+    monkeypatch.setattr(prof.time, "perf_counter", lambda: next(ticks))
+    t = PhaseTimer()
+    with pytest.raises(RuntimeError):
+        with t.phase("doomed"):
+            raise RuntimeError("boom")
+    assert t.seconds["doomed"] == pytest.approx(2.0)
+
+
+def test_phase_timer_reduced_single_process_identity():
+    t = PhaseTimer()
+    t.seconds = {"a": 1.5, "b": 0.25}
+    assert t.reduced() == {"a": 1.5, "b": 0.25}
+
+
+# ---------------------------------------------------------------------------
+# report renderers (golden inputs)
+
+
+def _fake_dd(**kw):
+    dd = types.SimpleNamespace(
+        setup_seconds={"partition": 0.5, "realize": 1.25},
+        exchange_seconds=[], exchange_every=1,
+        plan_provenance="default")
+    for k, v in kw.items():
+        setattr(dd, k, v)
+    return dd
+
+
+def test_setup_stats_report_golden():
+    line = setup_stats_report(_fake_dd())
+    assert line == "setup: partition=0.500000s realize=1.250000s"
+
+
+def test_exchange_stats_report_no_samples():
+    assert exchange_stats_report(_fake_dd()) == \
+        "exchange: no samples (enable_timing first)"
+
+
+def test_exchange_stats_report_golden():
+    dd = _fake_dd(exchange_seconds=[2e-3, 2e-3, 2e-3, 2e-3],
+                  exchange_bytes_total=lambda: 4_000_000)
+    line = exchange_stats_report(dd)
+    assert "n=4" in line
+    assert "trimean=2.000000e-03s" in line
+    assert "expected=4000000B/exchange (analytic)" in line
+    assert "eff=2.00GB/s" in line
+    assert "amortized" not in line   # s=1: no temporal line
+    assert "plan=" not in line       # default provenance: silent
+
+
+def test_exchange_stats_report_temporal_and_provenance():
+    dd = _fake_dd(exchange_seconds=[4e-3] * 4, exchange_every=4,
+                  exchange_bytes_total=lambda: 8_000_000,
+                  exchange_bytes_amortized_per_step=lambda: 2_000_000.0,
+                  plan_provenance="cached")
+    line = exchange_stats_report(dd)
+    assert "exchange_every=4" in line
+    assert "amortized=2000000B/step" in line
+    assert "(1.000000e-03s/step exchange cost)" in line
+    assert line.endswith("plan=cached")
+
+
+def test_autotune_report_golden():
+    cfg = types.SimpleNamespace(key=lambda: "PpermuteSlab[s=8]")
+    plan = types.SimpleNamespace(
+        config=cfg, provenance="tuned", measurements=7,
+        fingerprint="abcdef0123456789",
+        coefficients={"ici": {"alpha_s": 1e-6,
+                              "beta_bytes_per_s": 1e11}},
+        costs={
+            "PpermuteSlab[s=8]": {"predicted_s": 1e-4,
+                                  "measured_s": 9e-5},
+            "AllGather[s=1]": {"predicted_s": 5e-3},
+        })
+    text = autotune_report(plan)
+    lines = text.splitlines()
+    assert lines[0] == ("autotune: PpermuteSlab[s=8] provenance=tuned"
+                        " measurements=7 fingerprint=abcdef012345...")
+    assert "  link ici: alpha=1.000e-06s beta=1.000e+11B/s (measured)" \
+        in lines
+    # ranked by measured-else-predicted: the winner first
+    assert lines[2].startswith("  PpermuteSlab[s=8]: ")
+    assert "measured=9.000e-05s/step" in lines[2]
+    assert lines[3].startswith("  AllGather[s=1]: ")
+    assert "(pruned by model)" in lines[3]
