@@ -34,6 +34,16 @@ def main() -> None:
                          "kernel on the Pallas halo path)")
     ap.add_argument("--overlap", action="store_true",
                     help="interior/exterior comm-compute overlap per substep")
+    ap.add_argument("--fuse-segments",
+                    action=argparse.BooleanOptionalAction, default=False,
+                    help="megastep execution: advance --check-every "
+                         "iterations per dispatch as ONE fused program "
+                         "with the health probe trace in-graph "
+                         "(parallel/megastep.py; XLA path only — fast "
+                         "paths fall back to the classic loop)")
+    ap.add_argument("--check-every", type=int, default=4,
+                    help="megastep segment length (iterations per "
+                         "fused dispatch) for --fuse-segments")
     ap.add_argument("--kernel", default="auto",
                     choices=("auto", "wrap", "halo", "xla"),
                     help="compute path: fused Pallas megakernel (wrap: "
@@ -100,18 +110,42 @@ def main() -> None:
     it = start_iter
     last_saved = None
 
+    segment = None
+    if args.fuse_segments:
+        segment = m.make_segment(max(args.check_every, 1))
+        if segment is None:
+            import sys
+            print("# --fuse-segments: no fused-segment support on the "
+                  f"'{m.kernel_path}' path; using the classic loop",
+                  file=sys.stderr)
+
     def counted_step():
         nonlocal it, last_saved
-        m.step()
-        it += 1
+        prev = it
+        if segment is not None:
+            # one fused dispatch advances check_every iterations with
+            # the in-graph probe trace (discarded here — the timed
+            # sample measures the production megastep as dispatched)
+            segment.run(it)
+            it += segment.steps
+        else:
+            m.step()
+            it += 1
+        # "crossed a checkpoint boundary" rather than an exact modulus:
+        # a fused sample advances several iterations at once, and the
+        # requested cadence must not silently skip when check_every
+        # does not divide checkpoint_every
         if (args.checkpoint_dir and args.checkpoint_every
-                and it % args.checkpoint_every == 0):
+                and it // args.checkpoint_every
+                > prev // args.checkpoint_every):
             from stencil_tpu.utils.checkpoint import save_domain
             m.sync_domain()
             save_domain(m.dd, args.checkpoint_dir, it, extra=m._w)
             last_saved = it
 
-    stats = timed_samples(counted_step, m.block, args.iters)
+    samples = (max(args.iters // segment.steps, 1)
+               if segment is not None else args.iters)
+    stats = timed_samples(counted_step, m.block, samples)
     if args.checkpoint_dir and last_saved != it:
         from stencil_tpu.utils.checkpoint import save_domain
         m.sync_domain()
@@ -130,8 +164,12 @@ def main() -> None:
         # without this the dump would be the initial condition
         m.sync_domain()
         m.dd.write_paraview(args.prefix + "final")
+    # per-ITERATION trimean regardless of dispatch granularity (a
+    # fused segment sample covers segment.steps iterations)
+    per_iter = stats.trimean() / (segment.steps if segment is not None
+                                  else 1)
     print(csv_line(ndev, gx, gy, gz,
-                   f"{stats.trimean():.6e}", f"{exch:.6e}",
+                   f"{per_iter:.6e}", f"{exch:.6e}",
                    xstats["path"], int(xstats["bytes_per_iteration"])))
 
 

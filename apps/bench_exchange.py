@@ -60,6 +60,14 @@ def main() -> None:
                          "stencil_bench_bytes_per_step_model{...}) so "
                          "BENCH_*.json and the metrics surface agree "
                          "on one figure")
+    ap.add_argument("--fuse-segments", action="store_true",
+                    help="race megastep execution (ONE fused dispatch "
+                         "per --check-every steps, health probe trace "
+                         "in-graph; parallel/megastep.py) against the "
+                         "per-step dispatch loop on the same Jacobi "
+                         "problem")
+    ap.add_argument("--check-every", type=int, default=8,
+                    help="megastep segment length for --fuse-segments")
     ap.add_argument("--autotune", action="store_true",
                     help="run the exchange autotuner (measured plan, "
                          "stencil_tpu/tuning) and compare tuned vs "
@@ -196,6 +204,81 @@ def main() -> None:
               f"{base_sps:.3f} steps/s "
               f"(x{tuned_sps / base_sps:.2f})", file=sys.stderr)
 
+    fused_cmp = None
+    if args.fuse_segments:
+        # fused megastep vs the per-step dispatch loop the megastep
+        # replaced (resilience/driver.py's stepwise mode at
+        # check_every=1): one jitted STEP dispatch + one health-probe
+        # dispatch per Python iteration on the baseline side, ONE
+        # fused dispatch per k steps with the same per-step probes
+        # riding in-graph on the megastep side. Same problem, same
+        # health coverage — only the host/device boundary moves. The
+        # race runs the per-device smoke size on ONE device: that is
+        # the dispatch-bound regime the megastep targets (on the
+        # multi-threaded fake CPU mesh, in-program thread sync — which
+        # fusion cannot remove — swamps the dispatch signal).
+        from stencil_tpu.resilience.health import HealthSentinel
+
+        k = max(args.check_every, 1)
+        n = max(args.iters, k)
+        n -= n % k
+        dev1 = jax.devices()[:1]
+
+        js = Jacobi3D(args.x, args.y, args.z, mesh_shape=(1, 1, 1),
+                      devices=dev1, dtype=np.float32, kernel="xla",
+                      methods=methods_from_args(args))
+        js.init()
+        sentinel = HealthSentinel(js.dd)
+        js.step()          # compile + warm outside the timed window
+        sentinel.probe(js.dd.curr, 0)
+        sentinel.poll(block=True)
+        js.block()
+        t0 = time.perf_counter()
+        for i in range(n):
+            js.step()
+            sentinel.probe(js.dd.curr, i + 1)
+            sentinel.poll()
+        sentinel.poll(block=True)
+        js.block()
+        step_dt = time.perf_counter() - t0
+
+        jf = Jacobi3D(args.x, args.y, args.z, mesh_shape=(1, 1, 1),
+                      devices=dev1, dtype=np.float32, kernel="xla",
+                      methods=methods_from_args(args))
+        jf.init()
+        fsent = HealthSentinel(jf.dd)
+        seg = jf.make_segment(k)
+        tr = seg.run(0)    # compile + warm
+        fsent.observe_segment(tr.array, tr.abs_steps)
+        fsent.poll(block=True)
+        fsent.reset()
+        jf.block()
+        t0 = time.perf_counter()
+        done = 0
+        while done < n:
+            tr = seg.run(done)
+            done += k
+            fsent.observe_segment(tr.array, tr.abs_steps)
+            fsent.poll()
+        fsent.poll(block=True)
+        jf.block()
+        fused_dt = time.perf_counter() - t0
+
+        fused_cmp = {
+            "check_every": k,
+            "steps": n,
+            "stepwise_steps_per_s": n / step_dt,
+            "fused_steps_per_s": n / fused_dt,
+            "fused_over_stepwise": step_dt / fused_dt,
+        }
+        print(csv_line("bench_exchange_megastep", k, n,
+                       f"{n / step_dt:.3f}", f"{n / fused_dt:.3f}",
+                       f"{step_dt / fused_dt:.3f}"))
+        print(f"bench_exchange megastep: fused[k={k}] "
+              f"{n / fused_dt:.3f} steps/s vs per-step dispatch "
+              f"{n / step_dt:.3f} steps/s "
+              f"(x{step_dt / fused_dt:.2f})", file=sys.stderr)
+
     if args.json_out:
         base = results[0]
         results_by_s = {str(r["exchange_every"]): r for r in results}
@@ -222,6 +305,8 @@ def main() -> None:
         }
         if autotune_cmp is not None:
             comparison["autotune"] = autotune_cmp
+        if fused_cmp is not None:
+            comparison["fused"] = fused_cmp
         with open(args.json_out, "w") as f:
             json.dump(comparison, f, indent=2)
         print(f"bench_exchange: wrote {args.json_out}", file=sys.stderr)
@@ -253,6 +338,18 @@ def main() -> None:
                         config="tuned")
             g_tuned.set(autotune_cmp["default_steps_per_s"],
                         config="default")
+        if fused_cmp is not None:
+            g_fused = reg.gauge(
+                "stencil_bench_fused_steps_per_s",
+                "megastep race: steps/s by dispatch mode (fused = "
+                "one program per check_every steps incl. the "
+                "in-graph probe trace; stepwise = one step + one "
+                "probe dispatch per step)")
+            ck = str(fused_cmp["check_every"])
+            g_fused.set(fused_cmp["fused_steps_per_s"],
+                        mode="fused", check_every=ck)
+            g_fused.set(fused_cmp["stepwise_steps_per_s"],
+                        mode="stepwise", check_every=ck)
         reg.write_snapshot(args.metrics_json)
         print(f"bench_exchange: metrics snapshot -> "
               f"{args.metrics_json}", file=sys.stderr)

@@ -41,7 +41,8 @@ def _run_resilient(j, args) -> None:
     policy = ResiliencePolicy(check_every=args.check_every,
                               ckpt_every=args.ckpt_every,
                               max_retries=args.max_retries,
-                              base_delay=0.01)
+                              base_delay=0.01,
+                              fuse_segments=args.fuse_segments)
     report = j.run_resilient(args.iters, policy=policy,
                              ckpt_dir=args.ckpt_dir or None,
                              faults=plan)
@@ -101,7 +102,18 @@ def main() -> None:
                           "resume from it on the next invocation)")
     res.add_argument("--ckpt-every", type=int, default=10)
     res.add_argument("--check-every", type=int, default=1,
-                     help="health-sentinel probe cadence (steps)")
+                     help="health-sentinel boundary cadence (steps); "
+                          "with --fuse-segments this is also the "
+                          "megastep segment length")
+    res.add_argument("--fuse-segments",
+                     action=argparse.BooleanOptionalAction,
+                     default=True,
+                     help="megastep execution (default on): dispatch "
+                          "ONE fused program per check_every boundary "
+                          "with the health probe trace in-graph "
+                          "(parallel/megastep.py); "
+                          "--no-fuse-segments restores the per-step "
+                          "dispatch loop")
     res.add_argument("--max-retries", type=int, default=3)
     res.add_argument("--events-json", default="",
                      help="write the resilience event log (JSON) here")

@@ -69,6 +69,13 @@ def main() -> int:
     p.add_argument("--trace-json", default="", metavar="PATH",
                    help="write the Chrome trace-event JSON of the "
                         "service spans here (load in Perfetto)")
+    p.add_argument("--fuse-segments",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="megastep serving (default on): each batch "
+                        "segment is ONE fused dispatch carrying the "
+                        "per-member probe trace in-graph; "
+                        "--no-fuse-segments restores the step-loop + "
+                        "separate-probe path")
     p.add_argument("--fake-timer", action="store_true",
                    help="tune exchange plans with the deterministic "
                         "FakeTimer (CI: no hardware dependence)")
@@ -85,7 +92,8 @@ def main() -> int:
     svc = CampaignService(
         root, width=args.width,
         tuner_timer=FakeTimer() if args.fake_timer else None,
-        plan_cache_path=args.tune_cache or None)
+        plan_cache_path=args.tune_cache or None,
+        fuse_segments=args.fuse_segments)
 
     metrics_server = None
     if args.metrics_port >= 0:

@@ -125,6 +125,7 @@ TUNE_CACHE="$(mktemp -t tune_cache.XXXXXX.json)"; rm -f "$TUNE_CACHE"
 ( cd apps
   python bench_exchange.py --x 8 --y 8 --z 8 --iters 20 --fake-cpu 8 \
         --exchange-every 1,4 --autotune --tune-cache "$TUNE_CACHE" \
+        --fuse-segments --check-every 8 \
         --json-out "$BENCH_JSON" --metrics-json "$BENCH_METRICS" )
 BENCH_JSON="$BENCH_JSON" BENCH_METRICS="$BENCH_METRICS" python - <<'EOF'
 import json
@@ -149,16 +150,36 @@ assert speed["4"] > 0.8, speed
 at = d["autotune"]
 assert at["plan"]["provenance"] in ("tuned", "cached"), at["plan"]
 assert at["tuned_over_default"] > 0.8, at
+# megastep gate: ONE fused dispatch per check_every steps must beat the
+# per-step dispatch loop >= 1.5x at the dispatch-bound smoke size
+# (committed BENCH_pr8.json pins the PR-time numbers; this re-measures)
+fz = d["fused"]
+assert fz["fused_over_stepwise"] >= 1.5, fz
+ck = str(fz["check_every"])
+for mode, key in (("fused", "fused_steps_per_s"),
+                  ("stepwise", "stepwise_steps_per_s")):
+    got = snapshot_value(snap, "stencil_bench_fused_steps_per_s",
+                         mode=mode, check_every=ck)
+    assert got == fz[key], (mode, got, fz[key])
 print(f"bench smoke OK: rounds/step x{1/rounds['4']:.0f} fewer, "
       f"steps/s ratio {speed['4']:.2f}, tuned/default "
       f"x{at['tuned_over_default']:.2f} "
       f"({at['plan']['config']['method']}"
-      f"[s={at['plan']['config']['exchange_every']}])")
+      f"[s={at['plan']['config']['exchange_every']}]), "
+      f"megastep fused/stepwise x{fz['fused_over_stepwise']:.2f} "
+      f"[k={ck}]")
 EOF
 if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
   mkdir -p "$CI_ARTIFACT_DIR"
   cp "$BENCH_JSON" "$CI_ARTIFACT_DIR/BENCH_pr4.json"
+  cp "$BENCH_JSON" "$CI_ARTIFACT_DIR/BENCH_pr8.json"
   cp "$BENCH_METRICS" "$CI_ARTIFACT_DIR/bench_metrics.json"
+  # the megastep ratio, archived standalone for trend dashboards
+  python - "$BENCH_JSON" > "$CI_ARTIFACT_DIR/megastep_ratio.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+json.dump(d["fused"], sys.stdout, indent=1)
+EOF
 fi
 rm -f "$BENCH_JSON" "$BENCH_METRICS" "$TUNE_CACHE"
 
@@ -204,9 +225,9 @@ CHAOS_CKPT="$(mktemp -d -t chaos_ckpt.XXXXXX)"
 CHAOS_EVENTS="$(mktemp -t chaos_events.XXXXXX.json)"
 ( cd apps
   python jacobi3d.py --x 8 --y 8 --z 8 --iters 12 --fake-cpu 8 \
-        --resilient --ckpt-dir "$CHAOS_CKPT" --ckpt-every 4 \
-        --check-every 1 --chaos-nan 6 --chaos-save-fail 4 \
-        --events-json "$CHAOS_EVENTS" )
+        --resilient --fuse-segments --ckpt-dir "$CHAOS_CKPT" \
+        --ckpt-every 4 --check-every 1 --chaos-nan 6 \
+        --chaos-save-fail 4 --events-json "$CHAOS_EVENTS" )
 CHAOS_EVENTS="$CHAOS_EVENTS" python - <<'EOF'
 import json
 import os

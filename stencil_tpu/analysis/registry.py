@@ -843,6 +843,92 @@ def _telemetry_step_probe_cost() -> CostModelSpec:
 
 
 # ---------------------------------------------------------------------------
+# megastep targets: the whole-campaign fused segment
+# (parallel/megastep.py). A check_every=k segment must compile to ONE
+# program whose collective bill is exactly k x the per-step
+# collective_permute count plus ONE small all-reduce per probe row and
+# NOTHING else, with the exchange bytes exactly k x the per-step
+# analytic model — the fusion can neither smuggle in hidden
+# communication nor re-reduce the probe per sub-step
+# (tests/fixtures/lint/bad_megastep.py is that negative control).
+
+_MEGASTEP_K = 4
+_MEGASTEP_PROBE_EVERY = 2
+
+
+def _megastep_segment_fn(probe_every: int = _MEGASTEP_PROBE_EVERY):
+    """The production fused segment over the jacobi shard step: k
+    steps + the metric-carrying probe every ``probe_every`` sub-steps,
+    built with the same ``fused_segment_shard`` machinery the model
+    and driver deploy. Shared by the hlo gate and the byte cross-check
+    so both audit one program."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3
+    from ..models.jacobi import jacobi_shard_step
+    from ..parallel.exchange import shard_origin
+    from ..parallel.megastep import (fused_segment_shard, health_probe,
+                                     segment_chunks)
+    from ..parallel.mesh import mesh_dim
+    from ..parallel.methods import Method
+    from ..telemetry.probe import STEP_METRIC_NAMES
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = _exchange_radius("r1")
+    local = Dim3(12, 12, 12)
+    gsize = Dim3(24, 24, 24)
+
+    def shard(p, vec):
+        origin = shard_origin(local, Dim3(0, 0, 0))
+
+        def advance(q, c, i):
+            return jacobi_shard_step(q, radius, counts, local, gsize,
+                                     origin, Method.PpermuteSlab)
+
+        probe = health_probe(lambda q: {"temp": q}, base_vec=vec,
+                             metric_names=STEP_METRIC_NAMES,
+                             bytes_per_step=1.0)
+        return fused_segment_shard(p, advance, probe,
+                                   segment_chunks(_MEGASTEP_K),
+                                   probe_every)
+
+    spec = P("z", "y", "x")
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(spec, P()),
+                       out_specs=(spec, P()), check_vma=False)
+    vec = jax.ShapeDtypeStruct((2,), jnp.float32)
+    return sm, (_f32(_EXCHANGE_GLOBAL), vec)
+
+
+def _megastep_segment_hlo() -> HloSpec:
+    fn, args = _megastep_segment_fn()
+    n_probes = -(-_MEGASTEP_K // _MEGASTEP_PROBE_EVERY)
+    # k x the per-step slab sweep's 6 collective-permutes + exactly one
+    # all-reduce per probe row — the whole fused bill, nothing hidden
+    return HloSpec(fn=fn, args=args,
+                   allow=("collective_permute", "all_reduce"),
+                   exact_counts={"collective_permute": 6 * _MEGASTEP_K,
+                                 "all_reduce": n_probes})
+
+
+def _megastep_segment_cost() -> CostModelSpec:
+    """Exact-byte cross-check: the fused segment's exchanges move
+    exactly k x the per-step analytic halo bytes (probe all-reduces
+    are outside ``count_kinds``; their count is pinned above)."""
+    from ..geometry import Dim3
+
+    fn, args = _megastep_segment_fn()
+    expected = _MEGASTEP_K * _sweep_bytes(_exchange_shard_shape(),
+                                          _exchange_radius("r1"),
+                                          Dim3(*_EXCHANGE_MESH), 4)
+    return CostModelSpec(fn=fn, args=args,
+                         expected_bytes_per_shard=expected,
+                         count_kinds=("collective_permute",))
+
+
+# ---------------------------------------------------------------------------
 # VMEM targets: every shipped Pallas kernel's static memory/tiling
 # audit. The overlap/RDMA builders are shared with the dma targets;
 # the single-chip wrap/halo fast-path kernels (previously outside the
@@ -1137,6 +1223,16 @@ def default_targets() -> List[Target]:
                   _telemetry_step_probe_spec),
         CostModelTarget("telemetry.step+probe+metrics[cost]",
                         _telemetry_step_probe_cost),
+    ]
+    # the megastep: a check_every=k fused segment is ONE program with
+    # exactly k x the per-step collective_permutes + one all-reduce per
+    # probe row, bytes exactly k x the per-step model
+    targets += [
+        HloTarget(f"parallel.megastep.segment[k={_MEGASTEP_K},hlo]",
+                  _megastep_segment_hlo),
+        CostModelTarget(
+            f"parallel.megastep.segment[k={_MEGASTEP_K},cost]",
+            _megastep_segment_cost),
     ]
     # static VMEM/tiling audit: every shipped Pallas kernel
     targets += [

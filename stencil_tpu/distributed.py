@@ -441,6 +441,23 @@ class DistributedDomain:
         else:
             self.curr = dict(self._exchange_fn(self.curr))
 
+    def make_segment(self, shard_step, check_every: int,
+                     probe_every: int = 1, metrics=None):
+        """Fuse ``check_every`` applications of ``shard_step`` (per
+        shard: ``fields -> fields`` over the padded quantity dict) plus
+        the in-graph health probe into ONE compiled program — the
+        megastep (``parallel/megastep.py``). The returned
+        :class:`~stencil_tpu.parallel.megastep.Segment` advances
+        ``curr`` in place per ``run()`` and hands back the stacked
+        per-step probe trace; state is donated end-to-end. ``metrics``
+        (a :class:`~stencil_tpu.telemetry.probe.StepMetrics`) rides the
+        telemetry counters on the probe rows."""
+        assert self._exchange_fn is not None, "realize() first"
+        from .parallel.megastep import make_domain_segment
+        return make_domain_segment(self, shard_step, check_every,
+                                   probe_every=probe_every,
+                                   metrics=metrics)
+
     def swap(self) -> None:
         """Swap curr/next bindings (reference: src/local_domain.cu:67-84).
         next_ buffers are created on first use."""

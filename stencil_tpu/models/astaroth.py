@@ -329,6 +329,7 @@ class Astaroth:
 
     # -- fused iteration ----------------------------------------------
     def _build_step(self) -> None:
+        self._segment_builder = None
         dd = self.dd
         radius = dd.radius
         counts = mesh_dim(dd.mesh)
@@ -513,6 +514,66 @@ class Astaroth:
                              in_specs=(spec, spec, P()),
                              out_specs=(spec, spec), check_vma=False)
         self._iter_n = jax.jit(sm_n, donate_argnums=(0, 1))
+        self._set_segment_builder(shard_iter)
+
+    def _set_segment_builder(self, shard_iter) -> None:
+        """Megastep factory for the XLA path: the RK accumulators ride
+        the fused segment as carry next to the fields, both donated
+        end-to-end; the in-graph probe reads the PADDED fields after
+        each full RK3 iteration."""
+        dd = self.dd
+        cache: dict = {}
+
+        def build(k: int, probe_every: int, metrics):
+            from ..parallel import megastep as ms
+
+            chunks = ms.segment_chunks(k)
+            key = (k, probe_every,
+                   None if metrics is None
+                   else float(metrics.bytes_per_step))
+            fn = cache.get(key)
+            if fn is None:
+                spec = P("z", "y", "x")
+                fields_spec = {q: spec for q in FIELDS}
+                fn = ms.make_segment_fn(
+                    dd.mesh,
+                    lambda fw, c, i: shard_iter(*fw),
+                    lambda fw: {q: fw[0][q] for q in FIELDS},
+                    (fields_spec, fields_spec), chunks,
+                    probe_every=probe_every,
+                    metric_names=(metrics.names if metrics is not None
+                                  else ()),
+                    bytes_per_step=(metrics.bytes_per_step
+                                    if metrics is not None else 0.0))
+                cache[key] = fn
+            rel = ms.probe_rel_steps(chunks, probe_every)
+
+            def run(base_step: int):
+                self._ensure_w()
+                vec = ms.metric_base_vec(metrics, base_step)
+                (out_f, out_w), tr = fn(
+                    (dict(self.dd.curr), dict(self._w)), vec)
+                self.dd.curr = dict(out_f)
+                self._w = dict(out_w)
+                return ms.SegmentTrace(tr, rel, base_step)
+
+            return ms.Segment(run, k, rel, fn=fn)
+
+        self._segment_builder = build
+
+    def make_segment(self, check_every: int, probe_every: int = 1,
+                     metrics=None):
+        """ONE compiled program advancing ``check_every`` RK3
+        iterations with the health probe fused in-graph
+        (``parallel/megastep.py``); the ``w`` accumulators travel as
+        segment carry. None on the Pallas fast paths and the temporal
+        path (their in-kernel/grouped loops are already fused) — the
+        resilient driver falls back to stepwise dispatch there."""
+        builder = getattr(self, "_segment_builder", None)
+        if builder is None:
+            return None
+        return builder(int(check_every), max(int(probe_every), 1),
+                       metrics)
 
     def _build_temporal_xla_step(self, comp, store, nonper: bool) -> None:
         """Communication-avoiding XLA iteration: RK substeps run in
@@ -1101,7 +1162,7 @@ class Astaroth:
             new.dd._on_interior_write.clear()
             self.__dict__.update(new.__dict__)
             self.dd.on_interior_write(lambda name: self.sync_domain())
-            return self.dd, self.step
+            return self.dd, self.step, self.make_segment
 
         def on_restore(extras):
             # restored state replaces everything the fast paths cached
@@ -1114,7 +1175,10 @@ class Astaroth:
             extra_fn=lambda: self._w, on_restore=on_restore,
             fields_fn=lambda: (self._inner if self._inner is not None
                                else self.dd.curr),
-            pre_checkpoint=self.sync_domain)
+            pre_checkpoint=self.sync_domain,
+            make_segment=(self.make_segment
+                          if self._segment_builder is not None
+                          else None))
 
 
 # ----------------------------------------------------------------------

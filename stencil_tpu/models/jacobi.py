@@ -284,8 +284,70 @@ class Jacobi3D:
         vals = np.full(zyx_shape(self.dd.size), mean, dtype=self._dtype)
         self.dd.set_interior("temp", vals)
 
+    # -- megastep: whole campaign segments as one program --------------
+    def _set_segment_builder(self, shard_advance, stride: int = 1
+                             ) -> None:
+        """Register the fused-segment factory for the built compute
+        path: ``shard_advance(p, steps)`` advances one shard's padded
+        field ``steps`` steps (``steps`` is a whole temporal group or a
+        depth-1 tail step). :meth:`make_segment` builds/caches the
+        jitted megastep programs from it."""
+        dd = self.dd
+        cache: dict = {}
+
+        def build(k: int, probe_every: int, metrics):
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel import megastep as ms
+
+            chunks = ms.segment_chunks(k, stride)
+            key = (k, probe_every,
+                   None if metrics is None
+                   else float(metrics.bytes_per_step))
+            fn = cache.get(key)
+            if fn is None:
+                fn = ms.make_segment_fn(
+                    dd.mesh,
+                    lambda p, c, i: shard_advance(p, c),
+                    lambda p: {"temp": p},
+                    P("z", "y", "x"), chunks, probe_every=probe_every,
+                    metric_names=(metrics.names if metrics is not None
+                                  else ()),
+                    bytes_per_step=(metrics.bytes_per_step
+                                    if metrics is not None else 0.0))
+                cache[key] = fn
+            rel = ms.probe_rel_steps(chunks, probe_every)
+
+            def run(base_step: int):
+                vec = ms.metric_base_vec(metrics, base_step)
+                out, tr = fn(self.dd.curr["temp"], vec)
+                self.dd.curr["temp"] = out
+                return ms.SegmentTrace(tr, rel, base_step)
+
+            return ms.Segment(run, k, rel, fn=fn)
+
+        self._segment_builder = build
+
+    def make_segment(self, check_every: int, probe_every: int = 1,
+                     metrics=None):
+        """ONE compiled program advancing ``check_every`` iterations
+        with the health probe fused in-graph every ``probe_every``
+        steps (``parallel/megastep.py``): the resilient driver, the
+        apps, and the bench dispatch one of these per health boundary
+        instead of one jitted step per iteration. Field state is
+        donated end-to-end. Returns None on the interior-resident
+        Pallas fast paths (wrap/halo/overlap), which keep their own
+        fused in-kernel loops — the driver falls back to the stepwise
+        dispatch loop there."""
+        builder = getattr(self, "_segment_builder", None)
+        if builder is None:
+            return None
+        return builder(int(check_every), max(int(probe_every), 1),
+                       metrics)
+
     # -- the fused step ------------------------------------------------
     def _build_step(self) -> None:
+        self._segment_builder = None
         dd = self.dd
         radius = dd.radius
         counts = mesh_dim(dd.mesh)
@@ -404,6 +466,7 @@ class Jacobi3D:
         sm_n = jax.shard_map(shard_steps, mesh=dd.mesh, in_specs=(spec, P()),
                              out_specs=spec, check_vma=False)
         self._step_n = jax.jit(sm_n, donate_argnums=0)
+        self._set_segment_builder(lambda p, c: shard_step(p))
 
     def _build_temporal_step(self) -> None:
         """Communication-avoiding XLA steps: iterations run in groups of
@@ -461,6 +524,18 @@ class Jacobi3D:
         self._step_n = jax.jit(sm, donate_argnums=0)
         self._step = jax.jit(
             lambda p: sm(p, jnp.asarray(1, jnp.int32)), donate_argnums=0)
+
+        def shard_advance(p, c):
+            # one temporal group of c steps (c == s) or a depth-1 tail
+            # step — the same bodies the fused run loop iterates
+            upd = make_update(shard_origin(local, rem))
+            return temporal_shard_steps(
+                {"temp": p}, radius, counts, method, upd, c,
+                alloc_steps=s, rem=rem,
+                overlap=(overlap and c == s),
+                nonperiodic=nonper)["temp"]
+
+        self._set_segment_builder(shard_advance, stride=s)
 
     def _build_wrap_step(self) -> None:
         """Single-chip fused steps on the interior view: iterations run
@@ -776,14 +851,20 @@ class Jacobi3D:
                 output_prefix=self.dd._output_prefix,
                 **_dcn_request_kwargs(self.dd))
             # adopt the rebuilt engine in place so the caller's handle
-            # (and the driver's fields_fn closure) stay valid
+            # (and the driver's fields_fn closure) stay valid; the
+            # fused-segment factory is rebuilt with it (third element)
+            # so the degraded configuration's megastep serves from here
             self.__dict__.update(new.__dict__)
-            return self.dd, self.step
+            return self.dd, self.step, self.make_segment
 
         return run_resilient(self.dd, self.step, n_steps, policy=policy,
                              ckpt_dir=ckpt_dir, faults=faults,
                              rebuild=rebuild,
-                             fields_fn=lambda: self.dd.curr)
+                             fields_fn=lambda: self.dd.curr,
+                             make_segment=(
+                                 self.make_segment
+                                 if self._segment_builder is not None
+                                 else None))
 
 
 def dense_reference_step(temp: np.ndarray, hot_c: Tuple[int, int, int],

@@ -1,0 +1,263 @@
+"""Megastep: a whole campaign segment as ONE compiled program.
+
+The run loops used to return to Python every step (or every s-step
+temporal group): at dispatch-bound sizes the host round-trip, not the
+wire, set steps/s. Production stencil/PIC codes restructure exactly
+this boundary — PIConGPU (arXiv:1606.02862) moves control into the
+device program, POLAR-PIC (arXiv:2604.19337) co-designs the step loop
+with its communication. The megastep is that restructuring for this
+library: a ``check_every``-sized segment of the campaign fuses into a
+single XLA program that
+
+* advances the state ``check_every`` steps through the SAME per-shard
+  step bodies the stepwise loops use (bitwise-identical evolution);
+* carries the health-sentinel probe **in-graph** — every
+  ``probe_every`` sub-steps (and always at the segment's final step)
+  the fused :func:`~stencil_tpu.resilience.health.probe_shard`
+  reduction appends one row to a stacked probe trace, so the driver's
+  divergence predicate can locate the EXACT tripped step after the
+  fact without replaying the segment on host;
+* rides the telemetry step-metric columns on each probe row (the
+  cumulative-substep / cumulative-wire-byte contract of
+  ``telemetry/probe.py``) computed in-graph from a 2-element base
+  vector, so the one-all-reduce-per-probe bill is unchanged;
+* donates its state end-to-end (``input_output_alias`` for every field
+  buffer — proven in ``tests/test_donation.py``), so a segment costs
+  no more HBM than one step.
+
+Audited like everything else: the ``parallel.megastep.segment[...]``
+registry targets pin the lowered StableHLO to exactly ``k`` x the
+per-step collective_permute count plus one small all_reduce per probe
+row and NOTHING else, with the exchange bytes cross-checked exactly
+against the analytic model (``k`` x the per-step figure). The negative
+control ``tests/fixtures/lint/bad_megastep.py`` — a segment that
+re-reduces the probe on every sub-step — is proven flagged.
+
+The segment body is unrolled (a Python loop over the traced step
+body): collective counts in the lowered module are literally ``k`` x
+the per-step counts, which is what makes the registry contract exact.
+``MAX_UNROLL`` bounds compile time; drivers cut longer spans into
+multiple dispatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+#: segments longer than this are cut into multiple dispatches by the
+#: consumers (compile time of the unrolled body grows with k)
+MAX_UNROLL = 64
+
+
+def _metric_names() -> Tuple[str, ...]:
+    """The telemetry metric columns a megastep probe row can carry —
+    the one source of truth (``telemetry.probe.STEP_METRIC_NAMES``),
+    imported lazily to keep this package import-light."""
+    from ..telemetry.probe import STEP_METRIC_NAMES
+    return STEP_METRIC_NAMES
+
+
+def segment_chunks(k: int, stride: int = 1) -> List[int]:
+    """The advance-chunk sizes of a ``k``-step segment whose step body
+    moves ``stride`` steps per call (temporal blocking): whole groups
+    first, then depth-1 tail steps — the same whole-groups-plus-tail
+    shape the blocked run loops use."""
+    k = int(k)
+    stride = max(int(stride), 1)
+    return [stride] * (k // stride) + [1] * (k % stride)
+
+
+def probe_rel_steps(chunks: Sequence[int], probe_every: int = 1
+                    ) -> Tuple[int, ...]:
+    """The cumulative sub-step count at each probe row of a fused
+    segment: a probe fires after a chunk once ``probe_every`` steps
+    have accumulated since the last row, and always after the final
+    chunk (the boundary step's health is never skipped)."""
+    probe_every = max(int(probe_every), 1)
+    rel: List[int] = []
+    done = last = 0
+    total = sum(chunks)
+    for c in chunks:
+        done += c
+        if done - last >= probe_every or done == total:
+            rel.append(done)
+            last = done
+    return tuple(rel)
+
+
+def health_probe(probe_view: Callable[[Any], dict],
+                 base_vec=None,
+                 metric_names: Sequence[str] = (),
+                 bytes_per_step: float = 0.0,
+                 axis_names: Sequence[str] = ("z", "y", "x")):
+    """The standard in-graph probe for :func:`fused_segment_shard`:
+    one :func:`~stencil_tpu.resilience.health.probe_shard` reduction
+    over ``probe_view(state)`` (ONE small all-reduce per row), with
+    the telemetry step-metric columns computed in-graph from
+    ``base_vec = [base_substeps, base_wire_bytes]`` — row ``done``
+    carries ``base + done`` substeps and ``base + done *
+    bytes_per_step`` wire bytes, the exact cumulative contract of
+    ``telemetry/probe.py`` without any host round-trip."""
+    metric_names = tuple(metric_names)
+    known = _metric_names()
+    for m in metric_names:
+        if m not in known:
+            raise ValueError(f"unknown megastep metric column {m!r} "
+                             f"(have {known})")
+
+    def probe(state, done: int):
+        from ..resilience.health import probe_shard
+        extra = None
+        if metric_names:
+            vals = {"substeps": base_vec[0] + float(done),
+                    "wire_bytes": base_vec[1]
+                    + float(done) * float(bytes_per_step)}
+            extra = {m: vals[m] for m in metric_names}
+        return probe_shard(probe_view(state), axis_names, extra=extra)
+
+    return probe
+
+
+def fused_segment_shard(state, advance, probe, chunks: Sequence[int],
+                        probe_every: int = 1):
+    """The fused segment body, for use INSIDE ``shard_map``: advance
+    ``state`` through ``chunks`` (``advance(state, chunk_steps, idx)``
+    per chunk, unrolled), emitting one ``probe(state, done)`` row per
+    :func:`probe_rel_steps` point. Returns ``(state, trace)`` where
+    ``trace`` stacks the probe rows along a new leading axis."""
+    import jax.numpy as jnp
+
+    probe_every = max(int(probe_every), 1)
+    rows = []
+    done = last = 0
+    total = sum(chunks)
+    for idx, c in enumerate(chunks):
+        state = advance(state, int(c), idx)
+        done += int(c)
+        if done - last >= probe_every or done == total:
+            rows.append(probe(state, done))
+            last = done
+    return state, jnp.stack(rows)
+
+
+@dataclasses.dataclass
+class SegmentTrace:
+    """A fused segment's stacked probe trace, still on device.
+
+    ``array`` is ``(n_rows, 2, n_cols)`` (ensembles:
+    ``(n_rows, n_members, 2, n_quantities)``); ``steps`` holds the
+    RELATIVE sub-step count of each row; readback is the consumer's
+    business (``HealthSentinel.observe_segment`` polls ``is_ready``)."""
+
+    array: Any
+    steps: Tuple[int, ...]
+    base_step: int = 0
+
+    @property
+    def abs_steps(self) -> List[int]:
+        return [self.base_step + r for r in self.steps]
+
+
+class Segment:
+    """One compiled campaign segment bound to its owner's state.
+
+    ``run(base_step)`` dispatches the fused program ONCE, advancing the
+    owner's state in place by :attr:`steps` steps, and returns the
+    :class:`SegmentTrace` (device handle — no sync). ``fn`` exposes
+    the underlying jitted program (``fn(state, base_vec)``) for
+    lowering-level introspection — the donation proof in
+    ``tests/test_donation.py`` pins its ``input_output_alias`` map."""
+
+    def __init__(self, run_fn: Callable[[int], SegmentTrace],
+                 steps: int, probe_steps: Tuple[int, ...],
+                 fn: Optional[Callable] = None) -> None:
+        self._run = run_fn
+        self.steps = int(steps)
+        self.probe_steps = tuple(probe_steps)
+        self.fn = fn
+
+    def run(self, base_step: int = 0) -> SegmentTrace:
+        return self._run(int(base_step))
+
+
+def metric_base_vec(metrics, base_step: int):
+    """The replicated f32 ``[substeps, wire_bytes]`` base the fused
+    probe rows increment in-graph — :meth:`StepMetrics.values` at the
+    segment's base step, or zeros when no metrics ride."""
+    import jax.numpy as jnp
+
+    if metrics is None:
+        return jnp.zeros((2,), jnp.float32)
+    return metrics.values(int(base_step))
+
+
+def make_segment_fn(mesh, advance, probe_view, state_specs,
+                    chunks: Sequence[int], probe_every: int = 1,
+                    metric_names: Sequence[str] = (),
+                    bytes_per_step: float = 0.0):
+    """Build the jitted fused-segment program: ``fn(state, base_vec) ->
+    (state, trace)`` over ``mesh``, with the state pytree DONATED
+    end-to-end and the trace replicated. ``advance(state, steps, idx)``
+    and ``probe_view(state) -> {name: padded array}`` run per shard."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    chunks = [int(c) for c in chunks]
+
+    def shard_seg(state, vec):
+        probe = health_probe(probe_view, base_vec=vec,
+                             metric_names=metric_names,
+                             bytes_per_step=bytes_per_step)
+        return fused_segment_shard(state, advance, probe, chunks,
+                                   probe_every)
+
+    sm = jax.shard_map(shard_seg, mesh=mesh,
+                       in_specs=(state_specs, P()),
+                       out_specs=(state_specs, P()), check_vma=False)
+    return jax.jit(sm, donate_argnums=0)
+
+
+def make_domain_segment(dd, shard_step, check_every: int,
+                        probe_every: int = 1,
+                        metrics=None) -> Segment:
+    """A fused segment over a realized ``DistributedDomain``'s field
+    dict: ``shard_step(fields) -> fields`` (per shard, all quantities
+    padded) applied ``check_every`` times with the in-graph probe over
+    every registered quantity. The compiled program is cached on the
+    domain, keyed by the step fn and the segment geometry."""
+    from jax.sharding import PartitionSpec as P
+
+    k = int(check_every)
+    if k < 1:
+        raise ValueError(f"check_every must be >= 1, got {check_every}")
+    probe_every = max(int(probe_every), 1)
+    names = list(dd._names)
+    cache = getattr(dd, "_segment_cache", None)
+    if cache is None:
+        cache = {}
+        dd._segment_cache = cache
+    key = (shard_step, k, probe_every,
+           None if metrics is None else float(metrics.bytes_per_step))
+    fn = cache.get(key)
+    chunks = segment_chunks(k)
+    if fn is None:
+        spec = {q: P("z", "y", "x") for q in names}
+        fn = make_segment_fn(
+            dd.mesh,
+            lambda fields, c, i: shard_step(fields),
+            lambda fields: {q: fields[q] for q in names},
+            spec, chunks, probe_every=probe_every,
+            metric_names=(metrics.names if metrics is not None else ()),
+            bytes_per_step=(metrics.bytes_per_step
+                            if metrics is not None else 0.0))
+        cache[key] = fn
+    rel = probe_rel_steps(chunks, probe_every)
+
+    def run(base_step: int) -> SegmentTrace:
+        vec = metric_base_vec(metrics, base_step)
+        out, trace = fn(dict(dd.curr), vec)
+        dd.curr = dict(out)
+        return SegmentTrace(trace, rel, base_step)
+
+    return Segment(run, k, rel, fn=fn)

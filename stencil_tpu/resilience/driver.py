@@ -65,6 +65,13 @@ class ResiliencePolicy:
     growth_factor: float = 1e6  # max-abs growth trip factor
     degrade: bool = True        # walk the degradation ladder
     sleep: Callable[[float], None] = time.sleep  # injectable clock
+    # megastep execution (parallel/megastep.py): fuse check_every-sized
+    # campaign segments into ONE compiled program when the engine
+    # provides a segment factory; probe_every sets the in-graph probe
+    # cadence INSIDE a segment (1 = per-step trace rows, exact trip
+    # location; the segment's final step is always probed)
+    fuse_segments: bool = True
+    probe_every: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,7 +168,7 @@ class _ResilientRun:
 
     def __init__(self, dd, step_fn, n_steps, policy, ckpt_dir, faults,
                  rebuild, extra_fn, on_restore, fields_fn,
-                 pre_checkpoint):
+                 pre_checkpoint, make_segment=None):
         self.dd = dd
         self.step_fn = step_fn
         self.n_steps = int(n_steps)
@@ -173,6 +180,15 @@ class _ResilientRun:
         self.on_restore = on_restore
         self.fields_fn = fields_fn
         self.pre_checkpoint = pre_checkpoint
+        #: megastep factory: make_segment(k, probe_every, metrics) ->
+        #: Segment or None (parallel/megastep.py); when present and
+        #: policy.fuse_segments, the loop dispatches ONE fused program
+        #: per health boundary instead of one jitted step per iteration
+        self.make_segment = make_segment
+        self._fused = (make_segment is not None
+                       and self.policy.fuse_segments)
+        #: one async checkpoint in flight: (step, field copies, extras)
+        self._pending_save = None
         self.report = ResilienceReport()
         if faults is not None:
             faults.bind(self.report.log)
@@ -192,8 +208,10 @@ class _ResilientRun:
         self.report.bind_tracer(self._tracer)
         self._m_steps = reg.counter(
             "stencil_run_steps_total",
-            "step dispatches by resilient run loops (replayed "
-            "rollback windows included — work done, not net progress)")
+            "steps advanced by resilient run loops — steps inside a "
+            "fused megastep each count (one count per STEP, never per "
+            "dispatch); replayed rollback windows included (work done, "
+            "not net progress)")
         self._m_rollbacks = reg.counter(
             "stencil_run_rollbacks_total",
             "sentinel-tripped rollbacks")
@@ -257,14 +275,19 @@ class _ResilientRun:
         return self.fields_fn() if self.fields_fn is not None \
             else self.dd.curr
 
-    def _save(self, preempted: bool = False) -> None:
-        if self.pre_checkpoint is not None:
+    _UNSET = object()
+
+    def _save(self, preempted: bool = False, fields=None,
+              extra=_UNSET, at_step: Optional[int] = None) -> None:
+        if fields is None and self.pre_checkpoint is not None:
             self.pre_checkpoint()
-        extra = self.extra_fn() if self.extra_fn is not None else None
+        if extra is self._UNSET:
+            extra = self.extra_fn() if self.extra_fn is not None \
+                else None
+        step = self.step if at_step is None else int(at_step)
         meta_extra = {"preempted": preempted,
-                      "completed_steps": self.step,
+                      "completed_steps": step,
                       "config": _current_config(self.dd).key()}
-        step = self.step
 
         def attempt():
             if self.faults is not None:
@@ -273,7 +296,8 @@ class _ResilientRun:
             # is the only one — no hidden nested retries inside
             save_domain(self.dd, self.ckpt_dir, step, extra=extra,
                         max_to_keep=self.policy.max_to_keep,
-                        meta_extra=meta_extra, attempts=1)
+                        meta_extra=meta_extra, attempts=1,
+                        fields=fields)
 
         def on_retry(k, e, delay):
             self.report.save_retries += 1
@@ -299,14 +323,56 @@ class _ResilientRun:
         self.attempts = 0
         self.report.log("checkpoint", step=step, preempted=preempted)
 
+    # -- async checkpoint offload (megastep mode) -----------------------
+    def _save_async(self) -> None:
+        """Enqueue a checkpoint of the CURRENT state without stalling
+        the step pipeline: device copies of the fields (cheap, ride the
+        device queue) are taken at the segment boundary so the live
+        buffers can be donated to the next megastep; the orbax write
+        runs once the copies report ``is_ready`` (polled each loop
+        turn) — the EnsembleSnapshot pattern applied to checkpoints.
+        Exactly one save is in flight; ordering is preserved by
+        flushing before the next enqueue, any restore, preemption, and
+        loop end."""
+        import jax.numpy as jnp
+
+        self._flush_pending_save()
+        if self.pre_checkpoint is not None:
+            self.pre_checkpoint()
+        fields = {q: jnp.copy(v) for q, v in self.dd.curr.items()}
+        extra = self.extra_fn() if self.extra_fn is not None else None
+        if extra:
+            extra = {k: jnp.copy(v) for k, v in extra.items()}
+        self._pending_save = (self.step, fields, extra)
+
+    def _poll_pending_save(self, block: bool = False) -> None:
+        from .health import _is_ready
+        ps = self._pending_save
+        if ps is None:
+            return
+        step, fields, extra = ps
+        if not block and not all(_is_ready(v)
+                                 for v in fields.values()):
+            return
+        self._pending_save = None
+        self._save(fields=fields, extra=extra, at_step=step)
+
+    def _flush_pending_save(self) -> None:
+        self._poll_pending_save(block=True)
+
     def _drain_probe(self) -> List[HealthStats]:
         """Blocking health verdict on the CURRENT state (used at
         checkpoint boundaries and loop end). Reuses an in-flight probe
-        of this step rather than paying a duplicate reduction."""
-        if not self.sentinel.has_pending(self.step):
+        of this step — or a fused-trace row of it already harvested
+        clean this turn — rather than paying a duplicate reduction."""
+        if not self.sentinel.has_pending(self.step) and \
+                getattr(self, "_last_clean_health", None) != self.step:
             self.sentinel.probe(self._fields(), self.step)
         results = self.sentinel.poll(block=True)
         self._observe_probes(results)
+        for s in results:
+            if not s.tripped:
+                self._last_clean_health = s.step
         return [s for s in results if s.tripped]
 
     def _observe_probes(self, results: List[HealthStats]) -> None:
@@ -342,9 +408,17 @@ class _ResilientRun:
             self.sentinel = self._make_sentinel(
                 self.dd, rebase_step=step, prev=pre_degrade)
         self.sentinel.reset()
+        self._last_clean_health = None
         self.report.log("restored", step=step)
 
     def _handle_trip(self, tripped: List[HealthStats]) -> None:
+        # an in-flight async checkpoint (healthy by construction: it was
+        # enqueued only after a clean blocking drain) completes FIRST —
+        # before the attempt counter moves — so it can anchor the
+        # rollback AND its attempts-reset lands where the stepwise
+        # ordering puts it (the save preceded the faulting steps; it
+        # must not forgive the attempt recorded for THIS trip)
+        self._flush_pending_save()
         stats = tripped[0]
         self.report.rollbacks += 1
         self._m_rollbacks.inc()
@@ -383,7 +457,22 @@ class _ResilientRun:
             LOG_WARN(f"degrading configuration to {cfg.key()} after "
                      f"repeated failures")
             try:
-                self.dd, self.step_fn = self.rebuild(cfg)
+                built = self.rebuild(cfg)
+                # a 3-tuple rebuild also rebuilds the fused-segment
+                # factory (megastep mode): the degraded engine's
+                # segments, not the dead configuration's, serve from
+                # here on; a 2-tuple (legacy) drops to the stepwise
+                # loop — never dispatch a stale fused program
+                if len(built) == 3:
+                    self.dd, self.step_fn, self.make_segment = built
+                    self._fused = (self.make_segment is not None
+                                   and self.policy.fuse_segments)
+                else:
+                    self.dd, self.step_fn = built
+                    if self._fused:
+                        LOG_WARN("rebuild() returned no segment "
+                                 "factory; continuing stepwise")
+                        self._fused = False
             except (NotImplementedError, ValueError) as e:
                 self.report.log("degrade_rung_infeasible",
                                 config=cfg.key(),
@@ -419,6 +508,48 @@ class _ResilientRun:
             f"step {stats.step}: {stats.reason}; no degradation "
             f"available")
 
+    # -- megastep segmentation ------------------------------------------
+    def _next_seg_len(self) -> int:
+        """Steps until the next host boundary: campaign end, the
+        check_every health boundary, a checkpoint boundary, a scheduled
+        host fault, or the unroll cap — the fused segment runs exactly
+        that far in ONE dispatch."""
+        from ..parallel.megastep import MAX_UNROLL
+        p = self.policy
+        cands = [self.n_steps - self.step, MAX_UNROLL]
+        ce = max(int(p.check_every), 1)
+        cands.append(ce - self.step % ce)
+        if self.ckpt_dir is not None and p.ckpt_every > 0:
+            cands.append(p.ckpt_every - self.step % p.ckpt_every)
+        if self.faults is not None:
+            nf = self.faults.next_host_step(self.step)
+            if nf is not None:
+                cands.append(nf - self.step)
+        return max(1, min(c for c in cands if c > 0))
+
+    def _dispatch_segment(self) -> bool:
+        """Advance one fused megastep (ONE compiled dispatch for the
+        whole sub-check_every span, probe trace in-graph). Returns
+        False when the current engine configuration has no fused
+        segment — the caller re-enters the loop stepwise."""
+        k = self._next_seg_len()
+        seg = self.make_segment(k, self.policy.probe_every,
+                                self._step_metrics)
+        if seg is None:
+            LOG_WARN("engine has no fused-segment support for this "
+                     "configuration; continuing with the stepwise "
+                     "dispatch loop")
+            self._fused = False
+            return False
+        base = self.step
+        with self._tracer.span("megastep", steps=k, step=base):
+            trace = seg.run(base)
+        self.step += k
+        self.report.steps = self.step
+        self._m_steps.inc(k)
+        self.sentinel.observe_segment(trace.array, trace.abs_steps)
+        return True
+
     # -- the loop -------------------------------------------------------
     def run(self) -> ResilienceReport:
         with self._tracer.span("resilience.run", run=self.report.run_id,
@@ -448,7 +579,9 @@ class _ResilientRun:
             handler_installed = True
         try:
             while True:
+                self._poll_pending_save()
                 if self._preempt:
+                    self._flush_pending_save()
                     if self.ckpt_dir is not None:
                         # same invariant as periodic checkpoints:
                         # poisoned state must never be persisted — if
@@ -473,6 +606,7 @@ class _ResilientRun:
                              f"cleanly")
                     break
                 if self.step >= self.n_steps:
+                    self._flush_pending_save()
                     if self.last_saved == self.step:
                         break  # this step already drained + saved
                     tripped = self._drain_probe()
@@ -482,41 +616,79 @@ class _ResilientRun:
                     if self.ckpt_dir is not None:
                         self._save()
                     break
-                self.step_fn()
-                self.step += 1
-                self.report.steps = self.step
-                self._m_steps.inc()
-                if self.faults is not None:
-                    # faults hit the LIVE fields — the same dict the
-                    # sentinel probes (interior-resident fast paths
-                    # keep their state outside dd.curr)
-                    self.faults.on_step(self.dd, self.step,
-                                        self._fields())
-                if self._preempt:
-                    continue  # SIGTERM landed during the step
-                ckpt_due = (self.ckpt_dir is not None
-                            and self.step % policy.ckpt_every == 0)
-                if self.step % policy.check_every == 0 and not ckpt_due:
-                    # checkpoint boundaries probe via the blocking
-                    # drain below — one reduction per step, not two
-                    self.sentinel.probe(self._fields(), self.step)
+                if self._fused:
+                    # megastep mode: ONE compiled dispatch to the next
+                    # host boundary; the probe trace rides in-graph
+                    if not self._dispatch_segment():
+                        continue  # no fused support: retry stepwise
+                    if self.faults is not None:
+                        mutated = self.faults.on_step(
+                            self.dd, self.step, self._fields())
+                        if mutated:
+                            # the in-graph trace predates the host
+                            # injection: re-probe the poisoned fields
+                            # so detection matches the stepwise loop —
+                            # BEFORE any preempt drain can mistake the
+                            # stale clean trace row for current health
+                            self._last_clean_health = None
+                            self.sentinel.probe(self._fields(),
+                                                self.step)
+                        if self._preempt:
+                            continue  # SIGTERM landed at the boundary
+                else:
+                    self.step_fn()
+                    self.step += 1
+                    self.report.steps = self.step
+                    self._m_steps.inc()
+                    if self.faults is not None:
+                        # faults hit the LIVE fields — the same dict
+                        # the sentinel probes (interior-resident fast
+                        # paths keep their state outside dd.curr)
+                        self.faults.on_step(self.dd, self.step,
+                                            self._fields())
+                    if self._preempt:
+                        continue  # SIGTERM landed during the step
+                    if self.step % policy.check_every == 0 and not (
+                            self.ckpt_dir is not None
+                            and self.step % policy.ckpt_every == 0):
+                        # checkpoint boundaries probe via the blocking
+                        # drain below — one reduction per step, not two
+                        self.sentinel.probe(self._fields(), self.step)
                 results = self.sentinel.poll()
                 self._observe_probes(results)
+                for s in results:
+                    if not s.tripped:
+                        self._last_clean_health = s.step
                 tripped = [s for s in results if s.tripped]
                 if tripped:
                     self._handle_trip(tripped)
                     continue
+                ckpt_due = (self.ckpt_dir is not None
+                            and self.step % policy.ckpt_every == 0)
                 if ckpt_due:
                     tripped = self._drain_probe()
                     if tripped:
                         self._handle_trip(tripped)
                         continue
-                    self._save()
+                    if self._fused:
+                        # async host offload: the TPU starts the next
+                        # segment while orbax drains boundary copies
+                        self._save_async()
+                    else:
+                        self._save()
         finally:
             if handler_installed:
                 signal.signal(signal.SIGTERM,
                               prev_handler if prev_handler is not None
                               else signal.SIG_DFL)
+            if self._pending_save is not None:
+                # best-effort durability on abnormal exits: never mask
+                # the in-flight exception with a failing late save
+                try:
+                    self._flush_pending_save()
+                except Exception as e:  # noqa: BLE001
+                    LOG_WARN(f"in-flight checkpoint lost on exit: "
+                             f"{type(e).__name__}: {e}")
         self.report.steps = self.step
         self.report.final_config = _current_config(self.dd).key()
         elapsed = time.perf_counter() - t_start
@@ -536,7 +708,8 @@ def run_resilient(dd, step_fn: Callable[[], None], n_steps: int,
                   extra_fn: Optional[Callable[[], Optional[Dict]]] = None,
                   on_restore: Optional[Callable[[Dict], None]] = None,
                   fields_fn: Optional[Callable[[], Dict]] = None,
-                  pre_checkpoint: Optional[Callable[[], None]] = None
+                  pre_checkpoint: Optional[Callable[[], None]] = None,
+                  make_segment: Optional[Callable] = None
                   ) -> ResilienceReport:
     """Drive ``step_fn`` for ``n_steps`` steps with health sentinels,
     periodic integrity-checked checkpoints, rollback-retry recovery,
@@ -553,10 +726,20 @@ def run_resilient(dd, step_fn: Callable[[], None], n_steps: int,
     reinstall auxiliary state (RK accumulators). ``fields_fn``: the
     dict the sentinel probes (defaults to ``dd.curr``).
     ``pre_checkpoint``: flush hook run before every save (fast paths
-    sync interior-resident state). Returns a :class:`ResilienceReport`;
-    if it says ``preempted``, rerun with the same ``ckpt_dir`` to
-    resume. If a run was previously preempted mid-campaign, the same
-    call resumes it automatically."""
+    sync interior-resident state).
+
+    ``make_segment(k, probe_every, metrics)``: the megastep factory
+    (``parallel/megastep.py``) — when given (the model entry points
+    pass theirs) and ``policy.fuse_segments`` is on (default), the loop
+    dispatches ONE fused program per health boundary: ``k`` steps plus
+    the in-graph probe trace, state donated end-to-end, checkpoints
+    offloaded asynchronously from boundary copies. ``rebuild`` may
+    return ``(dd, step_fn, make_segment)`` so a degradation rebuilds
+    the fused segment too (a 2-tuple falls back to stepwise).
+
+    Returns a :class:`ResilienceReport`; if it says ``preempted``,
+    rerun with the same ``ckpt_dir`` to resume. If a run was previously
+    preempted mid-campaign, the same call resumes it automatically."""
     return _ResilientRun(dd, step_fn, n_steps, policy, ckpt_dir, faults,
                          rebuild, extra_fn, on_restore, fields_fn,
-                         pre_checkpoint).run()
+                         pre_checkpoint, make_segment=make_segment).run()

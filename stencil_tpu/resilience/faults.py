@@ -199,21 +199,40 @@ class FaultPlan:
         self._log = log
 
     # -- driver hooks ---------------------------------------------------
-    def on_step(self, dd, step: int, fields=None) -> None:
+    def on_step(self, dd, step: int, fields=None) -> bool:
         """Fire state faults due after ``step`` (NaN, halo, SIGTERM).
         ``fields`` is the LIVE field dict (the driver passes the same
         one the sentinel probes) — on interior-resident fast paths
         that is the model's resident state, not the stale ``dd.curr``;
-        it is mutated in place. Defaults to ``dd.curr``."""
+        it is mutated in place. Defaults to ``dd.curr``. Returns True
+        when a STATE fault (NaN/halo) fired — the fused megastep loop
+        re-probes the now-poisoned fields so detection matches the
+        stepwise loop's post-injection probe semantics."""
+        mutated = False
         for ev in self.nans:
             if ev.due(step):
                 ev.fire(dd, self._log, fields)
+                mutated = True
         for ev in self.halos:
             if ev.due(step):
                 ev.fire(dd, self._log, fields)
+                mutated = True
         for ev in self.preemptions:
             if ev.due(step):
                 ev.fire(self._log)
+        return mutated
+
+    def next_host_step(self, after: int) -> Optional[int]:
+        """The next step at which a host-side hook must run (NaN, halo,
+        SIGTERM still due) — the fused megastep loop cuts segments at
+        these boundaries so host fault injection lands between
+        dispatches exactly where the stepwise loop would fire it.
+        None when no such fault remains."""
+        cands = [ev.step
+                 for ev in (*self.nans, *self.halos, *self.preemptions)
+                 if ev.step > after
+                 and ev.fired < getattr(ev, "repeat", 1)]
+        return min(cands) if cands else None
 
     def maybe_fail_save(self, step: int) -> None:
         """Raise the scheduled transient ``IOError`` for this save."""

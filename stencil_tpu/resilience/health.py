@@ -187,22 +187,42 @@ class HealthSentinel:
             return
         self._pending.append((step, self._probe_fn(dict(fields))))
 
+    def observe_segment(self, trace, steps: Sequence[int]) -> None:
+        """Enqueue a fused-segment probe trace (``parallel/megastep``):
+        ``trace`` stacks one probe row per entry of ``steps`` (campaign
+        step numbers, oldest first). Rows ride the device queue exactly
+        like individual probes — ``poll`` expands them, oldest row
+        first, through the same divergence predicate, so the driver can
+        locate the exact tripped step inside the segment without
+        replaying it."""
+        self._pending.append((tuple(int(s) for s in steps), trace))
+
     def has_pending(self, step: int) -> bool:
-        """True when a probe of ``step`` is already in flight (the
+        """True when a probe of ``step`` is already in flight — as a
+        single enqueued probe or as a row of a fused-segment trace (the
         driver avoids double-probing checkpoint-boundary steps)."""
-        return any(s == step for s, _ in self._pending)
+        for s, _ in self._pending:
+            if step in (s if isinstance(s, tuple) else (s,)):
+                return True
+        return False
 
     # -- harvest side ---------------------------------------------------
     def poll(self, block: bool = False) -> List[HealthStats]:
         """Harvest completed probes (all of them when ``block``),
-        oldest first, evaluating the divergence predicate on each."""
+        oldest first, evaluating the divergence predicate on each.
+        Fused-segment traces expand into one result per probe row."""
         out: List[HealthStats] = []
         while self._pending:
             step, arr = self._pending[0]
             if not block and not _is_ready(arr):
                 break
             self._pending.popleft()
-            out.append(self._evaluate(step, np.asarray(arr)))
+            host = np.asarray(arr)
+            if isinstance(step, tuple):
+                for j, s in enumerate(step):
+                    out.append(self._evaluate(s, host[j]))
+            else:
+                out.append(self._evaluate(step, host))
         return out
 
     @property

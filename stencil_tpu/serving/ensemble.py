@@ -155,6 +155,12 @@ class EnsembleSentinel:
         self._pending.append(
             (step, self.engine._probe_fn(dict(self.engine.state))))
 
+    def observe_segment(self, trace, steps) -> None:
+        """Enqueue a fused-segment per-member probe trace
+        (``run_segment``): row ``j`` is the batched probe of member
+        step ``steps[j]``; ``poll`` expands the rows oldest first."""
+        self._pending.append((tuple(int(s) for s in steps), trace))
+
     def poll(self, block: bool = False) -> List[EnsembleHealth]:
         out: List[EnsembleHealth] = []
         while self._pending:
@@ -162,7 +168,12 @@ class EnsembleSentinel:
             if not block and not _is_ready(arr):
                 break
             self._pending.popleft()
-            out.append(self._evaluate(step, np.asarray(arr)))
+            host = np.asarray(arr)
+            if isinstance(step, tuple):
+                for j, s in enumerate(step):
+                    out.append(self._evaluate(s, host[j]))
+            else:
+                out.append(self._evaluate(step, host))
         return out
 
     def reset_member(self, k: int) -> None:
@@ -267,6 +278,16 @@ class _EnsembleBase:
 
     def run(self, n_steps: int) -> None:
         """Advance ALL members ``n_steps`` steps in one dispatch."""
+        raise NotImplementedError
+
+    def run_segment(self, n_steps: int, probe_every: int = 1):
+        """Advance ALL members ``n_steps`` steps AND carry the
+        per-member health probe in-graph — one fused dispatch
+        (``parallel/megastep.py``) whose returned
+        :class:`~..parallel.megastep.SegmentTrace` stacks a
+        ``(n_members, 2, n_quantities)`` probe row every
+        ``probe_every`` steps (the vmapped reduction is still ONE
+        small all-reduce per row). State is donated end-to-end."""
         raise NotImplementedError
 
     # -- allocation / lane plumbing ------------------------------------
@@ -555,12 +576,58 @@ class EnsembleJacobi(_EnsembleBase):
             in_specs=(ENSEMBLE_SPEC, P(), P(), P()),
             out_specs=ENSEMBLE_SPEC, check_vma=False)
         self._step_n = jax.jit(sm, donate_argnums=0)
+        self._segments: Dict = {}
+
+        def segment_fn(k: int, probe_every: int):
+            from ..parallel.megastep import (fused_segment_shard,
+                                             segment_chunks)
+
+            def shard_seg(batched, hot, cold):
+                origin = shard_origin(local, rem)
+
+                def advance(q, c, i):
+                    return jax.vmap(
+                        lambda p, h, c2: member_step(p, h, c2, origin))(
+                            q, hot, cold)
+
+                def probe(q, done):
+                    return jax.vmap(
+                        lambda p: probe_shard({"temp": p}))(q)
+
+                return fused_segment_shard(batched, advance, probe,
+                                           segment_chunks(k),
+                                           probe_every)
+
+            sseg = jax.shard_map(
+                shard_seg, mesh=dd.mesh,
+                in_specs=(ENSEMBLE_SPEC, P(), P()),
+                out_specs=(ENSEMBLE_SPEC, P()), check_vma=False)
+            return jax.jit(sseg, donate_argnums=0)
+
+        self._segment_fn = segment_fn
 
     def run(self, n_steps: int) -> None:
         hot, cold = self._param_args()
         self.state = {"temp": self._step_n(
             self.state["temp"], hot, cold,
             jnp.asarray(n_steps, jnp.int32))}
+
+    def run_segment(self, n_steps: int, probe_every: int = 1):
+        from ..parallel.megastep import (SegmentTrace, probe_rel_steps,
+                                         segment_chunks)
+        k = int(n_steps)
+        probe_every = max(int(probe_every), 1)
+        key = (k, probe_every)
+        fn = self._segments.get(key)
+        if fn is None:
+            fn = self._segment_fn(k, probe_every)
+            self._segments[key] = fn
+        hot, cold = self._param_args()
+        out, trace = fn(self.state["temp"], hot, cold)
+        self.state = {"temp": out}
+        return SegmentTrace(trace,
+                            probe_rel_steps(segment_chunks(k),
+                                            probe_every))
 
 
 class EnsembleAstaroth(_EnsembleBase):
@@ -685,6 +752,33 @@ class EnsembleAstaroth(_EnsembleBase):
                            in_specs=(fspec, fspec, pspec, P()),
                            out_specs=(fspec, fspec), check_vma=False)
         self._iter_n = jax.jit(sm, donate_argnums=(0, 1))
+        self._segments: Dict = {}
+
+        def segment_fn(k: int, probe_every: int):
+            from ..parallel.megastep import (fused_segment_shard,
+                                             segment_chunks)
+
+            def shard_seg(fields, w, pvals):
+                def advance(fw, c, i):
+                    return tuple(jax.vmap(member_iter)(fw[0], fw[1],
+                                                       pvals))
+
+                def probe(fw, done):
+                    return jax.vmap(
+                        lambda f: probe_shard(
+                            {q: f[q] for q in FIELDS}))(fw[0])
+
+                return fused_segment_shard((fields, w), advance, probe,
+                                           segment_chunks(k),
+                                           probe_every)
+
+            sseg = jax.shard_map(
+                shard_seg, mesh=dd.mesh,
+                in_specs=(fspec, fspec, pspec),
+                out_specs=((fspec, fspec), P()), check_vma=False)
+            return jax.jit(sseg, donate_argnums=(0, 1))
+
+        self._segment_fn = segment_fn
 
     def run(self, n_steps: int) -> None:
         pvals = {p: jnp.asarray(self._params[p], dtype=self._dtype)
@@ -692,6 +786,25 @@ class EnsembleAstaroth(_EnsembleBase):
         self.state, self.w = self._iter_n(
             dict(self.state), dict(self.w), pvals,
             jnp.asarray(n_steps, jnp.int32))
+
+    def run_segment(self, n_steps: int, probe_every: int = 1):
+        from ..parallel.megastep import (SegmentTrace, probe_rel_steps,
+                                         segment_chunks)
+        k = int(n_steps)
+        probe_every = max(int(probe_every), 1)
+        key = (k, probe_every)
+        fn = self._segments.get(key)
+        if fn is None:
+            fn = self._segment_fn(k, probe_every)
+            self._segments[key] = fn
+        pvals = {p: jnp.asarray(self._params[p], dtype=self._dtype)
+                 for p in self.PARAM_NAMES}
+        (out_f, out_w), trace = fn(dict(self.state), dict(self.w),
+                                   pvals)
+        self.state, self.w = out_f, out_w
+        return SegmentTrace(trace,
+                            probe_rel_steps(segment_chunks(k),
+                                            probe_every))
 
     # RK accumulators are campaign state: a lane rollback without its
     # w would resume mid-RK-iteration with a zeroed accumulator

@@ -121,9 +121,14 @@ class CampaignService:
                  window: int = 8, growth_factor: float = 1e6,
                  max_to_keep: int = 3, events_capacity: int = 4096,
                  run_id: Optional[str] = None, registry=None,
-                 tracer=None) -> None:
+                 tracer=None, fuse_segments: bool = True) -> None:
         if int(width) < 1:
             raise ValueError(f"width must be >= 1, got {width}")
+        #: megastep mode (default): each batch segment is ONE fused
+        #: dispatch carrying the per-member probe trace in-graph
+        #: (parallel/megastep.py) instead of a step loop + separate
+        #: probe dispatch
+        self._fuse = bool(fuse_segments)
         self.root = Path(root_dir)
         self.root.mkdir(parents=True, exist_ok=True)
         self.width = int(width)
@@ -368,10 +373,21 @@ class CampaignService:
         self._m_tuner.inc(plan.measurements)
         return plan
 
+    def _engine_key(self, fingerprint: str, req: CampaignRequest) -> str:
+        """The engine-cache key: the problem fingerprint PLUS the
+        fusion geometry — a megastep engine compiles segments per
+        ``check_every``, so differently-fused requests must not collide
+        on one cache slot (they would thrash segment compiles and lie
+        to the recompile counter)."""
+        if not self._fuse:
+            return fingerprint
+        return f"{fingerprint}|ck={int(req.check_every)}"
+
     def _engine_for(self, fingerprint: str, req: CampaignRequest):
         """The compiled ensemble engine for a fingerprint — built once,
         reused for every later fingerprint-identical batch."""
-        eng = self._engines.get(fingerprint)
+        key = self._engine_key(fingerprint, req)
+        eng = self._engines.get(key)
         if eng is not None:
             self._m_engine_hits.inc()
             return eng, False, None
@@ -389,18 +405,18 @@ class CampaignService:
                       plan=plan)
         assert eng.fingerprint == fingerprint, \
             (eng.fingerprint, fingerprint)
-        self._engines[fingerprint] = eng
-        self._sentinels[fingerprint] = EnsembleSentinel(
+        self._engines[key] = eng
+        self._sentinels[key] = EnsembleSentinel(
             eng, window=self._window,
             growth_factor=self._growth_factor)
         self.stats.compiles += 1
         self._m_compiles.inc()
-        if fingerprint in self._built:
-            # the engine cache dropped a fingerprint it had already
-            # built — the warm-path regression the CI counter gate is
-            # for (stencil_service_recompiles_total stays 0 normally)
+        if key in self._built:
+            # the engine cache dropped a key it had already built — the
+            # warm-path regression the CI counter gate is for
+            # (stencil_service_recompiles_total stays 0 normally)
             self._m_recompiles.inc()
-        self._built.add(fingerprint)
+        self._built.add(key)
         self._m_engine_size.set(len(self._engines))
         return eng, True, plan
 
@@ -528,7 +544,7 @@ class CampaignService:
         self._m_queue_depth.set(len(self.queue))
         self._m_occupancy.set(len(batch) / self.width)
         eng, compiled, plan = self._engine_for(fp, req0)
-        sentinel = self._sentinels[fp]
+        sentinel = self._sentinels[self._engine_key(fp, req0)]
         sentinel.reset()
         self.stats.batches += 1
         self._m_batches.inc()
@@ -612,8 +628,18 @@ class CampaignService:
                 return
             seg = min(self._steps_to_boundary(lane)
                       for lane in lanes if lane.active)
-            with self.tracer.span("segment", steps=seg):
-                eng.run(seg)
+            if self._fuse:
+                from ..parallel.megastep import MAX_UNROLL
+                seg = min(seg, MAX_UNROLL)
+            trace = None
+            with self.tracer.span("segment", steps=seg,
+                                  fused=self._fuse):
+                if self._fuse:
+                    # megastep: the per-member probe trace rides the
+                    # same single dispatch (one all-reduce per row)
+                    trace = eng.run_segment(seg)
+                else:
+                    eng.run(seg)
             n_active = 0
             for lane in lanes:
                 if lane.active:
@@ -621,14 +647,24 @@ class CampaignService:
                     n_active += 1
             self._m_steps.inc(seg * n_active)
             steps_advanced += seg * n_active
+            top = max(lane.counter for lane in lanes)
+            if trace is not None:
+                sentinel.observe_segment(
+                    trace.array, [top - seg + r for r in trace.steps])
             # chaos injections land AFTER the step that reaches them
+            chaos_fired = False
             for lane in lanes:
                 req = lane.request
                 if (lane.active and req.chaos_nan_step is not None
                         and not lane.chaos_fired
                         and lane.counter >= req.chaos_nan_step):
                     self._inject_nan(eng, lane)
-            sentinel.probe(max(lane.counter for lane in lanes))
+                    chaos_fired = True
+            if trace is None or chaos_fired:
+                # stepwise mode probes every boundary; fused mode
+                # re-probes only when a host-side injection poisoned
+                # state AFTER the in-graph trace rows were produced
+                sentinel.probe(top)
             poll_snapshots()
             # blocking drain BEFORE any checkpoint/completion below —
             # the same invariant as the resilience driver: poisoned

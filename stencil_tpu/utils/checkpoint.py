@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .logging import LOG_WARN
 from .retry import retry
@@ -302,23 +303,29 @@ def save_domain(dd, directory: str, step: int,
                 meta_extra: Optional[Dict[str, Any]] = None,
                 integrity: bool = True,
                 attempts: int = 3, base_delay: float = 0.1,
-                sleep=None) -> None:
+                sleep=None,
+                fields: Optional[Dict[str, jnp.ndarray]] = None) -> None:
     """Checkpoint a DistributedDomain's curr fields (+ optional extra
     arrays, e.g. RK accumulators) at ``step``. ``meta_extra`` is merged
     into the JSON meta record (the resilience driver tags preemption
     checkpoints through it); ``integrity=True`` (default) records a
     sha256 per array so restore can detect corruption — it costs one
-    host gather per array per checkpoint."""
+    host gather per array per checkpoint. ``fields`` overrides the
+    source arrays (same padded-global layout as ``curr``) — the async
+    megastep offload saves from device COPIES taken at the segment
+    boundary, so the live buffers can be donated to the next segment
+    while orbax drains the copies."""
     from ..geometry import Dim3
     _track_dir(dd, directory)
+    src = dd.curr if fields is None else fields
     if dd.rem == Dim3(0, 0, 0):
         extract, _ = _interior_fns(dd)
-        arrays = {q: extract(v) for q, v in dd.curr.items()}
+        arrays = {q: extract(src[q]) for q in dd._names}
     else:
         # uneven shards: per-shard interior extents differ, so the
         # device-side uniform extraction would embed dead rows; gather
         # the true dd.size interior on host instead (slower, correct)
-        arrays = {q: jnp.asarray(dd.interior_to_host(q))
+        arrays = {q: jnp.asarray(dd.assemble_interior(np.asarray(src[q])))
                   for q in dd._names}
     meta = domain_meta(dd)
     meta["extra"] = {}

@@ -1,0 +1,75 @@
+"""Negative control for the megastep fusion contract: a fused segment
+that RE-REDUCES the health probe on every sub-step.
+
+The megastep's license to ride the production loop is its collective
+bill: a ``check_every=k`` segment lowers to exactly ``k`` x the
+per-step collective-permutes plus ONE small all-reduce per *declared*
+probe row and nothing else. The broken builder here ignores its
+``probe_every=2`` contract and pays a probe reduction after EVERY
+sub-step — the classic fusion regression where instrumentation
+quietly multiplies the all-reduce traffic the fleet's health cadence
+was budgeted for. The hlo checker's ``exact_counts`` pin (2 probe
+rows for k=4, probe_every=2) must flag the 4 emitted all-reduces.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from stencil_tpu.analysis.hlo import HloSpec, HloTarget
+from stencil_tpu.geometry import Dim3
+from stencil_tpu.models.jacobi import jacobi_shard_step
+from stencil_tpu.parallel.exchange import shard_origin
+from stencil_tpu.parallel.megastep import fused_segment_shard, health_probe
+from stencil_tpu.parallel.mesh import make_mesh
+from stencil_tpu.parallel.methods import Method
+from stencil_tpu.resilience.health import probe_shard
+
+K = 4
+PROBE_EVERY = 2  # the declared cadence the broken fusion ignores
+
+
+def _mesh():
+    return make_mesh((2, 2, 2), jax.devices()[:8])
+
+
+def _bad_segment_spec() -> HloSpec:
+    mesh = _mesh()
+    counts = Dim3(2, 2, 2)
+    radius_local = Dim3(12, 12, 12)
+    gsize = Dim3(24, 24, 24)
+    from stencil_tpu.geometry import Radius
+    radius = Radius.constant(1)
+
+    def shard(p, vec):
+        origin = shard_origin(radius_local, Dim3(0, 0, 0))
+
+        def advance(q, c, i):
+            return jacobi_shard_step(q, radius, counts, radius_local,
+                                     gsize, origin, Method.PpermuteSlab)
+
+        # the bug: probe_every=1 hardwired — each of the k sub-steps
+        # pays its own all-reduce, 2x the declared probe bill
+        probe = health_probe(lambda q: {"temp": q}, base_vec=vec)
+        return fused_segment_shard(p, advance, probe, [1] * K,
+                                   probe_every=1)
+
+    spec = P("z", "y", "x")
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(spec, P()),
+                       out_specs=(spec, P()), check_vma=False)
+    vec = jax.ShapeDtypeStruct((2,), jnp.float32)
+    arg = jax.ShapeDtypeStruct((28, 28, 28), jnp.float32)
+    return HloSpec(fn=sm, args=(arg, vec),
+                   allow=("collective_permute", "all_reduce"),
+                   exact_counts={"collective_permute": 6 * K,
+                                 "all_reduce": -(-K // PROBE_EVERY)})
+
+
+TARGETS = [
+    HloTarget("fixture.megastep.reprobed_per_substep[hlo]",
+              _bad_segment_spec),
+]
+
+# silence unused-import style checkers; probe_shard documents what the
+# broken probe ultimately reduces with
+_ = probe_shard

@@ -81,6 +81,57 @@ def test_astaroth_iteration_donates_fields_and_w():
     assert ids == set(range(16)), ids
 
 
+def test_megastep_segment_donates_field_buffer():
+    """The fused campaign segment (parallel/megastep.py) must alias
+    its field state end-to-end: a k-step megastep costs no more HBM
+    than one step."""
+    from stencil_tpu.parallel.megastep import metric_base_vec
+    from stencil_tpu.telemetry.probe import StepMetrics
+
+    j = Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float32,
+                 kernel="xla")
+    j.init()
+    m = StepMetrics(j.dd)
+    seg = j.make_segment(4, probe_every=2, metrics=m)
+    assert seg is not None and seg.fn is not None
+    vec = metric_base_vec(m, 0)
+    compiled = seg.fn.lower(j.dd.curr["temp"], vec).compile()
+    ids = _alias_param_ids(compiled.as_text())
+    assert 0 in ids, "megastep lost its field-buffer donation"
+
+
+def test_domain_megastep_donates_every_field():
+    """The generic DistributedDomain.make_segment donates the WHOLE
+    field dict — every quantity's buffer aliases in place."""
+    import jax
+
+    from stencil_tpu.distributed import DistributedDomain
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.parallel.exchange import exchange_shard
+    from stencil_tpu.parallel.megastep import metric_base_vec
+    from stencil_tpu.parallel.mesh import mesh_dim
+
+    dd = DistributedDomain(16, 16, 16)
+    dd.set_mesh_shape((2, 2, 2))
+    dd.set_radius(1)
+    dd.add_data("a", np.float32)
+    dd.add_data("b", np.float32)
+    dd.realize()
+    counts = mesh_dim(dd.mesh)
+    radius = Radius.constant(1)
+
+    def shard_step(fields):
+        return {q: exchange_shard(p, radius, counts)
+                for q, p in fields.items()}
+
+    dd.make_segment(shard_step, check_every=2)
+    (fn,) = dd._segment_cache.values()
+    vec = metric_base_vec(None, 0)
+    compiled = fn.lower(dict(dd.curr), vec).compile()
+    ids = _alias_param_ids(compiled.as_text())
+    assert {0, 1} <= ids, f"expected both fields donated, got {ids}"
+
+
 def test_donated_exchange_invalidates_input():
     """The donation is real: reusing the donated input raises."""
     from stencil_tpu.distributed import DistributedDomain

@@ -88,13 +88,15 @@ def test_hlo_registry_collective_permute_only():
             assert kinds == {"all_gather"}, (key, kinds)
         elif ("resilience.health" in key
               or "serving.ensemble.probe" in key
-              or "telemetry." in key):
+              or "telemetry." in key
+              or "parallel.megastep" in key):
             # the health sentinels' contract is different by design:
             # exactly ONE small all-reduce (pinned via exact_counts on
             # their HloSpecs; the ensemble probe batches per-member
-            # stats through the same single reduce, and the telemetry
+            # stats through the same single reduce, the telemetry
             # step-metrics columns ride that same reduce — never a
-            # second one)
+            # second one — and the fused megastep carries one such
+            # reduce per declared probe row)
             assert kinds <= {"collective_permute", "all_reduce"}, \
                 (key, kinds)
         else:

@@ -1,0 +1,359 @@
+"""Megastep: whole-campaign fused segments (parallel/megastep.py).
+
+The ISSUE 8 acceptance contract: a ``check_every=k`` segment compiles
+to ONE program that is numerically indistinguishable from the stepwise
+loop (bitwise for Jacobi — periodic AND zero-Dirichlet, even AND
+uneven partitions; accumulator-carrying ~1-ULP for Astaroth), carries
+the per-step health probe in-graph so the driver can locate the exact
+tripped step, donates its state end-to-end, and passes the same
+registry gates as the stepwise path (exact collective counts, exact
+bytes, negative control flagged).
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from stencil_tpu.models.jacobi import Jacobi3D
+from stencil_tpu.parallel.megastep import (MAX_UNROLL, probe_rel_steps,
+                                           segment_chunks)
+
+N = 16
+BAD_FIXTURE = Path(__file__).parent / "fixtures" / "lint" / \
+    "bad_megastep.py"
+
+
+def make_jacobi(**kw):
+    kw.setdefault("mesh_shape", (2, 2, 2))
+    kw.setdefault("dtype", np.float32)
+    kw.setdefault("kernel", "xla")
+    j = Jacobi3D(kw.pop("x", N), kw.pop("y", N), kw.pop("z", N), **kw)
+    j.init()
+    return j
+
+
+# ----------------------------------------------------------------------
+# segmentation helpers
+# ----------------------------------------------------------------------
+def test_segment_chunks_and_probe_points():
+    assert segment_chunks(5) == [1] * 5
+    assert segment_chunks(7, stride=3) == [3, 3, 1]
+    assert probe_rel_steps([1] * 6, 2) == (2, 4, 6)
+    # the final step is ALWAYS probed, cadence or not
+    assert probe_rel_steps([1] * 5, 2) == (2, 4, 5)
+    assert probe_rel_steps([3, 3, 1], 1) == (3, 6, 7)
+    assert MAX_UNROLL >= 16
+
+
+# ----------------------------------------------------------------------
+# fused == stepwise, bitwise (jacobi)
+# ----------------------------------------------------------------------
+def _compare_jacobi(steps=8, seg=None, **kw):
+    a = make_jacobi(**kw)
+    b = make_jacobi(**kw)
+    for _ in range(steps):
+        a.step()
+    done = 0
+    while done < steps:
+        k = min(seg or steps, steps - done)
+        s = b.make_segment(k)
+        assert s is not None and s.steps == k
+        s.run(done)
+        done += k
+    np.testing.assert_array_equal(a.temperature(), b.temperature())
+
+
+def test_jacobi_segment_bitwise_periodic():
+    _compare_jacobi(steps=8, seg=4)
+
+
+def test_jacobi_segment_bitwise_uneven_partitions():
+    _compare_jacobi(steps=6, seg=3, x=17, y=17, z=17)
+
+
+def test_jacobi_segment_bitwise_boundary_none():
+    from stencil_tpu.topology import Boundary
+    _compare_jacobi(steps=6, seg=3, boundary=Boundary.NONE)
+
+
+def test_jacobi_segment_bitwise_uneven_none():
+    from stencil_tpu.topology import Boundary
+    _compare_jacobi(steps=5, seg=2, x=17, y=17, z=17,
+                    boundary=Boundary.NONE)
+
+
+def test_jacobi_temporal_segment_bitwise():
+    """exchange_every=2: the fused segment advances whole temporal
+    groups plus depth-1 tails, bitwise-equal to the blocked loop."""
+    a = make_jacobi(exchange_every=2)
+    assert a.kernel_path == "xla-temporal[s=2]"
+    b = make_jacobi(exchange_every=2)
+    a.run(7)
+    s = b.make_segment(7)
+    # 3 groups of 2 + 1 tail step, probed per chunk
+    assert s.probe_steps == (2, 4, 6, 7)
+    s.run(0)
+    np.testing.assert_array_equal(a.temperature(), b.temperature())
+
+
+def test_fast_paths_decline_segments():
+    """Interior-resident Pallas paths keep their own fused loops: the
+    factory returns None and the driver falls back to stepwise."""
+    import jax
+
+    j = Jacobi3D(16, 16, 16, mesh_shape=(1, 1, 1),
+                 devices=jax.devices()[:1], dtype=np.float32,
+                 kernel="wrap")
+    j.init()
+    assert j.make_segment(4) is None
+
+
+# ----------------------------------------------------------------------
+# the in-graph probe trace
+# ----------------------------------------------------------------------
+def test_segment_trace_rows_and_metrics():
+    from stencil_tpu.telemetry.probe import StepMetrics
+
+    j = make_jacobi()
+    m = StepMetrics(j.dd)
+    seg = j.make_segment(6, probe_every=2, metrics=m)
+    tr = seg.run(10)
+    assert tr.steps == (2, 4, 6)
+    assert tr.abs_steps == [12, 14, 16]
+    host = np.asarray(tr.array)
+    # columns: temp, substeps, wire_bytes; rows replicated f32
+    assert host.shape == (3, 2, 3)
+    np.testing.assert_array_equal(host[:, 0, 1], [12.0, 14.0, 16.0])
+    np.testing.assert_allclose(
+        host[:, 0, 2],
+        [m.cumulative_bytes(s) for s in (12, 14, 16)], rtol=1e-6)
+    # health columns are real: nonfinite 0, max-abs 1 (hot sphere)
+    assert host[0, 0, 0] == 0.0
+    assert host[0, 1, 0] == pytest.approx(1.0)
+
+
+def test_sentinel_locates_exact_tripped_step_in_trace():
+    """A NaN planted mid-segment: the trace row of ITS step trips, with
+    earlier rows clean — the driver learns the exact step without
+    replaying the segment."""
+    from stencil_tpu.resilience.health import HealthSentinel
+
+    j = make_jacobi()
+    s = HealthSentinel(j.dd)
+    clean = j.dd.curr["temp"]
+    rows = []
+    for i in range(4):
+        p = clean if i < 2 else clean.at[3, 3, 3].set(float("nan"))
+        rows.append(jnp.stack([
+            jnp.stack([jnp.sum(~jnp.isfinite(p)).astype(jnp.float32)]),
+            jnp.stack([jnp.max(jnp.abs(jnp.nan_to_num(p)))]),
+        ]))
+    s.observe_segment(jnp.stack(rows), steps=[5, 6, 7, 8])
+    results = s.poll(block=True)
+    assert [r.step for r in results] == [5, 6, 7, 8]
+    assert [r.tripped for r in results] == [False, False, True, True]
+    assert s.tripped.step == 7
+
+
+def test_driver_fused_equals_stepwise(tmp_path):
+    """run_resilient fused (default) vs fuse_segments=False: identical
+    final state, identical checkpoint trail."""
+    from stencil_tpu.resilience import ResiliencePolicy
+
+    def pol(fused):
+        return ResiliencePolicy(check_every=3, ckpt_every=4,
+                                base_delay=0.0, sleep=lambda s: None,
+                                fuse_segments=fused)
+
+    a = make_jacobi()
+    ra = a.run_resilient(10, policy=pol(True),
+                         ckpt_dir=str(tmp_path / "fused"))
+    b = make_jacobi()
+    rb = b.run_resilient(10, policy=pol(False),
+                         ckpt_dir=str(tmp_path / "stepwise"))
+    assert ra.steps == rb.steps == 10
+    np.testing.assert_array_equal(a.temperature(), b.temperature())
+    from stencil_tpu.utils.checkpoint import all_steps
+    assert sorted(all_steps(str(tmp_path / "fused"))) == \
+        sorted(all_steps(str(tmp_path / "stepwise")))
+
+
+def test_driver_fused_rollback_bitwise(tmp_path):
+    """A NaN inside a fused segment: rollback restores and the final
+    state is bitwise-equal to the fault-free run — with the trip
+    located at the exact injected step in the event log."""
+    from stencil_tpu.resilience import (FaultPlan, NaNInjection,
+                                        ResiliencePolicy)
+
+    clean = make_jacobi()
+    clean.run(12)
+
+    j = make_jacobi()
+    plan = FaultPlan(nans=[NaNInjection(step=7)])
+    rep = j.run_resilient(
+        12, policy=ResiliencePolicy(check_every=4, ckpt_every=4,
+                                    base_delay=0.0,
+                                    sleep=lambda s: None),
+        ckpt_dir=str(tmp_path), faults=plan)
+    assert rep.steps == 12 and rep.rollbacks == 1
+    trips = [e for e in rep.events if e["event"] == "sentinel_tripped"]
+    assert trips and trips[0]["step"] == 7
+    np.testing.assert_array_equal(j.temperature(), clean.temperature())
+
+
+# ----------------------------------------------------------------------
+# DistributedDomain.make_segment (the generic entry)
+# ----------------------------------------------------------------------
+def test_domain_make_segment_generic():
+    from stencil_tpu.distributed import DistributedDomain
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.parallel.exchange import exchange_shard
+    from stencil_tpu.parallel.mesh import mesh_dim
+
+    dd = DistributedDomain(16, 16, 16)
+    dd.set_mesh_shape((2, 2, 2))
+    dd.set_radius(1)
+    dd.add_data("a", np.float32)
+    dd.add_data("b", np.float32)
+    dd.realize()
+    counts = mesh_dim(dd.mesh)
+    radius = Radius.constant(1)
+
+    def shard_step(fields):
+        out = {}
+        for q, p in fields.items():
+            p = exchange_shard(p, radius, counts)
+            out[q] = p * 0.5
+        return out
+
+    dd.curr["a"] = dd.curr["a"] + 1.0
+    dd.curr["b"] = dd.curr["b"] + 2.0
+    seg = dd.make_segment(shard_step, check_every=3)
+    tr = seg.run(0)
+    assert tr.steps == (1, 2, 3)
+    host = np.asarray(tr.array)
+    assert host.shape == (3, 2, 2)  # rows x (nonfinite,max) x {a,b}
+    np.testing.assert_allclose(host[:, 1, 0], [0.5, 0.25, 0.125])
+    np.testing.assert_allclose(host[:, 1, 1], [1.0, 0.5, 0.25])
+    np.testing.assert_allclose(np.asarray(dd.curr["a"]),
+                               np.full_like(host[0, 0, 0], 0.125),
+                               rtol=0)
+
+
+# ----------------------------------------------------------------------
+# astaroth: accumulator carry
+# ----------------------------------------------------------------------
+def test_astaroth_segment_accumulator_carry():
+    """Fused RK3 segments vs stepwise: <= 1 ULP on the fields AND the
+    carried w accumulators (float64 on CPU pins the comparison)."""
+    from stencil_tpu.models.astaroth import Astaroth, MhdParams
+
+    prm = MhdParams()
+    a = Astaroth(8, 8, 8, params=prm, mesh_shape=(2, 2, 2),
+                 dtype=np.float64)
+    a.init()
+    b = Astaroth(8, 8, 8, params=prm, mesh_shape=(2, 2, 2),
+                 dtype=np.float64)
+    b.init()
+    for _ in range(2):
+        a.step()
+    seg = b.make_segment(2)
+    tr = seg.run(0)
+    assert tr.steps == (1, 2)
+    assert np.asarray(tr.array).shape == (2, 2, 8)
+    for q in ("lnrho", "uux", "ax", "ss"):
+        np.testing.assert_allclose(b.field(q), a.field(q),
+                                   rtol=1e-12, atol=1e-15)
+        np.testing.assert_allclose(np.asarray(b._w[q]),
+                                   np.asarray(a._w[q]),
+                                   rtol=1e-12, atol=1e-15)
+
+
+# ----------------------------------------------------------------------
+# ensemble: batched segments
+# ----------------------------------------------------------------------
+def test_ensemble_segment_matches_stepwise_run():
+    from stencil_tpu.serving.ensemble import EnsembleJacobi
+
+    a = EnsembleJacobi(4, 16, 16, 16, mesh_shape=(2, 2, 2))
+    a.init()
+    a.set_member_params(2, {"hot_temp": 1.25})
+    b = EnsembleJacobi(4, 16, 16, 16, mesh_shape=(2, 2, 2))
+    b.init()
+    b.set_member_params(2, {"hot_temp": 1.25})
+    a.run(5)
+    tr = b.run_segment(5)
+    assert tr.steps == (1, 2, 3, 4, 5)
+    host = np.asarray(tr.array)
+    assert host.shape == (5, 4, 2, 1)  # rows x members x stats x temp
+    assert not host[:, :, 0, :].any()  # all members finite throughout
+    for k in range(4):
+        np.testing.assert_array_equal(a.member_interior("temp", k),
+                                      b.member_interior("temp", k))
+
+
+def test_ensemble_segment_trace_isolates_tripped_member():
+    from stencil_tpu.serving.ensemble import (EnsembleJacobi,
+                                              EnsembleSentinel)
+
+    eng = EnsembleJacobi(4, 16, 16, 16, mesh_shape=(2, 2, 2))
+    eng.init()
+    host = eng.member_interior("temp", 1)
+    host[0, 0, 0] = np.nan
+    eng.set_member_interior("temp", 1, host)
+    sentinel = EnsembleSentinel(eng)
+    tr = eng.run_segment(3)
+    sentinel.observe_segment(tr.array, [r for r in tr.steps])
+    healths = sentinel.poll(block=True)
+    assert [h.step for h in healths] == [1, 2, 3]
+    for h in healths:
+        assert h.tripped_members == [1]
+
+
+# ----------------------------------------------------------------------
+# registry gates
+# ----------------------------------------------------------------------
+def test_megastep_registry_targets_prove_exact_counts():
+    """The shipped megastep targets pass: k x per-step ppermutes + one
+    all-reduce per probe row, bytes exactly k x the per-step model."""
+    from stencil_tpu.analysis import run_targets
+    from stencil_tpu.analysis.hlo import lowering_supported
+    from stencil_tpu.analysis.registry import default_targets
+
+    if not lowering_supported():
+        pytest.skip("StableHLO lowering unavailable")
+    targets = [t for t in default_targets() if "megastep" in t.name]
+    assert {t.name for t in targets} == {
+        "parallel.megastep.segment[k=4,hlo]",
+        "parallel.megastep.segment[k=4,cost]"}
+    report = run_targets(targets)
+    assert not report.findings, report.findings
+    hlo = report.metrics["hlo:parallel.megastep.segment[k=4,hlo]"]
+    assert hlo["collectives"]["collective_permute"]["count"] == 24
+    assert hlo["collectives"]["all_reduce"]["count"] == 2
+    cost = report.metrics[
+        "costmodel:parallel.megastep.segment[k=4,cost]"]
+    # exact-byte cross-check: observed == expected == k x per-step
+    assert cost["observed_bytes_per_shard"] == \
+        cost["expected_bytes_per_shard"]
+
+
+def test_reprobed_megastep_fixture_flagged():
+    """The negative control — a fused segment re-reducing the probe on
+    every sub-step — is flagged with a nonzero CLI exit."""
+    from stencil_tpu.analysis.hlo import lowering_supported
+
+    if not lowering_supported():
+        pytest.skip("StableHLO lowering unavailable")
+    proc = subprocess.run(
+        [sys.executable, "-m", "stencil_tpu.analysis",
+         str(BAD_FIXTURE)],
+        capture_output=True, text=True,
+        cwd=str(Path(__file__).parent.parent), timeout=600)
+    assert proc.returncode != 0, proc.stdout + proc.stderr
+    assert "all_reduce" in proc.stdout
+    assert "requires exactly 2" in proc.stdout

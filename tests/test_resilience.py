@@ -261,8 +261,9 @@ def test_independent_incidents_get_fresh_retry_budgets(tmp_path,
 
 def test_one_probe_per_step_at_checkpoint_boundaries(tmp_path,
                                                      monkeypatch):
-    """check_every=1 with ckpt_every=2: boundary steps are probed by
-    the blocking drain ONLY — never a duplicate async reduction."""
+    """Stepwise dispatch loop (fusion off): check_every=1 with
+    ckpt_every=2 — boundary steps are probed by the blocking drain
+    ONLY, never a duplicate async reduction."""
     from stencil_tpu.resilience import driver as drv
 
     calls = []
@@ -274,9 +275,38 @@ def test_one_probe_per_step_at_checkpoint_boundaries(tmp_path,
 
     monkeypatch.setattr(drv, "HealthSentinel", Counting)
     j = make_jacobi()
-    j.run_resilient(4, policy=fast_policy(ckpt_every=2),
+    j.run_resilient(4, policy=fast_policy(ckpt_every=2,
+                                          fuse_segments=False),
                     ckpt_dir=str(tmp_path))
     assert calls == [1, 2, 3, 4]
+
+
+def test_fused_loop_probes_ride_the_segment_trace(tmp_path,
+                                                  monkeypatch):
+    """Megastep mode (the default): every step's health arrives as a
+    row of the fused segment's in-graph trace — zero standalone probe
+    dispatches on the fault-free path, one observe per segment."""
+    from stencil_tpu.resilience import driver as drv
+
+    probes, traces = [], []
+
+    class Counting(drv.HealthSentinel):
+        def probe(self, fields, step):
+            probes.append(step)
+            super().probe(fields, step)
+
+        def observe_segment(self, trace, steps):
+            traces.append(tuple(steps))
+            super().observe_segment(trace, steps)
+
+    monkeypatch.setattr(drv, "HealthSentinel", Counting)
+    j = make_jacobi()
+    rep = j.run_resilient(4, policy=fast_policy(ckpt_every=2,
+                                                check_every=2),
+                          ckpt_dir=str(tmp_path))
+    assert rep.steps == 4
+    assert probes == []               # no per-step probe dispatches
+    assert traces == [(1, 2), (3, 4)]  # per-step rows, 2 megasteps
 
 
 def test_retries_and_ladder_exhausted_raises(tmp_path):
