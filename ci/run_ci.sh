@@ -24,9 +24,11 @@ cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
 echo "== 1/10 lint (stencil-lint + ruff; tier=$TIER) =="
-# stencil-lint: all six static checkers — halo-radius footprint, DMA
+# stencil-lint: all nine static checkers — halo-radius footprint, DMA
 # discipline, ppermute sanity, HLO collective-permute-only lowering,
-# analytic-vs-HLO byte cross-check, and the Pallas VMEM/tiling audit
+# analytic-vs-HLO byte cross-check, the Pallas VMEM/tiling audit, and
+# the dataflow trio (donation aliasing, host-transfer hygiene,
+# recompile-hazard fingerprints)
 # (python -m stencil_tpu.analysis, see README "Static analysis").
 # The hlo/costmodel byte checks capability-gate themselves on the
 # image's JAX (StableHLO lowering support is probed; Pallas targets
@@ -46,6 +48,20 @@ if [ "$lint_rc" -ne 0 ]; then
   echo "stencil-lint failed (exit $lint_rc)"
   exit "$lint_rc"
 fi
+# registry-count ratchet: audit coverage may only grow. A refactor
+# that drops targets (deregisters an entry point, deletes a checker
+# block) must bump ci/registry_floor.txt EXPLICITLY in review — it
+# cannot shrink the gate silently.
+python - stencil_lint_report.json ci/registry_floor.txt <<'EOF'
+import json
+import sys
+n = json.load(open(sys.argv[1]))["counts"]["targets"]
+floor = int(open(sys.argv[2]).read().split()[0])
+assert n >= floor, \
+    f"registry shrank: {n} targets < committed floor {floor} " \
+    f"(ci/registry_floor.txt) — audit coverage silently dropped"
+print(f"registry ratchet OK: {n} targets >= committed floor {floor}")
+EOF
 if python -c "import ruff" 2>/dev/null; then
   python -m ruff check stencil_tpu/
 elif command -v ruff >/dev/null; then
@@ -221,9 +237,15 @@ echo "== 7/10 chaos smoke: resilient run loop under injected faults =="
 # IOError (must be retried with backoff, not kill the run). The run
 # must COMPLETE all iterations with >= 1 rollback and >= 1 save retry
 # recorded; the resilience event log JSON is the CI artifact.
+# The fused dispatch runs under jax.transfer_guard("disallow") (the
+# driver wires it; STENCIL_ALLOW_TRANSFERS=1 is the escape hatch) and
+# under the recompile watchdog (STENCIL_ASSERT_SINGLE_COMPILE=1 set
+# here): an implicit host transfer or a re-traced megastep inside the
+# hot loop fails this stage loudly.
 CHAOS_CKPT="$(mktemp -d -t chaos_ckpt.XXXXXX)"
 CHAOS_EVENTS="$(mktemp -t chaos_events.XXXXXX.json)"
 ( cd apps
+  STENCIL_ASSERT_SINGLE_COMPILE=1 \
   python jacobi3d.py --x 8 --y 8 --z 8 --iters 12 --fake-cpu 8 \
         --resilient --fuse-segments --ckpt-dir "$CHAOS_CKPT" \
         --ckpt-every 4 --check-every 1 --chaos-nan 6 \

@@ -1,8 +1,9 @@
 """stencil-lint / stencil-audit: static invariant checking for the
 stencil framework.
 
-Six checkers prove, WITHOUT executing anything (jaxpr tracing plus
-lower-only StableHLO inspection — seconds on any CPU box, no TPU, no
+Nine checkers prove, WITHOUT executing anything (jaxpr tracing plus
+lower-only StableHLO inspection and alias-map parsing of compiled —
+never dispatched — programs; seconds on any CPU box, no TPU, no
 interpreter), the invariants the whole framework hangs on:
 
 * :mod:`.footprint`   — every registered stencil op's true access
@@ -21,11 +22,21 @@ interpreter), the invariants the whole framework hangs on:
   (uneven remainders included), plus jaxpr FLOPs / arithmetic
   intensity metrics;
 * :mod:`.vmem`        — every Pallas kernel's VMEM footprint fits the
-  budget and its blocks respect (8, 128) tiling and grid divisibility.
+  budget and its blocks respect (8, 128) tiling and grid divisibility;
+* :mod:`.donation`    — every declared ``donate_argnums`` buffer of
+  every jitted entry point actually appears in the compiled
+  ``input_output_alias`` map (donated-but-copied is an ERROR);
+* :mod:`.transfer`    — no host-callback/infeed/outfeed/host-memory
+  escape inside any step or segment hot path (plus the runtime
+  ``jax.transfer_guard("disallow")`` the drivers dispatch under);
+* :mod:`.recompile`   — every entry point's abstract fingerprint is
+  dispatch-stable: no Python-scalar args, no weak-type promotion, no
+  dtype/shape drift between paired curr/next buffers (plus the
+  runtime ``assert_single_compile`` trace-count guard).
 
 Run ``python -m stencil_tpu.analysis`` (exit nonzero on findings,
 ``--json`` for the CI artifact, ``--only``/``--list`` to select
-checkers), or use :func:`run_targets` /
+checkers or glob target names), or use :func:`run_targets` /
 :func:`stencil_tpu.analysis.registry.default_targets` from pytest.
 """
 
@@ -38,13 +49,20 @@ from .collectives import (CollectiveSpec, CollectiveTarget,
                           check_collectives)
 from .costmodel import CostModelSpec, CostModelTarget, check_costmodel
 from .dma import PallasKernelSpec, PallasKernelTarget, check_pallas_kernels
+from .donation import (DonationSpec, DonationTarget, alias_param_ids,
+                       check_donation)
 from .footprint import StencilOpSpec, StencilOpTarget, check_stencil_op
 from .hlo import HloSpec, HloTarget, check_hlo
+from .recompile import (RecompileGuardError, RecompileSpec,
+                        RecompileTarget, SingleCompileGuard,
+                        assert_single_compile, check_recompile)
 from .report import ERROR, WARNING, Finding, Report
+from .transfer import (TransferSpec, TransferTarget, check_transfer,
+                       hot_loop_transfer_guard)
 from .vmem import VmemSpec, VmemTarget, check_vmem
 
 CHECKERS = ("footprint", "dma", "collectives", "hlo", "costmodel",
-            "vmem")
+            "vmem", "donation", "transfer", "recompile")
 
 CHECKER_DOC = {
     "footprint": "26-direction access footprint vs declared Radius",
@@ -53,16 +71,24 @@ CHECKER_DOC = {
     "hlo": "collective-permute-only lowering (StableHLO audit)",
     "costmodel": "HLO bytes vs analytic halo model + FLOPs/AI",
     "vmem": "Pallas VMEM footprint, (8,128) tiling, grid divisibility",
+    "donation": "donate_argnums buffers alias in the compiled HLO",
+    "transfer": "no host-callback/infeed/outfeed escape in hot paths",
+    "recompile": "dispatch-stable abstract fingerprints (no retrace)",
 }
 
 __all__ = [
     "CHECKERS", "CHECKER_DOC", "ERROR", "WARNING", "Finding", "Report",
     "CollectiveSpec", "CollectiveTarget", "CostModelSpec",
-    "CostModelTarget", "HloSpec", "HloTarget", "PallasKernelSpec",
-    "PallasKernelTarget", "StencilOpSpec", "StencilOpTarget",
-    "VmemSpec", "VmemTarget", "check_collectives", "check_costmodel",
-    "check_hlo", "check_pallas_kernels", "check_stencil_op",
-    "check_vmem", "run_targets",
+    "CostModelTarget", "DonationSpec", "DonationTarget", "HloSpec",
+    "HloTarget", "PallasKernelSpec", "PallasKernelTarget",
+    "RecompileGuardError", "RecompileSpec", "RecompileTarget",
+    "SingleCompileGuard", "StencilOpSpec", "StencilOpTarget",
+    "TransferSpec", "TransferTarget", "VmemSpec", "VmemTarget",
+    "alias_param_ids", "assert_single_compile", "check_collectives",
+    "check_costmodel", "check_donation", "check_hlo",
+    "check_pallas_kernels", "check_recompile", "check_stencil_op",
+    "check_transfer", "check_vmem", "hot_loop_transfer_guard",
+    "run_targets",
 ]
 
 _DISPATCH = {
@@ -72,6 +98,9 @@ _DISPATCH = {
     "hlo": check_hlo,
     "costmodel": check_costmodel,
     "vmem": check_vmem,
+    "donation": check_donation,
+    "transfer": check_transfer,
+    "recompile": check_recompile,
 }
 
 

@@ -4,16 +4,21 @@ Exit status: 0 when every checked invariant holds, 1 when any
 error-severity finding exists, 2 on usage errors. ``--json PATH``
 writes the machine-readable report (schema in ``report.py``) for CI
 artifacts. ``--only NAME`` (or the legacy spelling ``--checker``)
-restricts the run to one checker (repeatable); ``--list`` enumerates
-the checkers and exits. Positional arguments are fixture module paths
-(files defining ``TARGETS``) checked INSTEAD of the shipped registry —
-the negative-control hook: the CLI must exit nonzero on every fixture
+restricts the run to one checker when NAME is a checker name, or to
+the registry targets matching NAME as a glob pattern otherwise
+(``--only 'telemetry.*'``); repeatable, and the two forms compose
+(checker filter AND target filter). ``--list`` enumerates the
+checkers plus the registry target counts per group and exits.
+Positional arguments are fixture module paths (files defining
+``TARGETS``) checked INSTEAD of the shipped registry — the
+negative-control hook: the CLI must exit nonzero on every fixture
 under ``tests/fixtures/lint/``.
 """
 
 from __future__ import annotations
 
 import argparse
+import fnmatch
 import sys
 from typing import List, Optional
 
@@ -38,7 +43,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m stencil_tpu.analysis",
         description="stencil-lint: static halo-radius / DMA-discipline "
                     "/ collective-permutation / HLO-lowering / "
-                    "cost-model / VMEM checks (no execution)")
+                    "cost-model / VMEM / donation / host-transfer / "
+                    "recompile checks (no execution)")
     parser.add_argument("fixtures", nargs="*",
                         help="fixture module paths (files defining "
                              "TARGETS) to check instead of the shipped "
@@ -46,10 +52,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--json", metavar="PATH",
                         help="write the JSON report here")
     parser.add_argument("--only", "--checker", action="append",
-                        dest="checkers", choices=CHECKERS,
-                        help="run only this checker (repeatable)")
+                        dest="only", metavar="CHECKER|GLOB",
+                        help="run only this checker (exact checker "
+                             "name) or only the targets matching this "
+                             "glob pattern, e.g. 'telemetry.*' "
+                             "(repeatable; forms compose)")
     parser.add_argument("--list", action="store_true", dest="list_",
-                        help="list the available checkers and exit")
+                        help="list the available checkers and the "
+                             "registry target counts per group, then "
+                             "exit")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the per-target OK lines")
     args = parser.parse_args(argv)
@@ -57,7 +68,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_:
         for name in CHECKERS:
             print(f"  {name:<12} {CHECKER_DOC[name]}")
+        from .registry import default_targets
+
+        targets = default_targets()
+        groups: dict = {}
+        for t in targets:
+            g = t.name.split(".", 1)[0]
+            groups.setdefault(g, {})
+            groups[g][t.checker] = groups[g].get(t.checker, 0) + 1
+        print(f"\n  {len(targets)} registry targets by group:")
+        for g in sorted(groups):
+            per = " ".join(f"{c}={n}"
+                           for c, n in sorted(groups[g].items()))
+            print(f"    {g:<12} {sum(groups[g].values()):>3}  ({per})")
         return 0
+
+    checkers = [v for v in (args.only or []) if v in CHECKERS]
+    patterns = [v for v in (args.only or []) if v not in CHECKERS]
 
     _setup_backend()
 
@@ -75,7 +102,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"stencil-lint: cannot load targets: {e}", file=sys.stderr)
         return 2
 
-    report = run_targets(targets, checkers=args.checkers)
+    if patterns:
+        # EVERY pattern must match something: a typo'd glob among
+        # several must fail the run, not silently drop its coverage
+        unmatched = [p for p in patterns
+                     if not any(fnmatch.fnmatchcase(t.name, p)
+                                for t in targets)]
+        if unmatched:
+            print(f"stencil-lint: no targets match {unmatched} "
+                  f"(values that are not checker names filter target "
+                  f"names by glob)", file=sys.stderr)
+            return 2
+        targets = [t for t in targets
+                   if any(fnmatch.fnmatchcase(t.name, p)
+                          for p in patterns)]
+    if checkers and not any(t.checker in checkers for t in targets):
+        # a checker filter + glob that intersect to nothing would be a
+        # vacuously green run — the same silent coverage drop the
+        # unmatched-glob guard above refuses
+        print(f"stencil-lint: the --only filters select no targets "
+              f"(checkers {checkers} x {len(targets)} matched "
+              f"target(s))", file=sys.stderr)
+        return 2
+
+    report = run_targets(targets, checkers=checkers or None)
 
     if not args.quiet:
         flagged = {f.target.split(":", 1)[0] for f in report.findings}

@@ -1,0 +1,211 @@
+"""Checker 7: buffer-donation audit — donated means *aliased*, proven.
+
+Every hot-path entry point in this library jits with
+``donate_argnums`` so the curr/next double-buffer swap costs no HBM
+copy: the model step loops, the exchange orchestrator
+(``make_exchange``), the fused megastep segments, and the ensemble
+step/segment/lane programs. Donation is also the property that
+silently disappears: a refactor that re-wraps a jitted function
+without ``donate_argnums``, or an innocent ``astype`` that changes the
+output's byte width, drops the alias and XLA quietly COPIES — the step
+still computes the right answer, just with an extra O(domain) HBM
+round-trip per dispatch. The only artifact that tells the truth is the
+compiled program's ``input_output_alias`` map, so this checker compiles
+each registered entry point (CPU backend, seconds — the alias map is a
+lowering-level contract XLA:TPU consumes verbatim) and proves every
+leaf of every declared-donated argument appears in it. A
+donated-but-copied buffer is an ERROR.
+
+:func:`alias_param_ids` is the single alias-map parser — promoted from
+``tests/test_donation.py``, which (with ``tests/test_megastep.py``) now
+asserts through it instead of duplicating the regex.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Sequence, Set, Tuple
+
+from .report import ERROR, Finding
+
+# the HLO entry computation's alias map, e.g.
+#   input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, ...) }
+# the body nests braces ({0} output indices, {} param indices), so the
+# block is extracted by brace counting, not a non-greedy regex (which
+# would stop at the first '}' and see an empty map); each "(N," in the
+# body names an aliased parameter
+_ALIAS_ATTR = "input_output_alias={"
+_ALIAS_PARAM_RE = re.compile(r"\((\d+),")
+
+
+def _alias_block(compiled_text: str) -> str:
+    """The brace-balanced body of the ``input_output_alias`` attribute,
+    or '' when the program has no alias map."""
+    start = compiled_text.find(_ALIAS_ATTR)
+    if start < 0:
+        return ""
+    i = start + len(_ALIAS_ATTR)
+    depth = 1
+    for j in range(i, len(compiled_text)):
+        c = compiled_text[j]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return compiled_text[i:j]
+    return compiled_text[i:]
+
+
+def alias_param_ids(compiled_text: str) -> Set[int]:
+    """Parameter numbers appearing in the compiled HLO's
+    ``input_output_alias`` map. A program with no alias map at all
+    returns the empty set (nothing aliases)."""
+    return {int(p)
+            for p in _ALIAS_PARAM_RE.findall(_alias_block(compiled_text))}
+
+
+def compiled_alias_ids(fn: Callable, args: Sequence[Any]) -> Set[int]:
+    """Compile the (already-jitted) entry point and parse its alias
+    map. Nothing executes — ``lower().compile()`` only."""
+    return alias_param_ids(fn.lower(*args).compile().as_text())
+
+
+def _kept_param_order(compiled, n_leaves: int) -> List[int]:
+    """Flat input-leaf indices actually KEPT as entry parameters, in
+    parameter order: ``jit``'s default ``keep_unused=False`` drops
+    unused inputs from the executable and renumbers the rest, so the
+    alias map speaks post-drop numbering. Falls back to the identity
+    when this JAX doesn't expose the kept set."""
+    try:
+        kept = compiled._executable._kept_var_idx
+        return sorted(int(i) for i in kept)
+    except Exception:  # noqa: BLE001 - private API; identity fallback
+        return list(range(n_leaves))
+
+
+@dataclasses.dataclass
+class DonationSpec:
+    """A jitted entry point plus its donation contract.
+
+    ``fn`` must be the SHIPPED jitted callable (its ``donate_argnums``
+    were declared where it was built — wrapping it in a fresh ``jit``
+    here would erase exactly the property under audit). A plain
+    callable is accepted for fixtures and is jitted WITHOUT donation
+    (modelling the refactor that lost it). ``donate_argnums`` declares
+    which positional args the contract says must fully alias.
+    """
+
+    fn: Callable
+    args: Sequence[Any]
+    donate_argnums: Tuple[int, ...] = (0,)
+
+
+@dataclasses.dataclass
+class DonationTarget:
+    name: str
+    build: Callable[[], DonationSpec]
+
+    checker = "donation"
+
+
+def _leaf_bytes(leaf: Any) -> int:
+    import numpy as np
+
+    shape = tuple(getattr(leaf, "shape", ()))
+    dtype = getattr(leaf, "dtype", None)
+    itemsize = np.dtype(dtype).itemsize if dtype is not None else 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * itemsize
+
+
+def donated_param_map(args: Sequence[Any],
+                      donate_argnums: Sequence[int]
+                      ) -> Tuple[Dict[int, str], int]:
+    """Map each donated flat parameter id to a human-readable leaf path
+    (HLO entry parameters number the flattened positional args in
+    order), plus the total donated bytes."""
+    import jax
+
+    donate = set(int(d) for d in donate_argnums)
+    out: Dict[int, str] = {}
+    donated_bytes = 0
+    i = 0
+    for argnum, a in enumerate(args):
+        leaves_with_paths = jax.tree_util.tree_flatten_with_path(a)[0]
+        for path, leaf in leaves_with_paths:
+            if argnum in donate:
+                keys = "".join(str(k) for k in path)
+                out[i] = f"arg{argnum}{keys}"
+                donated_bytes += _leaf_bytes(leaf)
+            i += 1
+    return out, donated_bytes
+
+
+def check_donation(target: DonationTarget) -> Tuple[List[Finding], Dict]:
+    """Prove every declared-donated buffer of the target actually
+    aliases in the compiled program."""
+    from .hlo import lowering_supported, pallas_unlowerable
+
+    try:
+        spec = target.build()
+    except Exception as e:  # noqa: BLE001
+        return [Finding("donation", target.name,
+                        f"target build failed: {type(e).__name__}: {e}")], {}
+    if not lowering_supported():
+        return [], {"skipped": "StableHLO lowering unavailable in this "
+                               "JAX/backend"}
+    fn = spec.fn
+    if not hasattr(fn, "lower"):
+        import jax
+
+        # fixture hook: a bare callable models the jit that LOST its
+        # donate_argnums — audited as shipped, i.e. without donation
+        fn = jax.jit(fn)
+    try:
+        if pallas_unlowerable(fn, spec.args):
+            return [], {"skipped": "contains pallas_call; compiling "
+                                   "needs a TPU backend"}
+    except Exception as e:  # noqa: BLE001
+        return [Finding("donation", target.name,
+                        f"trace failed: {type(e).__name__}: {e}")], {}
+    try:
+        compiled = fn.lower(*spec.args).compile()
+    except Exception as e:  # noqa: BLE001
+        return [Finding("donation", target.name,
+                        f"compile failed: {type(e).__name__}: {e}")], {}
+    aliased = alias_param_ids(compiled.as_text())
+
+    expected, donated_bytes = donated_param_map(spec.args,
+                                                spec.donate_argnums)
+    import jax
+
+    n_leaves = len(jax.tree_util.tree_leaves(list(spec.args)))
+    kept = _kept_param_order(compiled, n_leaves)
+    metrics = {"donated_bytes": donated_bytes,
+               "donated_leaves": len(expected),
+               "aliased_params": sorted(aliased)}
+    findings: List[Finding] = []
+    for flat_id in sorted(expected):
+        if flat_id not in kept:
+            findings.append(Finding(
+                "donation", target.name,
+                f"declared-donated buffer {expected[flat_id]} is "
+                f"UNUSED by the compiled program (jit dropped the "
+                f"parameter) — the donation contract names a buffer "
+                f"the entry point never consumes", ERROR))
+            continue
+        pid = kept.index(flat_id)
+        if pid not in aliased:
+            findings.append(Finding(
+                "donation", target.name,
+                f"declared-donated buffer {expected[flat_id]} (entry "
+                f"parameter {pid}) is missing from the compiled "
+                f"input_output_alias map — the donation is dead and "
+                f"XLA copies this buffer every dispatch "
+                f"(aliased params: {sorted(aliased) or 'none'})",
+                ERROR))
+    return findings, metrics

@@ -1,0 +1,277 @@
+"""Checker 9: recompile-hazard audit — one compile per fingerprint.
+
+A jitted entry point's compile is amortized over a campaign; a
+fingerprint that drifts between dispatches re-traces and re-compiles
+*every* dispatch, which at serving scale is the difference between an
+engine-cache hit and a multi-second stall per request. The drifts are
+always the same three, and all three are visible statically:
+
+* **Python-scalar arguments** — a driver that passes a bare ``int``/
+  ``float`` traces it as a *weak*-typed scalar; the same call made
+  later with a device array (or by a different driver) is a different
+  fingerprint, so the cache forks per call-site style. Entry points
+  must take committed arrays (``jnp.asarray(n, jnp.int32)`` — exactly
+  what the shipped run loops do).
+* **weak-type promotion** — a carried output that picks up
+  ``weak_type=True`` (a state leaf rebuilt from a Python scalar) feeds
+  back a different aval than the strong array it replaces: retrace on
+  the next dispatch, every dispatch.
+* **dtype/shape drift between paired curr/next buffers** — the donated
+  double-buffer contract requires the carried output aval to equal the
+  input aval exactly; an ``astype`` (or a dropped field) makes every
+  dispatch after the first a cache miss.
+
+The checker needs only ``jax.eval_shape`` — no lowering, no compile —
+and records each entry point's canonical abstract-signature
+fingerprint as a metric, so the JSON artifact doubles as a
+fingerprint manifest.
+
+The static gate has a runtime twin: :func:`assert_single_compile` /
+:class:`SingleCompileGuard` watch a jitted function's trace-cache size
+across dispatches (``STENCIL_ASSERT_SINGLE_COMPILE=1`` arms the guard
+inside ``resilience/driver.py`` and the ``CampaignService`` batch
+loop), so a hazard that slips past the static model still fails
+loudly instead of silently recompiling forever.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .report import ERROR, Finding
+
+#: arm the runtime trace-count guard in the drivers/service
+ASSERT_SINGLE_COMPILE_ENV = "STENCIL_ASSERT_SINGLE_COMPILE"
+
+#: carry pairing: (argnum, output index path) — None path means the
+#: whole output IS the carried state
+CarryPath = Tuple[int, Optional[Tuple[int, ...]]]
+
+
+class RecompileGuardError(RuntimeError):
+    """A guarded jitted function re-traced after its first dispatch."""
+
+
+@dataclasses.dataclass
+class RecompileSpec:
+    """An entry point plus its carry contract.
+
+    ``carry`` pairs each donated/carried argnum with the index path of
+    the output subtree that feeds back into it on the next dispatch
+    (``None`` = the whole output). The checker proves the two have
+    identical flat avals — shape, dtype, AND weak_type."""
+
+    fn: Callable
+    args: Sequence[Any]
+    carry: Tuple[CarryPath, ...] = ((0, None),)
+
+
+@dataclasses.dataclass
+class RecompileTarget:
+    name: str
+    build: Callable[[], RecompileSpec]
+
+    checker = "recompile"
+
+
+def _leaf_aval(leaf: Any) -> Tuple[Tuple[int, ...], str, bool]:
+    """(shape, dtype, weak_type) of an array-ish leaf."""
+    import numpy as np
+
+    shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+    dtype = str(np.dtype(getattr(leaf, "dtype", np.float32)))
+    weak = bool(getattr(leaf, "weak_type", False))
+    aval = getattr(leaf, "aval", None)
+    if aval is not None:
+        weak = bool(getattr(aval, "weak_type", weak))
+    return shape, dtype, weak
+
+
+def _flat_with_paths(tree: Any):
+    import jax
+
+    return [("".join(str(k) for k in path), leaf) for path, leaf in
+            jax.tree_util.tree_flatten_with_path(tree)[0]]
+
+
+def abstract_fingerprint(fn: Callable, args: Sequence[Any],
+                         out: Any = None) -> str:
+    """sha256 over the canonical abstract signature (flat input and
+    output avals incl. weak_type) — the identity the jit cache keys
+    on, minus static closure state. Pass an already-computed
+    ``jax.eval_shape`` result as ``out`` to skip re-tracing (the
+    unrolled megastep programs make a second abstract trace the
+    checker's dominant cost)."""
+    if out is None:
+        import jax
+
+        out = jax.eval_shape(fn, *args)
+    sig = [("in", p, _leaf_aval(v)) for p, v in _flat_with_paths(args)]
+    sig += [("out", p, _leaf_aval(v)) for p, v in _flat_with_paths(out)]
+    return hashlib.sha256(repr(sig).encode()).hexdigest()
+
+
+def _out_subtree(out: Any, path: Optional[Tuple[int, ...]]) -> Any:
+    if path is None:
+        return out
+    for i in path:
+        out = out[i]
+    return out
+
+
+def check_recompile(target: RecompileTarget
+                    ) -> Tuple[List[Finding], Dict]:
+    """Prove the target's abstract fingerprint is dispatch-stable."""
+    import jax
+
+    try:
+        spec = target.build()
+    except Exception as e:  # noqa: BLE001
+        return [Finding("recompile", target.name,
+                        f"target build failed: {type(e).__name__}: {e}")], {}
+
+    findings: List[Finding] = []
+    n_weak_args = 0
+    for argnum, a in enumerate(spec.args):
+        for path, leaf in _flat_with_paths(a):
+            if isinstance(leaf, (bool,)):
+                continue
+            if isinstance(leaf, (int, float, complex)):
+                n_weak_args += 1
+                findings.append(Finding(
+                    "recompile", target.name,
+                    f"arg{argnum}{path} is a Python scalar "
+                    f"({type(leaf).__name__}) — it traces weak-typed, "
+                    f"so array-typed and scalar-typed call sites fork "
+                    f"the jit cache; pass a committed "
+                    f"jnp.asarray(..., dtype) instead", ERROR))
+            elif _leaf_aval(leaf)[2]:
+                n_weak_args += 1
+                findings.append(Finding(
+                    "recompile", target.name,
+                    f"arg{argnum}{path} is weak-typed — its "
+                    f"fingerprint differs from the strong-typed array "
+                    f"the warm path feeds; commit it with an explicit "
+                    f"dtype", ERROR))
+
+    try:
+        out = jax.eval_shape(spec.fn, *spec.args)
+    except Exception as e:  # noqa: BLE001
+        findings.append(Finding(
+            "recompile", target.name,
+            f"abstract evaluation failed: {type(e).__name__}: {e}"))
+        return findings, {}
+
+    carry_leaves = 0
+    for argnum, path in spec.carry:
+        in_flat = _flat_with_paths(spec.args[argnum])
+        try:
+            out_flat = _flat_with_paths(_out_subtree(out, path))
+        except (IndexError, KeyError, TypeError):
+            findings.append(Finding(
+                "recompile", target.name,
+                f"carry output path {path!r} does not exist in the "
+                f"output tree — the carried state for arg{argnum} "
+                f"cannot feed back", ERROR))
+            continue
+        if len(in_flat) != len(out_flat):
+            findings.append(Finding(
+                "recompile", target.name,
+                f"carry arg{argnum}: {len(in_flat)} input leaves vs "
+                f"{len(out_flat)} output leaves — the state pytree "
+                f"changes shape across a dispatch (retrace every "
+                f"step)", ERROR))
+            continue
+        carry_leaves += len(in_flat)
+        for (ipath, ileaf), (opath, oleaf) in zip(in_flat, out_flat):
+            ishape, idtype, iweak = _leaf_aval(ileaf)
+            oshape, odtype, oweak = _leaf_aval(oleaf)
+            where = f"arg{argnum}{ipath}"
+            if ishape != oshape:
+                findings.append(Finding(
+                    "recompile", target.name,
+                    f"carry {where}: shape drift {ishape} -> {oshape} "
+                    f"between paired curr/next buffers — every "
+                    f"dispatch after the first re-traces", ERROR))
+            elif idtype != odtype:
+                findings.append(Finding(
+                    "recompile", target.name,
+                    f"carry {where}: dtype drift {idtype} -> {odtype} "
+                    f"between paired curr/next buffers — every "
+                    f"dispatch after the first re-traces (and the "
+                    f"donation dies with it)", ERROR))
+            elif oweak and not iweak:
+                findings.append(Finding(
+                    "recompile", target.name,
+                    f"carry {where}: weak-type promotion — the output "
+                    f"leaf is weak_type=True (rebuilt from a Python "
+                    f"scalar?) while the input is strong; feeding it "
+                    f"back re-traces every dispatch", ERROR))
+
+    metrics = {"fingerprint": abstract_fingerprint(spec.fn, spec.args,
+                                                   out=out),
+               "carry_leaves": carry_leaves,
+               "weak_args": n_weak_args}
+    return findings, metrics
+
+
+# ---------------------------------------------------------------------------
+# the runtime twin: trace-count guards
+
+
+def trace_cache_size(fn: Callable) -> Optional[int]:
+    """The jit trace-cache entry count of ``fn``, or None when this
+    JAX does not expose it (the guards then no-op)."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:  # noqa: BLE001 - introspection must never raise
+        return None
+
+
+@contextlib.contextmanager
+def assert_single_compile(fn: Callable, label: str = ""):
+    """Assert the jitted ``fn`` adds AT MOST ONE trace-cache entry
+    inside the block — the 'one compile per fingerprint' contract a
+    warm driver loop can wrap its steady state in."""
+    before = trace_cache_size(fn)
+    yield
+    after = trace_cache_size(fn)
+    # allow ONE cold compile; an already-warm fn (before >= 1) may not
+    # add any entry — growth past max(before, 1) is a second
+    # fingerprint either way
+    if before is not None and after is not None \
+            and after > max(before, 1):
+        raise RecompileGuardError(
+            f"{label or getattr(fn, '__name__', fn)}: jit cache grew "
+            f"{before} -> {after} inside an assert_single_compile "
+            f"block — the entry point re-traced (fingerprint drift)")
+
+
+class SingleCompileGuard:
+    """Cross-dispatch recompile watchdog: observe a jitted fn after
+    each dispatch; any cache growth after the first observation means
+    the steady-state fingerprint drifted."""
+
+    def __init__(self) -> None:
+        # keyed by id(fn) but HOLDING the fn: a freed fn's id can be
+        # recycled by a new jit, which would inherit a stale baseline
+        # and mask exactly the retrace this guard is armed to catch
+        self._seen: Dict[int, Tuple[Callable, int]] = {}
+
+    def observe(self, fn: Callable, label: str = "") -> None:
+        size = trace_cache_size(fn)
+        if size is None:
+            return
+        prev = self._seen.get(id(fn))
+        if prev is not None and prev[0] is fn and size > prev[1]:
+            raise RecompileGuardError(
+                f"{label or getattr(fn, '__name__', fn)}: jit cache "
+                f"grew {prev[1]} -> {size} between dispatches — the "
+                f"hot loop is recompiling every step")
+        self._seen[id(fn)] = (fn, size)

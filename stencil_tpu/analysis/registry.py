@@ -18,6 +18,7 @@ and ``tests/test_lint.py`` cross-checks both manifests against
 
 from __future__ import annotations
 
+import functools
 import importlib.util
 from pathlib import Path
 from typing import List, Union
@@ -25,12 +26,16 @@ from typing import List, Union
 from .collectives import CollectiveSpec, CollectiveTarget
 from .costmodel import CostModelSpec, CostModelTarget
 from .dma import PallasKernelSpec, PallasKernelTarget
+from .donation import DonationSpec, DonationTarget
 from .footprint import StencilOpSpec, StencilOpTarget
 from .hlo import HloSpec, HloTarget
+from .recompile import RecompileSpec, RecompileTarget
+from .transfer import TransferSpec, TransferTarget
 from .vmem import VmemSpec, VmemTarget
 
 Target = Union[StencilOpTarget, PallasKernelTarget, CollectiveTarget,
-               HloTarget, CostModelTarget, VmemTarget]
+               HloTarget, CostModelTarget, VmemTarget, DonationTarget,
+               TransferTarget, RecompileTarget]
 
 
 def _f32(shape):
@@ -929,6 +934,230 @@ def _megastep_segment_cost() -> CostModelSpec:
 
 
 # ---------------------------------------------------------------------------
+# dataflow targets: donation / transfer / recompile for every compiled
+# entry point the drivers dispatch — the model step loops, the
+# temporal path, make_exchange, the fused megastep segments, and the
+# ensemble step/segment/lane programs. Each entry builder returns
+# (jitted_fn, args) exactly the way the production caller invokes it,
+# so the donation checker audits the SHIPPED jit (its declared
+# donate_argnums), the transfer checker walks the same traced program,
+# and the recompile checker fingerprints the same abstract signature.
+# Builders are memoized: the three checkers audit ONE engine instead
+# of realizing the same domain per target (nothing here dispatches —
+# lower/trace/eval_shape only — so sharing the jitted fn is safe).
+
+
+@functools.lru_cache(maxsize=None)
+def _jacobi_step_entry(exchange_every: int = 1):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.jacobi import Jacobi3D
+
+    j = Jacobi3D(16, 16, 16, mesh_shape=_EXCHANGE_MESH,
+                 dtype=np.float32, kernel="xla",
+                 exchange_every=exchange_every)
+    return j._step_n, (j.dd.curr["temp"], jnp.asarray(2, jnp.int32))
+
+
+@functools.lru_cache(maxsize=None)
+def _astaroth_iter_entry():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.astaroth import Astaroth
+    from ..parallel.methods import Method
+
+    a = Astaroth(8, 8, 8, mesh_shape=(1, 1, 2),
+                 devices=jax.devices()[:2], dtype=np.float32,
+                 kernel="xla", methods=Method.PpermuteSlab)
+    a._ensure_w()
+    return a._iter_n, (a.dd.curr, a._w, jnp.asarray(1, jnp.int32))
+
+
+@functools.lru_cache(maxsize=None)
+def _make_exchange_entry(method_name: str):
+    from ..geometry import Radius
+    from ..parallel.exchange import make_exchange
+    from ..parallel.methods import Method
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    ex = make_exchange(mesh, Radius.constant(1), Method[method_name])
+    return ex, ({"q": _f32((20, 20, 20))},)
+
+
+@functools.lru_cache(maxsize=None)
+def _megastep_segment_entry():
+    import numpy as np
+
+    from ..models.jacobi import Jacobi3D
+    from ..parallel.megastep import metric_base_vec
+
+    j = Jacobi3D(16, 16, 16, mesh_shape=_EXCHANGE_MESH,
+                 dtype=np.float32, kernel="xla")
+    seg = j.make_segment(_MEGASTEP_K, probe_every=_MEGASTEP_PROBE_EVERY)
+    return seg.fn, (j.dd.curr["temp"],
+                    metric_base_vec(None, 0, mesh=j.dd.mesh))
+
+
+@functools.lru_cache(maxsize=None)
+def _domain_segment_entry():
+    import numpy as np
+
+    from ..distributed import DistributedDomain
+    from ..geometry import Radius
+    from ..parallel.exchange import exchange_shard
+    from ..parallel.megastep import metric_base_vec
+    from ..parallel.mesh import mesh_dim
+
+    dd = DistributedDomain(16, 16, 16)
+    dd.set_mesh_shape(_EXCHANGE_MESH)
+    dd.set_radius(1)
+    dd.add_data("a", np.float32)
+    dd.add_data("b", np.float32)
+    dd.realize()
+    counts = mesh_dim(dd.mesh)
+    radius = Radius.constant(1)
+
+    def shard_step(fields):
+        return {q: exchange_shard(p, radius, counts)
+                for q, p in fields.items()}
+
+    seg = dd.make_segment(shard_step, check_every=2)
+    return seg.fn, (dict(dd.curr),
+                    metric_base_vec(None, 0, mesh=dd.mesh))
+
+
+@functools.lru_cache(maxsize=None)
+def _ensemble_engine():
+    from ..serving.ensemble import EnsembleJacobi
+
+    return EnsembleJacobi(_ENSEMBLE_N, 24, 24, 24,
+                          mesh_shape=_EXCHANGE_MESH)
+
+
+@functools.lru_cache(maxsize=None)
+def _ensemble_step_entry():
+    import jax.numpy as jnp
+
+    eng = _ensemble_engine()
+    hot, cold = eng._param_args()
+    return eng._step_n, (eng.state["temp"], hot, cold,
+                         jnp.asarray(1, jnp.int32))
+
+
+@functools.lru_cache(maxsize=None)
+def _ensemble_segment_entry():
+    eng = _ensemble_engine()
+    fn = eng._segments.get((2, 1))
+    if fn is None:
+        fn = eng._segment_fn(2, 1)
+    hot, cold = eng._param_args()
+    return fn, (eng.state["temp"], hot, cold)
+
+
+def _ensemble_set_lane_entry():
+    import jax.numpy as jnp
+
+    eng = _ensemble_engine()
+    lane = {q: eng.state[q][0] for q in eng.state}
+    return eng._set_lane, (dict(eng.state), lane, jnp.int32(0))
+
+
+def _donation_spec(entry, donate=(0,)):
+    fn, args = entry()
+    return DonationSpec(fn=fn, args=args, donate_argnums=tuple(donate))
+
+
+def _transfer_spec(entry):
+    fn, args = entry()
+    return TransferSpec(fn=fn, args=args)
+
+
+def _health_step_probe_transfer() -> TransferSpec:
+    hs = _health_step_probe_spec()
+    return TransferSpec(fn=hs.fn, args=hs.args)
+
+
+def _recompile_spec(entry, carry=((0, None),)):
+    fn, args = entry()
+    return RecompileSpec(fn=fn, args=args, carry=tuple(carry))
+
+
+def _dataflow_targets() -> List[Target]:
+    """The donation/transfer/recompile registry block (one audit per
+    production entry point per applicable checker)."""
+    targets: List[Target] = []
+    # donation: every declared donate_argnums buffer must alias
+    donation = [
+        ("models.jacobi.step_n[xla,donation]",
+         _jacobi_step_entry, (0,)),
+        ("models.jacobi.step_n[xla-temporal[s=2],donation]",
+         lambda: _jacobi_step_entry(2), (0,)),
+        ("models.astaroth.iter_n[donation]",
+         _astaroth_iter_entry, (0, 1)),
+        ("parallel.exchange.make_exchange[PpermuteSlab,donation]",
+         lambda: _make_exchange_entry("PpermuteSlab"), (0,)),
+        ("parallel.exchange.make_exchange[PpermutePacked,donation]",
+         lambda: _make_exchange_entry("PpermutePacked"), (0,)),
+        ("parallel.exchange.make_exchange[AllGather,donation]",
+         lambda: _make_exchange_entry("AllGather"), (0,)),
+        (f"parallel.megastep.segment[k={_MEGASTEP_K},donation]",
+         _megastep_segment_entry, (0,)),
+        ("distributed.make_segment[donation]",
+         _domain_segment_entry, (0,)),
+        (f"serving.ensemble.step[N={_ENSEMBLE_N},donation]",
+         _ensemble_step_entry, (0,)),
+        (f"serving.ensemble.segment[N={_ENSEMBLE_N},k=2,donation]",
+         _ensemble_segment_entry, (0,)),
+        (f"serving.ensemble.set_lane[N={_ENSEMBLE_N},donation]",
+         _ensemble_set_lane_entry, (0,)),
+    ]
+    for name, entry, donate in donation:
+        targets.append(DonationTarget(
+            name, lambda e=entry, d=donate: _donation_spec(e, d)))
+    # transfer: no host escape inside the compiled hot path
+    transfer = [
+        ("models.jacobi.step_n[xla,transfer]", _jacobi_step_entry),
+        ("models.astaroth.iter_n[transfer]", _astaroth_iter_entry),
+        ("parallel.exchange.make_exchange[PpermutePacked,transfer]",
+         lambda: _make_exchange_entry("PpermutePacked")),
+        (f"parallel.megastep.segment[k={_MEGASTEP_K},transfer]",
+         _megastep_segment_entry),
+        (f"serving.ensemble.step[N={_ENSEMBLE_N},transfer]",
+         _ensemble_step_entry),
+        (f"serving.ensemble.segment[N={_ENSEMBLE_N},k=2,transfer]",
+         _ensemble_segment_entry),
+    ]
+    for name, entry in transfer:
+        targets.append(TransferTarget(
+            name, lambda e=entry: _transfer_spec(e)))
+    targets.append(TransferTarget("resilience.health.step+probe[transfer]",
+                                  _health_step_probe_transfer))
+    # recompile: dispatch-stable abstract fingerprints; carry pairs
+    # the donated state with the output subtree that feeds back
+    recompile = [
+        ("models.jacobi.step_n[xla,recompile]",
+         _jacobi_step_entry, ((0, None),)),
+        ("models.astaroth.iter_n[recompile]",
+         _astaroth_iter_entry, ((0, (0,)), (1, (1,)))),
+        ("parallel.exchange.make_exchange[PpermutePacked,recompile]",
+         lambda: _make_exchange_entry("PpermutePacked"), ((0, None),)),
+        (f"parallel.megastep.segment[k={_MEGASTEP_K},recompile]",
+         _megastep_segment_entry, ((0, (0,)),)),
+        (f"serving.ensemble.step[N={_ENSEMBLE_N},recompile]",
+         _ensemble_step_entry, ((0, None),)),
+        (f"serving.ensemble.segment[N={_ENSEMBLE_N},k=2,recompile]",
+         _ensemble_segment_entry, ((0, (0,)),)),
+    ]
+    for name, entry, carry in recompile:
+        targets.append(RecompileTarget(
+            name, lambda e=entry, c=carry: _recompile_spec(e, c)))
+    return targets
+
+
+# ---------------------------------------------------------------------------
 # VMEM targets: every shipped Pallas kernel's static memory/tiling
 # audit. The overlap/RDMA builders are shared with the dma targets;
 # the single-chip wrap/halo fast-path kernels (previously outside the
@@ -1234,6 +1463,9 @@ def default_targets() -> List[Target]:
             f"parallel.megastep.segment[k={_MEGASTEP_K},cost]",
             _megastep_segment_cost),
     ]
+    # the dataflow block: donation / transfer / recompile audits for
+    # every compiled entry point the drivers dispatch
+    targets += _dataflow_targets()
     # static VMEM/tiling audit: every shipped Pallas kernel
     targets += [
         VmemTarget("parallel.pallas_exchange.exchange_shard_pallas[vmem]",

@@ -30,7 +30,8 @@ class Finding:
     """One violated (or unverifiable) invariant.
 
     ``checker``  -- "footprint" | "dma" | "collectives" | "hlo" |
-                    "costmodel" | "vmem"
+                    "costmodel" | "vmem" | "donation" | "transfer" |
+                    "recompile"
     ``target``   -- registry name of the checked entity (or
                     "name:kernel" for per-kernel dma/vmem findings)
     ``message``  -- human-readable description of the violation

@@ -550,7 +550,8 @@ class Astaroth:
 
             def run(base_step: int):
                 self._ensure_w()
-                vec = ms.metric_base_vec(metrics, base_step)
+                vec = ms.metric_base_vec(metrics, base_step,
+                                         mesh=dd.mesh)
                 (out_f, out_w), tr = fn(
                     (dict(self.dd.curr), dict(self._w)), vec)
                 self.dd.curr = dict(out_f)
@@ -1103,8 +1104,12 @@ class Astaroth:
             per_shard = (raw_size(self.dd.local_size, self.dd.alloc_radius)
                          if self._w_padded else self.dd.local_size)
             shape = zyx_shape(per_shard * dim)
+            # np.zeros + EXPLICIT device_put: _ensure_w runs inside
+            # the fused-segment dispatch, which is guarded by
+            # jax.transfer_guard("disallow") — jnp.zeros would lift
+            # its fill scalar through an implicit transfer
             self._w = {q: jax.device_put(
-                jnp.zeros(shape, dtype=self._dtype), sharding)
+                np.zeros(shape, dtype=self._dtype), sharding)
                 for q in FIELDS}
 
     def step(self) -> None:

@@ -319,7 +319,8 @@ class Jacobi3D:
             rel = ms.probe_rel_steps(chunks, probe_every)
 
             def run(base_step: int):
-                vec = ms.metric_base_vec(metrics, base_step)
+                vec = ms.metric_base_vec(metrics, base_step,
+                                         mesh=dd.mesh)
                 out, tr = fn(self.dd.curr["temp"], vec)
                 self.dd.curr["temp"] = out
                 return ms.SegmentTrace(tr, rel, base_step)

@@ -181,15 +181,26 @@ class Segment:
         return self._run(int(base_step))
 
 
-def metric_base_vec(metrics, base_step: int):
+def metric_base_vec(metrics, base_step: int, mesh=None):
     """The replicated f32 ``[substeps, wire_bytes]`` base the fused
-    probe rows increment in-graph — :meth:`StepMetrics.values` at the
-    segment's base step, or zeros when no metrics ride."""
-    import jax.numpy as jnp
+    probe rows increment in-graph — ``metrics.values(base_step)`` (the
+    metrics protocol of ``resilience/health.py``; ``StepMetrics``
+    commits it replicated over its domain's mesh), or zeros over
+    ``mesh`` when no metrics ride. Either way the host->device
+    movement is EXPLICIT (``jax.device_put``) so the fused dispatch
+    runs clean under the hot-loop ``jax.transfer_guard("disallow")`` —
+    no implicit transfer, no dispatch-time reshard."""
+    import jax
+    import numpy as np
 
-    if metrics is None:
-        return jnp.zeros((2,), jnp.float32)
-    return metrics.values(int(base_step))
+    if metrics is not None:
+        return metrics.values(int(base_step))
+    vec = np.zeros((2,), np.float32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(vec, NamedSharding(mesh, P()))
+    return jax.device_put(vec)
 
 
 def make_segment_fn(mesh, advance, probe_view, state_specs,
@@ -255,7 +266,7 @@ def make_domain_segment(dd, shard_step, check_every: int,
     rel = probe_rel_steps(chunks, probe_every)
 
     def run(base_step: int) -> SegmentTrace:
-        vec = metric_base_vec(metrics, base_step)
+        vec = metric_base_vec(metrics, base_step, mesh=dd.mesh)
         out, trace = fn(dict(dd.curr), vec)
         dd.curr = dict(out)
         return SegmentTrace(trace, rel, base_step)

@@ -31,11 +31,15 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import signal
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..analysis.recompile import (ASSERT_SINGLE_COMPILE_ENV,
+                                  SingleCompileGuard)
+from ..analysis.transfer import hot_loop_transfer_guard
 from ..parallel.methods import (METHOD_PRIORITY, Method, method_runnable,
                                 pick_method)
 from ..utils.checkpoint import restore_domain, save_domain
@@ -189,6 +193,13 @@ class _ResilientRun:
                        and self.policy.fuse_segments)
         #: one async checkpoint in flight: (step, field copies, extras)
         self._pending_save = None
+        #: recompile watchdog (analysis/recompile.py): armed via
+        #: STENCIL_ASSERT_SINGLE_COMPILE=1, raises if a fused segment
+        #: program re-traces between dispatches
+        self._compile_guard = (
+            SingleCompileGuard()
+            if os.environ.get(ASSERT_SINGLE_COMPILE_ENV) == "1"
+            else None)
         self.report = ResilienceReport()
         if faults is not None:
             faults.bind(self.report.log)
@@ -543,7 +554,15 @@ class _ResilientRun:
             return False
         base = self.step
         with self._tracer.span("megastep", steps=k, step=base):
-            trace = seg.run(base)
+            # the hot-loop dataflow contract, enforced at runtime: the
+            # fused dispatch moves NOTHING implicitly between host and
+            # device (the probe trace stays on device, the metric base
+            # vec is an explicit replicated device_put) — see
+            # analysis/transfer.py; STENCIL_ALLOW_TRANSFERS=1 opts out
+            with hot_loop_transfer_guard():
+                trace = seg.run(base)
+        if self._compile_guard is not None:
+            self._compile_guard.observe(seg.fn, "megastep segment")
         self.step += k
         self.report.steps = self.step
         self._m_steps.inc(k)

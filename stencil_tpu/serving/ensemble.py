@@ -319,9 +319,31 @@ class _EnsembleBase:
 
         self._set_lane = jax.jit(set_lane, donate_argnums=0)
 
+    def _replicated(self, value) -> jnp.ndarray:
+        """A replicated device array built via an EXPLICIT transfer
+        (``jax.device_put`` with the mesh sharding) so segment
+        dispatches stay clean under the hot-loop
+        ``jax.transfer_guard("disallow")`` — an implicit scalar lift
+        would both trip the guard and reshard at dispatch."""
+        return jax.device_put(np.asarray(value, dtype=self._dtype),
+                              NamedSharding(self.dd.mesh, P()))
+
     def _param_args(self) -> Tuple[jnp.ndarray, ...]:
-        return tuple(jnp.asarray(self._params[p], dtype=self._dtype)
+        return tuple(self._replicated(self._params[p])
                      for p in self.PARAM_NAMES)
+
+    def jit_entry_points(self) -> Dict[str, object]:
+        """The hot-path jitted programs a recompile watchdog
+        (:class:`~..analysis.recompile.SingleCompileGuard`) observes
+        after each dispatch: the step loop and every built segment."""
+        out: Dict[str, object] = {}
+        for attr, label in (("_step_n", "step_n"), ("_iter_n", "iter_n")):
+            fn = getattr(self, attr, None)
+            if fn is not None:
+                out[label] = fn
+        for (k, p), fn in getattr(self, "_segments", {}).items():
+            out[f"segment[k={k},probe_every={p}]"] = fn
+        return out
 
     # -- per-member parameters -----------------------------------------
     def set_member_params(self, k: int, overrides: Dict[str, float]
@@ -781,7 +803,7 @@ class EnsembleAstaroth(_EnsembleBase):
         self._segment_fn = segment_fn
 
     def run(self, n_steps: int) -> None:
-        pvals = {p: jnp.asarray(self._params[p], dtype=self._dtype)
+        pvals = {p: self._replicated(self._params[p])
                  for p in self.PARAM_NAMES}
         self.state, self.w = self._iter_n(
             dict(self.state), dict(self.w), pvals,
@@ -797,7 +819,7 @@ class EnsembleAstaroth(_EnsembleBase):
         if fn is None:
             fn = self._segment_fn(k, probe_every)
             self._segments[key] = fn
-        pvals = {p: jnp.asarray(self._params[p], dtype=self._dtype)
+        pvals = {p: self._replicated(self._params[p])
                  for p in self.PARAM_NAMES}
         (out_f, out_w), trace = fn(dict(self.state), dict(self.w),
                                    pvals)

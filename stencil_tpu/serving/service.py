@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import threading
 import time
 from pathlib import Path
@@ -51,6 +52,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..analysis.recompile import (ASSERT_SINGLE_COMPILE_ENV,
+                                  SingleCompileGuard)
+from ..analysis.transfer import hot_loop_transfer_guard
 from ..utils.checkpoint import all_steps, validate_checkpoint_component
 from ..utils.logging import LOG_INFO, LOG_WARN
 from .ensemble import EnsembleAstaroth, EnsembleJacobi, EnsembleSentinel
@@ -160,6 +164,14 @@ class CampaignService:
         #: fingerprint is a recompile (warm-path regression)
         self._built: set = set()
         self._sentinels: Dict[str, EnsembleSentinel] = {}
+        #: recompile watchdog (analysis/recompile.py): armed via
+        #: STENCIL_ASSERT_SINGLE_COMPILE=1 — a cached engine whose
+        #: step/segment programs re-trace between dispatches raises
+        #: instead of silently recompiling per batch
+        self._compile_guard = (
+            SingleCompileGuard()
+            if os.environ.get(ASSERT_SINGLE_COMPILE_ENV) == "1"
+            else None)
         self._preempt = False
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -636,8 +648,17 @@ class CampaignService:
                                   fused=self._fuse):
                 if self._fuse:
                     # megastep: the per-member probe trace rides the
-                    # same single dispatch (one all-reduce per row)
-                    trace = eng.run_segment(seg)
+                    # same single dispatch (one all-reduce per row),
+                    # under the hot-loop transfer guard — nothing moves
+                    # implicitly between host and device inside the
+                    # fused dispatch (analysis/transfer.py;
+                    # STENCIL_ALLOW_TRANSFERS=1 opts out)
+                    with hot_loop_transfer_guard():
+                        trace = eng.run_segment(seg)
+                    if self._compile_guard is not None:
+                        for name, fn in eng.jit_entry_points().items():
+                            self._compile_guard.observe(
+                                fn, f"ensemble {name}")
                 else:
                     eng.run(seg)
             n_active = 0

@@ -66,6 +66,10 @@ class StepMetrics:
         self.bytes_per_step = float(dd.exchange_bytes_amortized_per_step())
         self.base_step = int(base_step)
         self.base_bytes = float(base_bytes)
+        # the domain's mesh, so values() can commit the vector
+        # replicated (a single-device put would reshard implicitly at
+        # dispatch — disallowed under the hot-loop transfer guard)
+        self._mesh = getattr(dd, "mesh", None)
 
     def cumulative_bytes(self, step: int) -> float:
         """Modeled wire bytes for the campaign's first ``step`` steps."""
@@ -79,13 +83,27 @@ class StepMetrics:
         return StepMetrics(dd, base_step=step,
                            base_bytes=self.cumulative_bytes(step))
 
-    def values(self, step: int):
-        """The replicated f32 metrics vector for a probe of ``step``."""
-        import jax.numpy as jnp
-
+    def host_values(self, step: int) -> np.ndarray:
+        """The f32 metrics vector for a probe of ``step``, on host —
+        callers that dispatch under the hot-loop transfer guard
+        device_put it explicitly (``megastep.metric_base_vec``)."""
         step = int(step)
-        return jnp.asarray([float(step), self.cumulative_bytes(step)],
-                           dtype=jnp.float32)
+        return np.asarray([float(step), self.cumulative_bytes(step)],
+                          dtype=np.float32)
+
+    def values(self, step: int):
+        """The metrics vector as a replicated device array; the
+        transfer is EXPLICIT (``jax.device_put`` with the domain's
+        mesh sharding) so guarded hot loops stay clean — no implicit
+        dispatch-time reshard."""
+        import jax
+
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.device_put(self.host_values(step),
+                                  NamedSharding(self._mesh, P()))
+        return jax.device_put(self.host_values(step))
 
     def decode(self, metrics: Dict[str, float]) -> Dict[str, float]:
         """Derived figures from harvested probe metrics: the raw

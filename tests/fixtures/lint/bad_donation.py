@@ -1,0 +1,40 @@
+"""Negative control for the donation checker: entry points whose
+declared donation contract is dead in the compiled program.
+
+``fixture.donation_never_declared`` models the classic refactor
+regression — a step loop re-wrapped in a fresh ``jax.jit`` WITHOUT
+``donate_argnums`` (the spec still declares the contract; the compiled
+alias map is empty). ``fixture.donated_but_copied`` models the subtler
+one: ``donate_argnums`` is still declared on the jit, but an
+``astype`` changed the output's byte width, so XLA silently drops the
+alias and copies — donation checked at the Python level looks fine,
+the compiled program says otherwise.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.analysis.donation import DonationSpec, DonationTarget
+
+
+def _arg():
+    return jax.ShapeDtypeStruct((8, 8, 8), jnp.float32)
+
+
+def _never_declared() -> DonationSpec:
+    # the jit lost its donate_argnums; the contract says arg 0 aliases
+    fn = jax.jit(lambda x: x + 1.0)
+    return DonationSpec(fn=fn, args=(_arg(),), donate_argnums=(0,))
+
+
+def _donated_but_copied() -> DonationSpec:
+    # donated on the jit, but the f32 -> bf16 narrowing makes the
+    # buffer unaliasable: XLA warns and copies
+    fn = jax.jit(lambda x: x.astype(jnp.bfloat16), donate_argnums=0)
+    return DonationSpec(fn=fn, args=(_arg(),), donate_argnums=(0,))
+
+
+TARGETS = [
+    DonationTarget("fixture.donation_never_declared", _never_declared),
+    DonationTarget("fixture.donated_but_copied", _donated_but_copied),
+]

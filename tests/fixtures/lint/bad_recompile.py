@@ -1,0 +1,49 @@
+"""Negative control for the recompile checker: entry points whose
+abstract fingerprint drifts between dispatches.
+
+``fixture.carry_dtype_drift`` returns its carried state at a different
+dtype than it accepts — the second dispatch sees a new input aval and
+re-traces, every step (and the donation dies with it).
+``fixture.weak_type_promotion`` rebuilds part of the state from a
+Python scalar (``jnp.full`` with no dtype), so the carried output is
+weak-typed while the input is strong — same retrace loop, harder to
+see. ``fixture.python_scalar_arg`` passes the step count as a bare
+Python ``int``: it traces weak-typed, forking the jit cache from the
+array-typed calls the warm path makes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.analysis.recompile import RecompileSpec, RecompileTarget
+
+
+def _arg():
+    return jax.ShapeDtypeStruct((8, 8), jnp.float32)
+
+
+def _dtype_drift() -> RecompileSpec:
+    fn = jax.jit(lambda x: (x * 0.5).astype(jnp.bfloat16))
+    return RecompileSpec(fn=fn, args=(_arg(),), carry=((0, None),))
+
+
+def _weak_promotion() -> RecompileSpec:
+    # jnp.full with a Python scalar and no dtype produces a WEAK-typed
+    # default float — same dtype as the strong input (the arg uses the
+    # default float so this holds with and without jax_enable_x64),
+    # but feeding the weak result back re-traces next dispatch
+    fn = jax.jit(lambda x: jnp.full(x.shape, 2.0))
+    arg = jax.ShapeDtypeStruct((8, 8), jnp.result_type(float))
+    return RecompileSpec(fn=fn, args=(arg,), carry=((0, None),))
+
+
+def _python_scalar_arg() -> RecompileSpec:
+    fn = jax.jit(lambda x, n: x * n)
+    return RecompileSpec(fn=fn, args=(_arg(), 3), carry=((0, None),))
+
+
+TARGETS = [
+    RecompileTarget("fixture.carry_dtype_drift", _dtype_drift),
+    RecompileTarget("fixture.weak_type_promotion", _weak_promotion),
+    RecompileTarget("fixture.python_scalar_arg", _python_scalar_arg),
+]

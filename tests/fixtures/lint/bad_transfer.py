@@ -1,0 +1,45 @@
+"""Negative control for the transfer checker: step programs that
+escape to the host every dispatch.
+
+``fixture.debug_print_in_step`` is the one everybody ships at least
+once — a ``jax.debug.print`` left in the hot loop (a host callback per
+dispatch). ``fixture.pure_callback_in_step`` routes part of the step
+through a Python callback, serializing the pipeline on the host.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stencil_tpu.analysis.transfer import TransferSpec, TransferTarget
+
+
+def _arg():
+    return jax.ShapeDtypeStruct((8, 8), jnp.float32)
+
+
+def _debug_print_step() -> TransferSpec:
+    def step(x):
+        jax.debug.print("step max {m}", m=x.max())
+        return x * 0.5
+
+    return TransferSpec(fn=step, args=(_arg(),))
+
+
+def _pure_callback_step() -> TransferSpec:
+    def host_filter(a):
+        return np.asarray(a) * 2.0
+
+    def step(x):
+        y = jax.pure_callback(
+            host_filter, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return y + 1.0
+
+    return TransferSpec(fn=step, args=(_arg(),))
+
+
+TARGETS = [
+    TransferTarget("fixture.debug_print_in_step", _debug_print_step),
+    TransferTarget("fixture.pure_callback_in_step",
+                   _pure_callback_step),
+]

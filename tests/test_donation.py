@@ -6,26 +6,30 @@ Donation silently disappears when a refactor re-wraps a jitted
 function without ``donate_argnums`` — these tests pin the aliasing at
 the compiled-HLO level on the CPU backend (the alias map is a
 lowering-level property; the CPU runtime may still copy, but the
-contract XLA:TPU consumes is exactly this annotation)."""
+contract XLA:TPU consumes is exactly this annotation).
 
-import re
+The alias-map parser is library code now —
+:func:`stencil_tpu.analysis.donation.alias_param_ids` — shared with
+the donation checker (``python -m stencil_tpu.analysis --only
+donation``), which audits every registered entry point in CI; these
+tests keep the direct, readable proofs and exercise the same parser.
+"""
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from stencil_tpu.analysis.donation import (alias_param_ids,
+                                           compiled_alias_ids)
 from stencil_tpu.models.jacobi import Jacobi3D
 
 
-def _alias_param_ids(compiled_text: str) -> set:
-    """Parameter numbers appearing in the HLO input_output_alias map,
-    e.g. ``input_output_alias={ {0}: (0, {}, may-alias) }`` -> {0}."""
-    m = re.search(r"input_output_alias=\{(.*?)\}\s*,\s*entry",
-                  compiled_text, re.S)
-    if m is None:
-        m = re.search(r"input_output_alias=\{(.*?)\}", compiled_text, re.S)
-    assert m, "no input_output_alias in compiled HLO"
-    return {int(p) for p in re.findall(r"\((\d+),", m.group(1))}
+def _alias_param_ids(compiled) -> set:
+    """Aliased entry-parameter numbers of a compiled program, via the
+    analysis library's single parser."""
+    ids = alias_param_ids(compiled.as_text())
+    assert ids, "no input_output_alias in compiled HLO"
+    return ids
 
 
 def test_jacobi_step_loop_donates_field_buffer():
@@ -33,18 +37,17 @@ def test_jacobi_step_loop_donates_field_buffer():
                  kernel="xla")
     arr = j.dd.curr["temp"]
     compiled = j._step_n.lower(arr, jnp.asarray(2, jnp.int32)).compile()
-    ids = _alias_param_ids(compiled.as_text())
+    ids = _alias_param_ids(compiled)
     assert 0 in ids, "temp field buffer (arg 0) lost its donation"
 
 
 def test_jacobi_temporal_step_loop_donates_field_buffer():
-    """The new temporal-blocking loop must keep the donation."""
+    """The temporal-blocking loop must keep the donation."""
     j = Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float32,
                  kernel="xla", exchange_every=2)
     assert j.kernel_path == "xla-temporal[s=2]"
     arr = j.dd.curr["temp"]
-    compiled = j._step_n.lower(arr, jnp.asarray(2, jnp.int32)).compile()
-    ids = _alias_param_ids(compiled.as_text())
+    ids = compiled_alias_ids(j._step_n, (arr, jnp.asarray(2, jnp.int32)))
     assert 0 in ids
 
 
@@ -59,8 +62,7 @@ def test_exchange_orchestrator_donates_every_field():
     dd.add_data("a", np.float32)
     dd.add_data("b", np.float32)
     dd.realize()
-    compiled = dd._exchange_fn.lower(dd.curr).compile()
-    ids = _alias_param_ids(compiled.as_text())
+    ids = compiled_alias_ids(dd._exchange_fn, (dd.curr,))
     assert ids == {0, 1}, f"expected both fields donated, got {ids}"
 
 
@@ -74,9 +76,8 @@ def test_astaroth_iteration_donates_fields_and_w():
                  devices=jax.devices()[:2], dtype=np.float32,
                  kernel="xla", methods=Method.PpermuteSlab)
     a._ensure_w()
-    compiled = a._iter_n.lower(a.dd.curr, a._w,
-                               jnp.asarray(1, jnp.int32)).compile()
-    ids = _alias_param_ids(compiled.as_text())
+    ids = compiled_alias_ids(a._iter_n,
+                             (a.dd.curr, a._w, jnp.asarray(1, jnp.int32)))
     # 8 fields + 8 w accumulators donated; the iteration count is not
     assert ids == set(range(16)), ids
 
@@ -94,17 +95,14 @@ def test_megastep_segment_donates_field_buffer():
     m = StepMetrics(j.dd)
     seg = j.make_segment(4, probe_every=2, metrics=m)
     assert seg is not None and seg.fn is not None
-    vec = metric_base_vec(m, 0)
-    compiled = seg.fn.lower(j.dd.curr["temp"], vec).compile()
-    ids = _alias_param_ids(compiled.as_text())
+    vec = metric_base_vec(m, 0, mesh=j.dd.mesh)
+    ids = compiled_alias_ids(seg.fn, (j.dd.curr["temp"], vec))
     assert 0 in ids, "megastep lost its field-buffer donation"
 
 
 def test_domain_megastep_donates_every_field():
     """The generic DistributedDomain.make_segment donates the WHOLE
     field dict — every quantity's buffer aliases in place."""
-    import jax
-
     from stencil_tpu.distributed import DistributedDomain
     from stencil_tpu.geometry import Radius
     from stencil_tpu.parallel.exchange import exchange_shard
@@ -126,10 +124,59 @@ def test_domain_megastep_donates_every_field():
 
     dd.make_segment(shard_step, check_every=2)
     (fn,) = dd._segment_cache.values()
-    vec = metric_base_vec(None, 0)
-    compiled = fn.lower(dict(dd.curr), vec).compile()
-    ids = _alias_param_ids(compiled.as_text())
+    vec = metric_base_vec(None, 0, mesh=dd.mesh)
+    ids = compiled_alias_ids(fn, (dict(dd.curr), vec))
     assert {0, 1} <= ids, f"expected both fields donated, got {ids}"
+
+
+def test_alias_parser_handles_nested_braces():
+    """The alias map body nests braces ({0} output indices, {} param
+    index lists); the parser walks them balanced — a non-greedy regex
+    would stop at the first '}' and report an empty map. Also holds
+    without the usual ', entry' suffix after the attribute."""
+    text = ("HloModule m, "
+            "input_output_alias={ {0}: (0, {}, may-alias), "
+            "{1}: (2, {}, must-alias) }\nENTRY ...")
+    assert alias_param_ids(text) == {0, 2}
+
+
+def test_donation_checker_maps_through_dropped_params():
+    """jit's default keep_unused=False drops unused inputs from the
+    executable and renumbers the alias map; the checker must map its
+    flat-leaf expectations through the kept-parameter order, so a
+    correctly-donated arg AFTER an unused one audits clean — and a
+    donated arg the program never consumes is its own finding."""
+    import jax
+
+    from stencil_tpu.analysis import DonationSpec, DonationTarget
+    from stencil_tpu.analysis.donation import check_donation
+
+    fn = jax.jit(lambda unused, x: x + 1.0, donate_argnums=(1,))
+    args = (jnp.zeros((3,), jnp.float32), jnp.zeros((4,), jnp.float32))
+    t = DonationTarget("unit.dropped_param",
+                       lambda: DonationSpec(fn=fn, args=args,
+                                            donate_argnums=(1,)))
+    findings, metrics = check_donation(t)
+    assert findings == [], [str(f) for f in findings]
+    # the dropped-parameter case: declaring the UNUSED arg donated is
+    # a dead contract, reported as such
+    t2 = DonationTarget("unit.donated_unused",
+                        lambda: DonationSpec(fn=fn, args=args,
+                                             donate_argnums=(0,)))
+    findings, _ = check_donation(t2)
+    assert findings and "UNUSED by the compiled program" in \
+        findings[0].message
+
+
+def test_alias_parser_empty_on_alias_free_program():
+    """The promoted parser returns the empty set (never raises) on a
+    compiled program with no alias map — the donation checker turns
+    that into its donated-but-copied ERROR."""
+    import jax
+
+    fn = jax.jit(lambda x: x + 1.0)
+    compiled = fn.lower(jnp.zeros((4,), jnp.float32)).compile()
+    assert alias_param_ids(compiled.as_text()) == set()
 
 
 def test_donated_exchange_invalidates_input():

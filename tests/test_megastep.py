@@ -329,7 +329,11 @@ def test_megastep_registry_targets_prove_exact_counts():
     targets = [t for t in default_targets() if "megastep" in t.name]
     assert {t.name for t in targets} == {
         "parallel.megastep.segment[k=4,hlo]",
-        "parallel.megastep.segment[k=4,cost]"}
+        "parallel.megastep.segment[k=4,cost]",
+        # the dataflow audits of the same fused program (PR 9)
+        "parallel.megastep.segment[k=4,donation]",
+        "parallel.megastep.segment[k=4,transfer]",
+        "parallel.megastep.segment[k=4,recompile]"}
     report = run_targets(targets)
     assert not report.findings, report.findings
     hlo = report.metrics["hlo:parallel.megastep.segment[k=4,hlo]"]

@@ -674,7 +674,8 @@ def test_health_probe_registry_targets_prove_single_all_reduce():
         pytest.skip("StableHLO lowering unavailable in this JAX")
     targets = [t for t in default_targets()
                if t.name.startswith("resilience.health.")]
-    assert len(targets) == 2
+    # probe[hlo] + step+probe[hlo] + the step+probe transfer audit
+    assert len(targets) == 3
     report = run_targets(targets)
     assert report.findings == []
     probe = report.metrics["hlo:resilience.health.probe[hlo]"]
