@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Weak-scaling particle-in-cell demo (models/pic.py).
+
+The dynamic-communication counterpart of jacobi3d.py: charged
+particles deposit onto the sharded grid (reverse halo-accumulate),
+gather the field, push, and MIGRATE between shards over the
+fixed-capacity ppermute ring each step. CSV result line
+``pic,methods,devices,x,y,z,particles,deposition,min (s),trimean (s),
+particle_steps_per_s,mig_bytes_per_shard,overflow``; --resilient runs
+under the recovery driver with the --chaos-* fault plan (ParticleLoss
+included) — the CI pic-smoke stage's entry point.
+"""
+
+import argparse
+import json
+
+from _common import (add_device_flags, add_dtype_flags, add_method_flags,
+                     apply_device_flags, csv_line, dtype_from_args,
+                     methods_from_args, timed_samples)
+
+
+def _run_resilient(p, args) -> None:
+    from stencil_tpu.resilience import (FaultPlan, NaNInjection,
+                                        ParticleLoss, ResiliencePolicy,
+                                        TransientSaveFailure)
+
+    plan = FaultPlan(seed=args.chaos_seed)
+    if args.chaos_particle_loss:
+        plan.particle_losses.append(
+            ParticleLoss(step=args.chaos_particle_loss,
+                         count=args.chaos_particle_count))
+    if args.chaos_nan:
+        plan.nans.append(NaNInjection(step=args.chaos_nan))
+    if args.chaos_save_fail:
+        plan.save_failures.append(
+            TransientSaveFailure(step=args.chaos_save_fail))
+    policy = ResiliencePolicy(check_every=args.check_every,
+                              ckpt_every=args.ckpt_every,
+                              max_retries=args.max_retries,
+                              base_delay=0.01)
+    report = p.run_resilient(args.iters, policy=policy,
+                             ckpt_dir=args.ckpt_dir or None,
+                             faults=plan)
+    if args.events_json:
+        report.write(args.events_json)
+    print(csv_line("pic-resilient", methods_from_args(args),
+                   report.steps, report.rollbacks, report.save_retries,
+                   int(report.preempted), int(p.overflow_total()),
+                   report.final_config))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--x", type=int, default=8, help="per-device x size")
+    ap.add_argument("--y", type=int, default=8)
+    ap.add_argument("--z", type=int, default=8)
+    ap.add_argument("--particles", type=int, default=512, metavar="N",
+                    help="particles per DEVICE (weak scaling)")
+    ap.add_argument("--iters", "-n", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=5,
+                    help="iterations per timing sample (fused loop)")
+    ap.add_argument("--deposition", choices=("cic", "ngp"),
+                    default="cic")
+    ap.add_argument("--dt", type=float, default=0.25)
+    ap.add_argument("--capacity", type=int, default=0,
+                    help="per-shard particle slots (0 = 2x mean fill)")
+    ap.add_argument("--budget", type=int, default=0,
+                    help="migration record slots per direction "
+                         "(0 = capacity/4)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json-out", default="",
+                    help="write the bench record (BENCH_pr10 schema)")
+    add_dtype_flags(ap)
+    add_method_flags(ap)
+    add_device_flags(ap)
+    res = ap.add_argument_group(
+        "resilience", "run under the checkpoint-rollback driver; the "
+        "--chaos-* flags inject seeded faults (CI pic-smoke)")
+    res.add_argument("--resilient", action="store_true")
+    res.add_argument("--ckpt-dir", default="")
+    res.add_argument("--ckpt-every", type=int, default=4)
+    res.add_argument("--check-every", type=int, default=1)
+    res.add_argument("--max-retries", type=int, default=3)
+    res.add_argument("--events-json", default="")
+    res.add_argument("--chaos-particle-loss", type=int, default=0,
+                     metavar="STEP", help="NaN particle records of "
+                     "shard 0 after STEP (ParticleLoss)")
+    res.add_argument("--chaos-particle-count", type=int, default=2)
+    res.add_argument("--chaos-nan", type=int, default=0, metavar="STEP")
+    res.add_argument("--chaos-save-fail", type=int, default=0,
+                     metavar="STEP")
+    res.add_argument("--chaos-seed", type=int, default=0)
+    args = ap.parse_args()
+    if args.resilient and args.json_out:
+        ap.error("--json-out records the timed bench path; it is not "
+                 "produced by --resilient (use --events-json there)")
+    apply_device_flags(args)
+    dtype = dtype_from_args(args)
+
+    import jax
+
+    from stencil_tpu.models.pic import Pic
+    from stencil_tpu.parallel.mesh import default_mesh_shape
+
+    ndev = len(jax.devices())
+    mesh_shape = default_mesh_shape(ndev)
+    gx, gy, gz = (args.x * mesh_shape.x, args.y * mesh_shape.y,
+                  args.z * mesh_shape.z)
+    n = args.particles * ndev
+    p = Pic(gx, gy, gz, n, mesh_shape=mesh_shape, dtype=dtype,
+            methods=methods_from_args(args),
+            capacity=args.capacity or None, budget=args.budget or None,
+            deposition=args.deposition, dt=args.dt, seed=args.seed)
+
+    if args.resilient:
+        _run_resilient(p, args)
+        return
+
+    samples = max(args.iters // args.batch, 1)
+    steps_run = 0
+
+    def one():
+        nonlocal steps_run
+        p.run(args.batch)
+        steps_run += args.batch
+
+    # timed_samples also runs warmup calls of one(): steps_run counts
+    # what actually advanced, so the particle-steps counter is honest
+    stats = timed_samples(one, p.block, samples)
+    mig = p.migration_stats()
+    step_s = stats.trimean() / args.batch
+    psps = n / step_s  # particle steps advanced per second
+    print(csv_line("pic", methods_from_args(args), ndev, gx, gy, gz,
+                   n, args.deposition,
+                   f"{stats.min() / args.batch:.6e}",
+                   f"{step_s:.6e}", f"{psps:.6e}",
+                   mig["migration_bytes_per_shard"],
+                   int(p.overflow_total())))
+    p._export_run_metrics(steps_run)
+    if args.json_out:
+        rec = {
+            "bench": "pic",
+            "config": {"grid": [gx, gy, gz], "devices": ndev,
+                       "particles": n, "deposition": args.deposition,
+                       "dt": args.dt, "capacity": p.capacity,
+                       "budget": p.budget,
+                       "dtype": str(p._dtype)},
+            "seconds_per_step": step_s,
+            "particle_steps_per_s": psps,
+            "migration_bytes_per_shard":
+                mig["migration_bytes_per_shard"],
+            "overflow": p.overflow_total(),
+            "total_charge": p.total_charge(),
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(rec, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

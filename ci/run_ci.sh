@@ -23,7 +23,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
-echo "== 1/10 lint (stencil-lint + ruff; tier=$TIER) =="
+echo "== 1/11 lint (stencil-lint + ruff; tier=$TIER) =="
 # stencil-lint: all nine static checkers — halo-radius footprint, DMA
 # discipline, ppermute sanity, HLO collective-permute-only lowering,
 # analytic-vs-HLO byte cross-check, the Pallas VMEM/tiling audit, and
@@ -79,10 +79,10 @@ if [ "$TIER" = "full" ]; then
   fi
 fi
 
-echo "== 2/10 native build =="
+echo "== 2/11 native build =="
 bash ci/build.sh
 
-echo "== 3/10 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+echo "== 3/11 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
 # The full tier is dominated by interpret-mode Pallas parity tests
 # (CPU-bound, independent): fan them out with pytest-xdist when the
 # machine has cores to spare. Each worker process builds its own
@@ -98,7 +98,7 @@ else
   python -m pytest tests/ -q --maxfail=1 -m "not slow"
 fi
 
-echo "== 4/10 app smoke runs =="
+echo "== 4/11 app smoke runs =="
 # overlap app smokes execute remote DMA: possible only on a TPU or
 # with the distributed (mosaic) interpreter — probe, don't assume
 RDMA_OK=$(python -c "from stencil_tpu._compat import remote_dma_runnable
@@ -123,7 +123,7 @@ smoke() { echo "-- $*"; python "$@" > /dev/null; }
   smoke bench_qap.py --sizes 4 6
 )
 
-echo "== 5/10 bench smoke: temporal blocking + autotuned plan =="
+echo "== 5/11 bench smoke: temporal blocking + autotuned plan =="
 # communication-avoiding temporal blocking must not regress steps/s of
 # the REAL blocked hot path (Jacobi3D's fused run loop, redundant ring
 # compute included) on the fake CPU mesh; the amortized byte model
@@ -199,7 +199,7 @@ EOF
 fi
 rm -f "$BENCH_JSON" "$BENCH_METRICS" "$TUNE_CACHE"
 
-echo "== 6/10 exchange autotuner (fake timer: search/fit/plan/cache) =="
+echo "== 6/11 exchange autotuner (fake timer: search/fit/plan/cache) =="
 # the tuner's whole pipeline with deterministic fake measurements (no
 # hardware dependence): first invocation tunes and writes the plan
 # cache, the second MUST be a cache hit performing zero measurements.
@@ -230,7 +230,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -f "$TUNE_CACHE" "$PLAN1" "$PLAN2"
 
-echo "== 7/10 chaos smoke: resilient run loop under injected faults =="
+echo "== 7/11 chaos smoke: resilient run loop under injected faults =="
 # the Jacobi app under run_resilient (stencil_tpu/resilience) with a
 # seeded fault plan: one NaN injection (must trip the health sentinel
 # and roll back to the last good checkpoint) and one transient save
@@ -272,7 +272,53 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -rf "$CHAOS_CKPT" "$CHAOS_EVENTS"
 
-echo "== 8/10 service smoke: concurrent multi-tenant ensemble campaigns =="
+echo "== 8/11 pic smoke: particle migration + ParticleLoss chaos =="
+# the particle-in-cell workload (stencil_tpu/models/pic.py): a short
+# run proves the dynamic migration path end-to-end (CSV line, zero
+# overflow, charge conserved), then a chaos run injects a ParticleLoss
+# fault (NaN'd particle records) that must trip the sentinel via the
+# particle lanes, roll back to a checkpoint carrying the lanes as
+# extras, and still complete every step. The event log is the CI
+# artifact.
+PIC_CKPT="$(mktemp -d -t pic_ckpt.XXXXXX)"
+PIC_EVENTS="$(mktemp -t pic_events.XXXXXX.json)"
+PIC_BENCH="$(mktemp -t pic_bench.XXXXXX.json)"
+( cd apps
+  python pic.py --x 8 --y 8 --z 8 --particles 64 --iters 4 --batch 2 \
+        --fake-cpu 8 --deposition ngp --f64 \
+        --json-out "$PIC_BENCH" > /dev/null
+  python pic.py --x 8 --y 8 --z 8 --particles 64 --iters 6 --fake-cpu 8 \
+        --resilient --ckpt-dir "$PIC_CKPT" --ckpt-every 2 \
+        --check-every 1 --chaos-particle-loss 3 \
+        --events-json "$PIC_EVENTS" > /dev/null )
+PIC_EVENTS="$PIC_EVENTS" PIC_BENCH="$PIC_BENCH" python - <<'EOF'
+import json
+import os
+b = json.load(open(os.environ["PIC_BENCH"]))
+assert b["overflow"] == 0, b
+assert b["total_charge"] == b["config"]["particles"], b
+assert b["particle_steps_per_s"] > 0, b
+d = json.load(open(os.environ["PIC_EVENTS"]))
+assert d["steps"] == 6, d
+assert d["rollbacks"] >= 1, d
+kinds = [e["event"] for e in d["events"]]
+assert "fault_particle_loss" in kinds, kinds
+assert "sentinel_tripped" in kinds and "restored" in kinds, kinds
+trip = [e for e in d["events"] if e["event"] == "sentinel_tripped"][0]
+assert trip["step"] == 3, trip
+print(f"pic smoke OK: {b['particle_steps_per_s']:.0f} particle "
+      f"steps/s, charge conserved, ParticleLoss at step 3 tripped + "
+      f"{d['rollbacks']} rollback(s), {d['steps']}/6 steps")
+EOF
+python -m stencil_tpu.telemetry validate-events "$PIC_EVENTS"
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+  mkdir -p "$CI_ARTIFACT_DIR"
+  cp "$PIC_EVENTS" "$CI_ARTIFACT_DIR/pic_events.json"
+  cp "$PIC_BENCH" "$CI_ARTIFACT_DIR/BENCH_pr10.json"
+fi
+rm -rf "$PIC_CKPT" "$PIC_EVENTS" "$PIC_BENCH"
+
+echo "== 9/11 service smoke: concurrent multi-tenant ensemble campaigns =="
 # the campaign service (stencil_tpu/serving) on the fake CPU mesh:
 # three concurrent fake tenants share one problem fingerprint and ride
 # ONE batched ensemble dispatch stream (tenant0 gets a chaos NaN that
@@ -328,7 +374,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -rf "$SERVE_ROOT" "$SERVE_CACHE" "$SERVE_EVENTS1" "$SERVE_EVENTS2"
 
-echo "== 9/10 telemetry: metrics surface, span trace, unified events =="
+echo "== 10/11 telemetry: metrics surface, span trace, unified events =="
 # the observability acceptance gate (stencil_tpu/telemetry): a first
 # service process (cold: tunes once) and a second process on the same
 # plan cache (warm) each export their metrics snapshot, span trace,
@@ -399,7 +445,7 @@ fi
 rm -rf "$TM_ROOT" "$TM_CACHE" "$TM_EVENTS1" "$TM_EVENTS2" \
        "$TM_METRICS1" "$TM_METRICS2" "$TM_TRACE"
 
-echo "== 10/10 multi-chip certification sweep =="
+echo "== 11/11 multi-chip certification sweep =="
 python __graft_entry__.py 8 | tail -1
 
 echo "CI PASSED"

@@ -128,6 +128,46 @@ def amortized_step_wire_bytes(shard_interior_zyx: Sequence[int],
                                          counts, elem_size, steps) / steps
 
 
+def migration_record_rows(n_fields: int) -> int:
+    """Rows of one particle-migration wire record: the SoA fields plus
+    the three riding offset components and the validity flag — the one
+    constant the engine packs (``parallel.migrate.RECORD_EXTRA_ROWS``),
+    re-exported here so the byte model cannot drift from the packer."""
+    from ..parallel.migrate import migration_record_rows as rows
+
+    return rows(n_fields)
+
+
+def migration_wire_bytes_per_shard(n_fields: int, budget: int, counts,
+                                   elem_size: int) -> int:
+    """Wire bytes ONE shard puts on the fabric per migration step:
+    2 direction messages per mesh axis that crosses devices, each a
+    fixed ``record_rows x budget`` buffer — the *static* price of the
+    dynamic exchange (payload occupancy varies at runtime; wire bytes
+    do not, which is what makes the HLO cross-check exact). 1-device
+    axes degenerate to local copies and cost nothing."""
+    from ..parallel.migrate import migration_messages
+
+    return (migration_messages(counts) * migration_record_rows(n_fields)
+            * int(budget) * int(elem_size))
+
+
+def migration_step_seconds(n_fields: int, budget: int, counts,
+                           elem_size: int,
+                           coeffs: "LinkCoefficients | None" = None
+                           ) -> float:
+    """Alpha-beta migration cost per STEP: the ppermute launches plus
+    the budget-sized buffers over the calibrated wire rate — what the
+    tuner ranks capacity/budget candidates with
+    (``tuning.plan.rank_migration_candidates``)."""
+    from ..parallel.migrate import migration_messages
+
+    c = coeffs if coeffs is not None else DEFAULT_ICI_COEFFS
+    return c.seconds(migration_messages(counts),
+                     migration_wire_bytes_per_shard(
+                         n_fields, budget, counts, elem_size))
+
+
 def temporal_step_exchange_seconds(shard_interior_zyx: Sequence[int],
                                    radius, counts, elem_size: int,
                                    steps: int, round_latency_s: float,

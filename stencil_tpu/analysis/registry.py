@@ -934,6 +934,149 @@ def _megastep_segment_cost() -> CostModelSpec:
 
 
 # ---------------------------------------------------------------------------
+# particle-migration / PIC targets: the DYNAMIC communication pattern.
+# The fixed-capacity migration ring must lower to collective-permute
+# only with its static budget x record-rows wire bill matching the
+# analytic model EXACTLY (payload occupancy is runtime-dynamic; wire
+# bytes are not — that is the whole design), and the full fused PIC
+# step (deposit + reverse accumulate + exchange + gather + push +
+# migrate) must bill exactly 2 ppermutes per active axis per engine
+# and nothing else. tests/fixtures/lint/bad_migration.py (a migration
+# that all-gathers every shard's outbox) is the negative control.
+
+_MIGRATE_MESH = (2, 2, 2)
+_MIGRATE_FIELDS = ("q", "x", "y")
+_MIGRATE_CAPACITY = 16
+_MIGRATE_BUDGET = 4
+
+_PIC_N = 64
+_PIC_CAPACITY = 32
+_PIC_BUDGET = 8
+
+
+def _migrate_spec() -> CollectiveSpec:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import mesh_dim
+    from ..parallel.migrate import migrate_shard
+
+    mesh = _mesh(_MIGRATE_MESH)
+    counts = mesh_dim(mesh)
+    cap = _MIGRATE_CAPACITY
+
+    def shard(fields, valid, ox, oy, oz):
+        f, v, ovf = migrate_shard(fields, valid, (ox, oy, oz), counts,
+                                  _MIGRATE_BUDGET)
+        return f, v, ovf.reshape(1)
+
+    spec = P(("z", "y", "x"))
+    fspec = {q: spec for q in _MIGRATE_FIELDS}
+    sm = jax.shard_map(shard, mesh=mesh,
+                       in_specs=(fspec, spec, spec, spec, spec),
+                       out_specs=(fspec, spec, spec), check_vma=False)
+    n = 8 * cap
+    fields = {q: _f32((n,)) for q in _MIGRATE_FIELDS}
+    valid = jax.ShapeDtypeStruct((n,), jnp.bool_)
+    off = jax.ShapeDtypeStruct((n,), jnp.int32)
+    return CollectiveSpec(fn=sm, args=(fields, valid, off, off, off),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _migrate_hlo() -> HloSpec:
+    cs = _migrate_spec()
+    # 2 directions x 3 active axes, one packed record buffer each —
+    # the dynamic exchange's whole collective bill
+    return HloSpec(fn=cs.fn, args=cs.args, allow=("collective_permute",),
+                   exact_counts={"collective_permute": 6})
+
+
+def _migrate_cost() -> CostModelSpec:
+    from ..geometry import Dim3
+    from .costmodel import migration_wire_bytes_per_shard
+
+    cs = _migrate_spec()
+    expected = migration_wire_bytes_per_shard(
+        len(_MIGRATE_FIELDS), _MIGRATE_BUDGET, Dim3(*_MIGRATE_MESH), 4)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected,
+                         count_kinds=("collective_permute",))
+
+
+@functools.lru_cache(maxsize=None)
+def _pic_engine():
+    import numpy as np
+
+    from ..models.pic import Pic
+
+    return Pic(16, 16, 16, _PIC_N, mesh_shape=_EXCHANGE_MESH,
+               dtype=np.float32, capacity=_PIC_CAPACITY,
+               budget=_PIC_BUDGET)
+
+
+@functools.lru_cache(maxsize=None)
+def _pic_step_entry():
+    eng = _pic_engine()
+    return eng._step, (dict(eng.state),)
+
+
+def _pic_step_bytes() -> int:
+    """The fused PIC step's exact wire bill: reverse accumulate +
+    forward exchange (each one radius-2 sweep on the padded shard) +
+    the migration ring."""
+    from ..geometry import Dim3, Radius
+    from ..models.pic import PARTICLE_FIELDS, RADIUS
+    from .costmodel import migration_wire_bytes_per_shard
+
+    eng = _pic_engine()
+    local = eng.dd.local_size
+    pad = 2 * RADIUS
+    padded = (local.z + pad, local.y + pad, local.x + pad)
+    sweep = _sweep_bytes(padded, Radius.constant(RADIUS),
+                         Dim3(*_EXCHANGE_MESH), 4)
+    return 2 * sweep + migration_wire_bytes_per_shard(
+        len(PARTICLE_FIELDS), _PIC_BUDGET, Dim3(*_EXCHANGE_MESH), 4)
+
+
+def _pic_step_hlo() -> HloSpec:
+    fn, args = _pic_step_entry()
+    # 6 ppermutes each for accumulate, exchange, and migration — the
+    # dynamic pattern pays the same ring discipline as the static one
+    return HloSpec(fn=fn, args=args, allow=("collective_permute",),
+                   exact_counts={"collective_permute": 18})
+
+
+def _pic_step_cost() -> CostModelSpec:
+    fn, args = _pic_step_entry()
+    return CostModelSpec(fn=fn, args=args,
+                         expected_bytes_per_shard=_pic_step_bytes(),
+                         count_kinds=("collective_permute",))
+
+
+def _pic_probe_hlo() -> HloSpec:
+    """The PIC sentinel probe: rho + every particle SoA lane + the
+    IN-GRAPH migration-overflow column, still exactly ONE small
+    all-reduce — the overflow counter rides the existing reduction."""
+    eng = _pic_engine()
+    return HloSpec(fn=eng._probe_fn, args=(dict(eng.state),),
+                   allow=("all_reduce",),
+                   exact_counts={"all_reduce": 1})
+
+
+def _central_diff_spec(axis: int) -> StencilOpSpec:
+    from ..geometry import Dim3, Radius
+    from ..ops.stencil_kernels import central_diff
+
+    radius = Radius.constant(1)
+    interior = Dim3(8, 8, 8)
+    return StencilOpSpec(
+        fn=lambda p: central_diff(p, axis, radius, interior),
+        args=(_f32((10, 10, 10)),), radius=radius, interior=interior)
+
+
+# ---------------------------------------------------------------------------
 # dataflow targets: donation / transfer / recompile for every compiled
 # entry point the drivers dispatch — the model step loops, the
 # temporal path, make_exchange, the fused megastep segments, and the
@@ -1113,6 +1256,7 @@ def _dataflow_targets() -> List[Target]:
          _ensemble_segment_entry, (0,)),
         (f"serving.ensemble.set_lane[N={_ENSEMBLE_N},donation]",
          _ensemble_set_lane_entry, (0,)),
+        ("models.pic.step[donation]", _pic_step_entry, (0,)),
     ]
     for name, entry, donate in donation:
         targets.append(DonationTarget(
@@ -1129,6 +1273,7 @@ def _dataflow_targets() -> List[Target]:
          _ensemble_step_entry),
         (f"serving.ensemble.segment[N={_ENSEMBLE_N},k=2,transfer]",
          _ensemble_segment_entry),
+        ("models.pic.step[transfer]", _pic_step_entry),
     ]
     for name, entry in transfer:
         targets.append(TransferTarget(
@@ -1150,6 +1295,7 @@ def _dataflow_targets() -> List[Target]:
          _ensemble_step_entry, ((0, None),)),
         (f"serving.ensemble.segment[N={_ENSEMBLE_N},k=2,recompile]",
          _ensemble_segment_entry, ((0, (0,)),)),
+        ("models.pic.step[recompile]", _pic_step_entry, ((0, None),)),
     ]
     for name, entry, carry in recompile:
         targets.append(RecompileTarget(
@@ -1463,6 +1609,25 @@ def default_targets() -> List[Target]:
             f"parallel.megastep.segment[k={_MEGASTEP_K},cost]",
             _megastep_segment_cost),
     ]
+    # the particle-migration ring and the fused PIC step: the dynamic
+    # communication pattern under the same gates as the static sweep —
+    # ppermute-only lowering with the static budget x record-rows wire
+    # bill matching the model exactly, and the overflow column riding
+    # the probe's one all-reduce
+    targets += [
+        CollectiveTarget("parallel.migrate.migrate_shard",
+                         _migrate_spec),
+        HloTarget("parallel.migrate.migrate_shard[hlo]", _migrate_hlo),
+        CostModelTarget("parallel.migrate.migrate_shard[cost]",
+                        _migrate_cost),
+        HloTarget("models.pic.step[hlo]", _pic_step_hlo),
+        CostModelTarget("models.pic.step[cost]", _pic_step_cost),
+        HloTarget("models.pic.probe[hlo]", _pic_probe_hlo),
+    ]
+    for axis, ax_name in enumerate("xyz"):
+        targets.append(StencilOpTarget(
+            f"ops.stencil_kernels.central_diff[{ax_name}]",
+            lambda a=axis: _central_diff_spec(a)))
     # the dataflow block: donation / transfer / recompile audits for
     # every compiled entry point the drivers dispatch
     targets += _dataflow_targets()

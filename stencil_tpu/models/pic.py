@@ -1,0 +1,562 @@
+"""Particle-in-cell mini-app: charged particles on the sharded grid.
+
+The scenario-diversity workload (ROADMAP item 5): both PIConGPU
+(arXiv:1606.02862) and POLAR-PIC (arXiv:2604.19337) are PIC codes
+layered on exactly this kind of halo framework plus one thing the
+static sweep never exercised — a *dynamic, data-dependent* exchange.
+One PIC step, fused into a single ``shard_map``-ped XLA program per
+shard:
+
+1. **deposit** — every particle scatters its charge into the
+   halo-padded ``rho`` array (NGP nearest-cell or CIC trilinear); edge
+   particles legally land in pad cells that belong to a neighbor;
+2. **reverse halo-accumulate** — the adjoint of the halo sweep
+   (:func:`~stencil_tpu.parallel.exchange.accumulate_shard`) folds
+   those pad contributions back into the owning interiors;
+3. **exchange** — the ordinary forward halo sweep fills ``rho`` pads
+   so the field stencil has support;
+4. **gather** — ``E = -grad rho`` (``ops.stencil_kernels.central_diff``)
+   interpolated at particle positions (NGP/CIC, same kernel family as
+   the deposit);
+5. **leapfrog push** — ``v += q E dt``, ``x += v dt`` (like charges
+   repel: the deposited density is its own potential proxy — a
+   mini-app, not a Poisson solver);
+6. **migrate** — the fixed-capacity sort/pad/ppermute-ring migration
+   (:mod:`stencil_tpu.parallel.migrate`), with the cumulative overflow
+   counter carried in the particle state.
+
+Communication bill per step, pinned by the ``models.pic.*`` registry
+targets: 2 ppermutes per active axis for the accumulate + 2 for the
+exchange + 2 for the migration — collective-permute only, bytes
+matching the analytic model exactly. Health probing rides the standard
+sentinel machinery: :meth:`Pic.make_sentinel` probes ``rho`` AND the
+particle SoA arrays and appends the migration-overflow counter as an
+extra probe column on the probe's one existing all-reduce.
+
+CFL-style contract: a particle moves at most one shard per step
+(``|v| * dt < min shard extent``) — the fixed ±1 ring is exact under
+it; beyond it, migration drops and counts the particle (overflow).
+Boundaries are periodic (the migration ring and the position wrap
+share one topology).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..distributed import DistributedDomain
+from ..geometry import Dim3
+from ..ops.stencil_kernels import central_diff
+from ..parallel.exchange import (accumulate_shard, dispatch_exchange,
+                                 shard_interior_len, shard_origin)
+from ..parallel.mesh import mesh_dim
+from ..parallel.methods import Method, pick_method
+from ..parallel.migrate import migrate_shard
+
+#: the particle SoA fields, in state order (one common dtype)
+PARTICLE_FIELDS = ("x", "y", "z", "vx", "vy", "vz", "q")
+
+#: every per-particle state key checkpointed as extras
+PARTICLE_STATE_KEYS = PARTICLE_FIELDS + ("valid", "overflow")
+
+#: PIC stencil radius: CIC deposits reach 1 cell past the interior and
+#: the gathered E needs rho one cell further out
+RADIUS = 2
+
+
+def _floor_int(v):
+    return jnp.floor(v).astype(jnp.int32)
+
+
+class Pic:
+    """Distributed electrostatic-proxy PIC over a TPU mesh."""
+
+    def __init__(self, x: int, y: int, z: int, n_particles: int,
+                 mesh_shape=None, dtype=jnp.float32,
+                 devices: Optional[Sequence] = None,
+                 methods: Method = Method.Default,
+                 capacity: Optional[int] = None,
+                 budget: Optional[int] = None,
+                 deposition: str = "cic", dt: float = 0.25,
+                 push: float = 1.0, seed: int = 0) -> None:
+        if deposition not in ("cic", "ngp"):
+            raise ValueError(f"deposition must be cic|ngp, "
+                             f"got {deposition!r}")
+        self.dd = DistributedDomain(x, y, z, devices=devices)
+        self.dd.set_radius(RADIUS)
+        self.dd.set_methods(methods)
+        if pick_method(methods) not in (Method.PpermuteSlab,
+                                        Method.PpermutePacked):
+            raise NotImplementedError(
+                "Pic supports the PpermuteSlab and PpermutePacked "
+                "exchange methods (the accumulate adjoint and the "
+                "migration ring are ppermute engines)")
+        if mesh_shape is not None:
+            self.dd.set_mesh_shape(mesh_shape)
+        self.dd.add_data("rho", dtype)
+        self.dd.realize()
+        self._dtype = np.dtype(self.dd._dtypes["rho"])
+        self.n_particles = int(n_particles)
+        self.deposition = deposition
+        self.dt = float(dt)
+        self.push = float(push)
+        self.seed = int(seed)
+        n_shards = self.dd.placement.dim().flatten()
+        per = -(-self.n_particles // n_shards)
+        self.capacity = (int(capacity) if capacity is not None
+                         else max(2 * per, 8))
+        if self.capacity < per:
+            raise ValueError(
+                f"capacity {self.capacity} < {per} particles/shard at "
+                f"a uniform fill — even the initial state overflows")
+        self.budget = (int(budget) if budget is not None
+                       else max(self.capacity // 4, 4))
+        if not 1 <= self.budget <= self.capacity:
+            raise ValueError(f"budget must be in [1, capacity], got "
+                             f"{self.budget}")
+        # the ParticleLoss fault class reads the block layout off the
+        # domain (resilience/faults.py)
+        self.dd.particle_capacity = self.capacity
+        self._psharding = NamedSharding(self.dd.mesh, P(("z", "y", "x")))
+        #: the LIVE state the step advances, the sentinel probes, and
+        #: the fault injector mutates: the padded rho global plus the
+        #: particle SoA/validity/overflow lanes (dd.curr['rho'] stays
+        #: aliased to state['rho'] after every step)
+        self.state: Dict[str, jnp.ndarray] = {}
+        self._build_step()
+        self._build_probe()
+        self.init()
+
+    # -- geometry helpers ----------------------------------------------
+    def _axis_bounds(self, axis: int) -> np.ndarray:
+        """Subdomain origin boundaries along grid ``axis`` (len
+        counts+1) — uneven (+-1) partitions included."""
+        dim = self.dd.placement.dim()
+        idx = [Dim3(*(b if a == axis else 0 for a in range(3)))
+               for b in range(dim[axis])]
+        orgs = [self.dd.placement.subdomain_origin(i)[axis] for i in idx]
+        return np.asarray(orgs + [self.dd.size[axis]], dtype=np.float64)
+
+    def _block_linear(self, bx, by, bz):
+        """Linear particle-block index of shard (bx, by, bz) under the
+        ``P(('z','y','x'))`` sharding: z outermost, x innermost."""
+        dim = self.dd.placement.dim()
+        return (bz * dim.y + by) * dim.x + bx
+
+    # -- initial conditions --------------------------------------------
+    def init(self) -> None:
+        """Seeded uniform plasma: positions uniform over the grid,
+        small thermal velocities, unit charges."""
+        rng = np.random.default_rng(self.seed)
+        g = self.dd.size
+        n = self.n_particles
+        arrays = {
+            "x": rng.uniform(0, g.x, n), "y": rng.uniform(0, g.y, n),
+            "z": rng.uniform(0, g.z, n),
+            "vx": rng.normal(0, 0.05, n), "vy": rng.normal(0, 0.05, n),
+            "vz": rng.normal(0, 0.05, n),
+            "q": np.ones(n),
+        }
+        self.set_particles(arrays)
+
+    def set_particles(self, arrays: Dict[str, np.ndarray]) -> None:
+        """Install explicit particle ICs (host arrays of one common
+        length, keys :data:`PARTICLE_FIELDS`; missing velocity/charge
+        keys default to 0/1). Particles are binned to the shard owning
+        their position and padded to the static capacity."""
+        n = len(np.asarray(arrays["x"]))
+        host = {}
+        for k in PARTICLE_FIELDS:
+            v = arrays.get(k)
+            if v is None:
+                v = np.ones(n) if k == "q" else np.zeros(n)
+            host[k] = np.asarray(v, dtype=self._dtype)
+            if host[k].shape != (n,):
+                raise ValueError(f"particle field {k!r} has shape "
+                                 f"{host[k].shape}, want ({n},)")
+        bounds = [self._axis_bounds(a) for a in range(3)]
+        pos = {0: host["x"], 1: host["y"], 2: host["z"]}
+        for a in range(3):
+            if np.any((pos[a] < 0) | (pos[a] >= self.dd.size[a])):
+                raise ValueError(f"particle positions outside the "
+                                 f"[0, {self.dd.size[a]}) grid along "
+                                 f"{'xyz'[a]}")
+        block = np.zeros(n, dtype=np.int64)
+        bidx = {}
+        for a in range(3):
+            bidx[a] = np.searchsorted(bounds[a], pos[a],
+                                      side="right") - 1
+        block = self._block_linear(bidx[0], bidx[1], bidx[2])
+        n_shards = self.dd.placement.dim().flatten()
+        cap = self.capacity
+        full = {k: np.zeros(n_shards * cap, dtype=self._dtype)
+                for k in PARTICLE_FIELDS}
+        valid = np.zeros(n_shards * cap, dtype=bool)
+        for b in range(n_shards):
+            sel = np.nonzero(block == b)[0]
+            if len(sel) > cap:
+                raise ValueError(
+                    f"{len(sel)} particles land on shard block {b} "
+                    f"but capacity is {cap}")
+            dst = slice(b * cap, b * cap + len(sel))
+            for k in PARTICLE_FIELDS:
+                full[k][dst] = host[k][sel]
+            valid[b * cap: b * cap + len(sel)] = True
+        self.n_particles = n
+        for k in PARTICLE_FIELDS:
+            self.state[k] = jax.device_put(full[k], self._psharding)
+        self.state["valid"] = jax.device_put(valid, self._psharding)
+        self.state["overflow"] = jax.device_put(
+            np.zeros(n_shards, dtype=np.float32), self._psharding)
+        self.state["rho"] = self.dd.curr["rho"]
+
+    # -- the fused step ------------------------------------------------
+    def _build_step(self) -> None:
+        dd = self.dd
+        counts = mesh_dim(dd.mesh)
+        local = dd.local_size
+        gsize = dd.size
+        rem = dd.rem
+        radius = dd.alloc_radius
+        lo = radius.pad_lo()
+        method = pick_method(dd.methods)
+        dep = self.deposition
+        dt = self.dt
+        push = self.push
+        budget = self.budget
+        cap = self.capacity
+
+        def deposit_weights(px, py, pz):
+            """Per-corner (cell_index, weight) pairs of the deposition
+            stencil in LOCAL coordinates — shared by the charge
+            scatter and the field gather so the two interpolate the
+            same way (validity masking is the call sites' business)."""
+            if dep == "ngp":
+                cz = _floor_int(pz + 0.5)
+                cy = _floor_int(py + 0.5)
+                cx = _floor_int(px + 0.5)
+                one = jnp.ones_like(px)
+                return [((cz, cy, cx), one)]
+            i0z, i0y, i0x = _floor_int(pz), _floor_int(py), _floor_int(px)
+            fz = pz - jnp.floor(pz)
+            fy = py - jnp.floor(py)
+            fx = px - jnp.floor(px)
+            out = []
+            for dz in (0, 1):
+                wz = fz if dz else (1.0 - fz)
+                for dy in (0, 1):
+                    wy = fy if dy else (1.0 - fy)
+                    for dx in (0, 1):
+                        wx = fx if dx else (1.0 - fx)
+                        out.append(((i0z + dz, i0y + dy, i0x + dx),
+                                    wz * wy * wx))
+            return out
+
+        def shard_step(st):
+            rho = st["rho"]
+            valid = st["valid"]
+            q = st["q"]
+            ox, oy, oz = shard_origin(local, rem)
+            Lx = shard_interior_len(0, local.x, rem)
+            Ly = shard_interior_len(1, local.y, rem)
+            Lz = shard_interior_len(2, local.z, rem)
+            # local (cell) coordinates of each particle on this shard
+            px = st["x"] - ox
+            py = st["y"] - oy
+            pz = st["z"] - oz
+
+            # 1. deposit charge into the padded shard (pads included).
+            # The deposit target is the DONATED rho buffer scrubbed to
+            # zero NaN-safely (nan_to_num first: a poisoned cell must
+            # not survive the x*0 rewrite XLA is forbidden to fold) —
+            # a plain zeros_like would leave the rho parameter unused
+            # and the compiler would drop its input_output_alias
+            rho_new = jnp.nan_to_num(rho) * jnp.zeros((), rho.dtype)
+            corners = deposit_weights(px, py, pz)
+            for (cz, cy, cx), w in corners:
+                iz = jnp.where(valid, lo.z + cz, -1)
+                iy = jnp.where(valid, lo.y + cy, -1)
+                ix = jnp.where(valid, lo.x + cx, -1)
+                rho_new = rho_new.at[(iz, iy, ix)].add(
+                    jnp.where(valid, q * w.astype(q.dtype),
+                              jnp.zeros_like(q)), mode="drop")
+
+            # 2. fold pad deposits into the owning interiors (adjoint)
+            rho_new = accumulate_shard(rho_new, radius, counts, rem=rem)
+
+            # 3. forward halo sweep: fill pads for the field stencil
+            rho_new = dispatch_exchange(
+                {"rho": rho_new}, radius, counts, method,
+                rem=rem)["rho"]
+
+            # 4. gather E = -grad rho at the particles; the field is
+            # computed on the static [0, capacity] node window (the
+            # one-past-interior column edge particles interpolate)
+            win = Dim3(local.x + 1, local.y + 1, local.z + 1)
+            E = [-central_diff(rho_new, a, radius, win)
+                 for a in range(3)]
+            ex = jnp.zeros_like(px)
+            ey = jnp.zeros_like(py)
+            ez = jnp.zeros_like(pz)
+            for (cz, cy, cx), w in corners:
+                gz = jnp.clip(cz, 0, local.z)
+                gy = jnp.clip(cy, 0, local.y)
+                gx = jnp.clip(cx, 0, local.x)
+                wt = w.astype(px.dtype)
+                ex = ex + wt * E[0][(gz, gy, gx)]
+                ey = ey + wt * E[1][(gz, gy, gx)]
+                ez = ez + wt * E[2][(gz, gy, gx)]
+
+            # 5. leapfrog push (unwrapped positions decide the hop;
+            # the stored position wraps periodically)
+            k = jnp.asarray(push * dt, q.dtype)
+            vx = st["vx"] + k * q * ex
+            vy = st["vy"] + k * q * ey
+            vz = st["vz"] + k * q * ez
+            ux = st["x"] + vx * dt
+            uy = st["y"] + vy * dt
+            uz = st["z"] + vz * dt
+
+            def offset(u, o, ln):
+                off = (jnp.where(u >= o + ln, 1, 0)
+                       + jnp.where(u < o, -1, 0)).astype(jnp.int32)
+                return jnp.where(valid, off, 0)
+
+            offs = (offset(ux, ox, Lx), offset(uy, oy, Ly),
+                    offset(uz, oz, Lz))
+
+            # CFL guard: a particle that would hop MORE than one shard
+            # cannot be routed by the +-1 ring — drop it and COUNT it
+            # as overflow rather than ship it one hop short, where its
+            # out-of-window deposits would be discarded silently
+            def beyond(u, o, ln):
+                return (u >= o + 2 * ln) | (u < o - ln)
+
+            cfl = valid & (beyond(ux, ox, Lx) | beyond(uy, oy, Ly)
+                           | beyond(uz, oz, Lz))
+            valid = valid & ~cfl
+            fields = {
+                "x": jnp.mod(ux, gsize.x), "y": jnp.mod(uy, gsize.y),
+                "z": jnp.mod(uz, gsize.z),
+                "vx": vx, "vy": vy, "vz": vz, "q": q,
+            }
+
+            # 6. migrate across the ppermute ring; overflow accumulates
+            fields, valid, ovf = migrate_shard(fields, valid, offs,
+                                               counts, budget)
+            ovf = ovf + jnp.sum(cfl).astype(jnp.float32)
+            out = {"rho": rho_new, "valid": valid,
+                   "overflow": st["overflow"] + ovf}
+            out.update(fields)
+            return out
+
+        specs = {"rho": P("z", "y", "x")}
+        for k in PARTICLE_STATE_KEYS:
+            specs[k] = P(("z", "y", "x"))
+        sm = jax.shard_map(shard_step, mesh=dd.mesh, in_specs=(specs,),
+                           out_specs=specs, check_vma=False)
+        self._step = jax.jit(sm, donate_argnums=0)
+
+        def shard_steps(st, n):
+            return lax.fori_loop(0, n, lambda _, s: shard_step(s), st)
+
+        sm_n = jax.shard_map(shard_steps, mesh=dd.mesh,
+                             in_specs=(specs, P()), out_specs=specs,
+                             check_vma=False)
+        self._step_n = jax.jit(sm_n, donate_argnums=0)
+        # the per-axis displacement bound the +-1 ring can host, for
+        # the CFL note in diagnostics; the in-graph guard above DROPS
+        # and COUNTS violators (overflow), never corrupts
+        self._min_extent = min(
+            local[a] - (1 if rem[a] else 0) for a in range(3))
+
+    def _adopt(self, out) -> None:
+        self.state = dict(out)
+        self.dd.curr["rho"] = self.state["rho"]
+
+    def step(self) -> None:
+        """One PIC step: deposit + accumulate + exchange + gather +
+        push + migrate, as one compiled dispatch."""
+        self._adopt(self._step(self.state))
+
+    def run(self, iters: int) -> None:
+        """``iters`` steps in one XLA program (fori_loop body)."""
+        self._adopt(self._step_n(self.state,
+                                 jnp.asarray(iters, jnp.int32)))
+
+    def block(self) -> None:
+        from ..utils.timers import device_sync
+        device_sync(self.state["rho"])
+
+    # -- health probing -------------------------------------------------
+    def _build_probe(self) -> None:
+        dd = self.dd
+        self._probe_names = ["rho"] + list(PARTICLE_FIELDS)
+        specs = {"rho": P("z", "y", "x")}
+        for k in PARTICLE_STATE_KEYS:
+            specs[k] = P(("z", "y", "x"))
+        names = list(self._probe_names)
+
+        def shard(st):
+            from ..resilience.health import probe_shard
+            return probe_shard(
+                {q: st[q] for q in names},
+                extra={"migration_overflow": st["overflow"][0]})
+
+        sm = jax.shard_map(shard, mesh=dd.mesh, in_specs=(specs,),
+                           out_specs=P(), check_vma=False)
+        self._probe_fn = jax.jit(sm)
+
+    def make_sentinel(self, window: int = 8,
+                      growth_factor: float = 1e6):
+        """A :class:`~stencil_tpu.resilience.health.HealthSentinel`
+        over the FULL live state (rho + every particle SoA lane), with
+        a migration-overflow column riding the probe's one all-reduce
+        (decoded into ``HealthStats.metrics['migration_overflow']``).
+        The probe reduction is a max, so the column reports the WORST
+        per-shard cumulative drop count — zero iff no shard dropped
+        anything (the alerting predicate); the exported
+        ``stencil_run_migration_overflow_total`` counter is the
+        all-shard SUM (:meth:`overflow_total`, host-side)."""
+        from ..resilience.health import HealthSentinel
+        return HealthSentinel(
+            self.dd, window=window, growth_factor=growth_factor,
+            names=self._probe_names,
+            probe_fn=lambda fields, step: self._probe_fn(dict(fields)),
+            extra_names=("migration_overflow",))
+
+    # -- diagnostics ----------------------------------------------------
+    def rho(self) -> np.ndarray:
+        """Global interior charge density (z,y,x) on host."""
+        return self.dd.interior_to_host("rho")
+
+    def total_charge(self) -> float:
+        """Sum of the deposited charge over the global grid."""
+        return float(np.sum(self.rho(), dtype=self._dtype))
+
+    def particles_to_host(self) -> Dict[str, np.ndarray]:
+        """Host copies of the LIVE particles only (invalid slots
+        dropped), plus the per-shard overflow counters under
+        ``'overflow'``."""
+        valid = np.asarray(self.state["valid"])
+        out = {k: np.asarray(self.state[k])[valid]
+               for k in PARTICLE_FIELDS}
+        out["overflow"] = np.asarray(self.state["overflow"])
+        return out
+
+    def overflow_total(self) -> float:
+        """Particles dropped by migration so far (all shards)."""
+        return float(np.sum(np.asarray(self.state["overflow"])))
+
+    def migration_stats(self) -> dict:
+        """The wire-cost identity of this engine's migration step —
+        the same figures the costmodel registry target pins against
+        the lowered HLO, plus the CFL displacement bound."""
+        from ..analysis.costmodel import migration_wire_bytes_per_shard
+        from ..parallel.migrate import migration_record_rows
+        counts = mesh_dim(self.dd.mesh)
+        return {
+            "capacity": self.capacity, "budget": self.budget,
+            "record_bytes": migration_record_rows(len(PARTICLE_FIELDS))
+            * self._dtype.itemsize,
+            "migration_bytes_per_shard": migration_wire_bytes_per_shard(
+                len(PARTICLE_FIELDS), self.budget, counts,
+                self._dtype.itemsize),
+            "max_displacement_per_step": float(self._min_extent),
+        }
+
+    # -- checkpointing / resilience -------------------------------------
+    def _particle_extras(self) -> Dict[str, jnp.ndarray]:
+        return {k: self.state[k] for k in PARTICLE_STATE_KEYS}
+
+    def _install_particles(self, extras: Dict[str, jnp.ndarray]) -> None:
+        for k in PARTICLE_STATE_KEYS:
+            if k not in extras:
+                raise ValueError(f"checkpoint extras missing particle "
+                                 f"lane {k!r}")
+            want = bool if k == "valid" else (
+                np.float32 if k == "overflow" else self._dtype)
+            self.state[k] = jax.device_put(
+                np.asarray(extras[k]).astype(want, copy=False),
+                self._psharding)
+
+    def run_resilient(self, n_steps: int, policy=None,
+                      ckpt_dir: Optional[str] = None, faults=None):
+        """``n_steps`` PIC steps under the checkpoint-rollback driver:
+        the particle lanes ride every checkpoint as extras (like the
+        RK accumulators), the sentinel probes the FULL live state with
+        the overflow column on its one all-reduce, and a recovered run
+        is bitwise-equal to the fault-free one. Exports
+        ``stencil_run_particles_total`` /
+        ``stencil_run_migration_overflow_total``."""
+        from ..resilience.driver import run_resilient
+
+        ovf0 = self.overflow_total()
+
+        def on_restore(extras):
+            # restore_domain already reinstalled rho into dd.curr
+            self.state["rho"] = self.dd.curr["rho"]
+            self._install_particles(extras)
+
+        report = run_resilient(
+            self.dd, self.step, n_steps, policy=policy,
+            ckpt_dir=ckpt_dir, faults=faults,
+            extra_fn=self._particle_extras, on_restore=on_restore,
+            fields_fn=lambda: self.state,
+            sentinel_factory=lambda dd: self.make_sentinel())
+        self._export_run_metrics(report.steps, ovf0)
+        return report
+
+    def _export_run_metrics(self, steps: int, ovf0: float = 0.0) -> None:
+        """Process-registry telemetry (README "Observability"):
+        particle steps advanced and migration-overflow drops."""
+        from ..telemetry import get_registry
+        reg = get_registry()
+        c = reg.counter(
+            "stencil_run_particles_total",
+            "particle steps advanced by PIC run loops (one count per "
+            "particle per step; replayed rollback windows included)")
+        c.inc(max(int(steps), 0) * self.n_particles)
+        o = reg.counter(
+            "stencil_run_migration_overflow_total",
+            "particles dropped by fixed-capacity migration (send "
+            "budget or receive capacity exceeded) — nonzero means the "
+            "capacity/budget plan is undersized for the flux")
+        o.inc(max(self.overflow_total() - ovf0, 0.0))
+
+
+def dense_reference_rho(x, y, z, q, gsize, dtype=np.float64,
+                        deposition: str = "cic") -> np.ndarray:
+    """Single-host dense oracle of one deposition over the periodic
+    global grid — the correctness reference for deposit + reverse
+    halo-accumulate at any sharding."""
+    g = Dim3.of(gsize)
+    rho = np.zeros((g.z, g.y, g.x), dtype=dtype)
+    x = np.asarray(x, dtype=dtype)
+    y = np.asarray(y, dtype=dtype)
+    z = np.asarray(z, dtype=dtype)
+    q = np.asarray(q, dtype=dtype)
+    if deposition == "ngp":
+        cx = np.floor(x + 0.5).astype(int) % g.x
+        cy = np.floor(y + 0.5).astype(int) % g.y
+        cz = np.floor(z + 0.5).astype(int) % g.z
+        np.add.at(rho, (cz, cy, cx), q)
+        return rho
+    i0x, fx = np.floor(x).astype(int), x - np.floor(x)
+    i0y, fy = np.floor(y).astype(int), y - np.floor(y)
+    i0z, fz = np.floor(z).astype(int), z - np.floor(z)
+    for dz in (0, 1):
+        wz = fz if dz else (1.0 - fz)
+        for dy in (0, 1):
+            wy = fy if dy else (1.0 - fy)
+            for dx in (0, 1):
+                wx = fx if dx else (1.0 - fx)
+                np.add.at(rho, ((i0z + dz) % g.z, (i0y + dy) % g.y,
+                                (i0x + dx) % g.x), q * wz * wy * wx)
+    return rho

@@ -21,6 +21,7 @@ PUBLIC_OPS: Dict[str, str] = {
     # XLA-fused stencil ops (footprint-audited against their Radius)
     "ops.stencil_kernels.jacobi7": "ops.stencil_kernels.jacobi7",
     "ops.stencil_kernels.laplacian27": "ops.stencil_kernels.laplacian27",
+    "ops.stencil_kernels.central_diff": "ops.stencil_kernels.central_diff",
     "ops.fd6.der1": "ops.fd6.der1",
     "ops.fd6.der2": "ops.fd6.der2",
     "ops.fd6.der_cross": "ops.fd6.der_cross",

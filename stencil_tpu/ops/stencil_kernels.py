@@ -66,6 +66,23 @@ def laplacian27(padded: jnp.ndarray, radius: Radius, interior: Dim3,
     return out
 
 
+def central_diff(padded: jnp.ndarray, axis: int, radius: Radius,
+                 interior: Dim3) -> jnp.ndarray:
+    """Second-order central difference along grid ``axis`` (0=x, 1=y,
+    2=z): ``(p[i+1] - p[i-1]) / 2`` over the interior — the radius-1
+    gradient component the PIC mini-app's field gather interpolates
+    (``models/pic.py`` computes ``E = -grad rho`` from the deposited
+    charge). ``radius`` is the ALLOCATION radius of ``padded`` (the
+    slices reach one cell past the interior along ``axis`` only)."""
+    lo = radius.pad_lo()
+    plus = [0, 0, 0]
+    plus[axis] = 1
+    minus = [0, 0, 0]
+    minus[axis] = -1
+    return (shifted(padded, tuple(plus), lo, interior)
+            - shifted(padded, tuple(minus), lo, interior)) * 0.5
+
+
 def write_interior(padded: jnp.ndarray, interior_vals: jnp.ndarray,
                    radius: Radius) -> jnp.ndarray:
     """Place interior-shaped values into a padded shard (halos keep

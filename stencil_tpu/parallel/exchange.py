@@ -180,6 +180,76 @@ def exchange_shard(arr: jnp.ndarray, radius: Radius,
     return arr
 
 
+def accumulate_shard(arr: jnp.ndarray, radius: Radius,
+                     mesh_counts: Dim3,
+                     axis_order: Tuple[int, ...] = (2, 1, 0),
+                     rem: Dim3 = Dim3(0, 0, 0),
+                     nonperiodic: bool = False) -> jnp.ndarray:
+    """The ADJOINT of :func:`exchange_shard`: fold halo-pad
+    contributions back into the interiors that own them (scatter-add
+    deposition — a PIC particle near a shard edge deposits charge into
+    this shard's pad cells, which belong to the neighbor's interior).
+
+    Per axis, each pad slab is shipped to the neighboring shard whose
+    interior it overlays and ADDED into that interior's edge rows, then
+    zeroed locally. Axis order is the REVERSE of the exchange sweep
+    (z, y, x by default): a slab spans the full allocation in the other
+    dims, so edge/corner contributions ride into the other axes' pads
+    and are folded by the subsequent sweeps — the transpose of the
+    sequential-sweep corner rule. After all sweeps the pads are zero
+    and every interior cell holds the full periodic sum.
+
+    ``rem``: uneven (+-1) subdomains — a short shard's hi pad sits at
+    its ACTUAL interior end (dynamic position), same placement rule as
+    :func:`exchange_shard`. ``nonperiodic``: contributions crossing the
+    open global boundary are discarded (the zero-Dirichlet exterior
+    absorbs them) instead of wrapping. Must be traced inside
+    ``shard_map``; lowers to the same collective-permute-only bill as
+    the forward exchange (2 ppermutes per active axis), with identical
+    wire bytes — ``exchanged_bytes_per_sweep`` prices both."""
+    for a in axis_order:
+        r_lo = radius.face(a, -1)
+        r_hi = radius.face(a, 1)
+        if r_lo == 0 and r_hi == 0:
+            continue
+        dim = AXIS_TO_DIM[a]
+        name = AXIS_NAME[a]
+        n_dev = mesh_counts[a]
+        alloc = arr.shape[dim]
+        interior = alloc - r_lo - r_hi
+        L = shard_interior_len(a, interior, rem)
+
+        # hi pad [p_lo+L, p_lo+L+r_hi) overlays the +a neighbor's
+        # interior lo rows [p_lo, p_lo+r_hi): ship it +1 and add
+        if r_hi > 0:
+            src = lax.dynamic_slice_in_dim(arr, r_lo + L, r_hi, axis=dim)
+            recv = _shift_from_minus(src, name, n_dev)
+            if nonperiodic:
+                # shard 0 received the wrapped last shard's pad: the
+                # open boundary absorbs it
+                recv = _edge_masked(recv, -1, name, n_dev)
+            cur = lax.slice_in_dim(arr, r_lo, r_lo + r_hi, axis=dim)
+            arr = lax.dynamic_update_slice_in_dim(arr, cur + recv, r_lo,
+                                                  axis=dim)
+            arr = lax.dynamic_update_slice_in_dim(
+                arr, jnp.zeros_like(src), r_lo + L, axis=dim)
+        # lo pad [p_lo-r_lo, p_lo) overlays the -a neighbor's interior
+        # hi rows [p_lo+L-r_lo, p_lo+L): ship it -1 and add
+        if r_lo > 0:
+            src = lax.slice_in_dim(arr, 0, r_lo, axis=dim)
+            recv = _shift_from_plus(src, name, n_dev)
+            if nonperiodic:
+                recv = _edge_masked(recv, 1, name, n_dev)
+            cur = lax.dynamic_slice_in_dim(arr, r_lo + L - r_lo, r_lo,
+                                           axis=dim)
+            arr = lax.dynamic_update_slice_in_dim(arr, cur + recv,
+                                                  r_lo + L - r_lo,
+                                                  axis=dim)
+            arr = lax.dynamic_update_slice_in_dim(
+                arr, jnp.zeros_like(src), 0, axis=dim)
+    return arr
+
+
 def exchange_interior_slabs(p: jnp.ndarray, mesh_counts: Dim3,
                             rz: int, ry: int, radius_rows: int = 0,
                             y_z_extended: bool = False,

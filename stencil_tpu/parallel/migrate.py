@@ -1,0 +1,162 @@
+"""Fixed-capacity particle migration: the second communication pattern.
+
+Every exchange this framework performed until now was the static
+26-direction halo sweep — the payload (which slab goes where) is known
+at trace time. Particle-in-cell codes layered on halo frameworks
+(PIConGPU, arXiv:1606.02862; POLAR-PIC, arXiv:2604.19337) add a
+*dynamic, data-dependent* exchange: which particles cross which shard
+boundary is decided by the physics at runtime. This module implements
+that pattern so it still compiles to ONE static XLA program and lowers
+to collective-permute only (proven by the ``parallel.migrate.*``
+stencil-lint registry targets):
+
+* per-shard particle state is SoA: a dict of same-dtype ``(capacity,)``
+  arrays plus a ``(capacity,)`` validity mask — static shapes, dead
+  slots masked;
+* destinations are per-axis offsets in {-1, 0, +1} (a particle moves at
+  most one shard per step — the standard PIC CFL-style contract);
+  the 26 neighbor directions collapse into THREE sequential axis hops
+  exactly like the halo sweep: a corner-bound particle hops x, then y
+  on the intermediate shard, then z, its remaining offsets riding along
+  in the wire record;
+* per axis-direction, leavers are *sorted to the front* (a stable
+  argsort over the leave mask), *padded to a static ``budget``* of
+  record slots, packed into one ``(rows, budget)`` buffer and moved
+  with ONE ``lax.ppermute`` per direction — at most 6 collectives per
+  migration, mirroring the halo sweep's bill;
+* arrivals are scattered into free slots (stable argsort over the
+  validity mask); leavers beyond ``budget`` and arrivals beyond the
+  free capacity are DROPPED and counted by the in-graph **overflow
+  counter**, which rides the health probe's existing single all-reduce
+  as an extra column (``models/pic.py``) — operators see lost
+  particles without any added collective.
+
+The wire record is ``n_fields + RECORD_EXTRA_ROWS`` rows of the field
+dtype per particle slot (the SoA fields, the three remaining offset
+components, and the validity flag), so modeled migration bytes are
+``2 x active_axes x record_rows x budget x itemsize`` — priced by
+``analysis/costmodel.migration_wire_bytes_per_shard`` and cross-checked
+EXACTLY against the lowered HLO. ``capacity`` and ``budget`` are the
+tuning knobs ``tuning/plan.py`` ranks (wire bytes scale with budget;
+HBM with capacity; overflow risk caps how low either may go).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..geometry import Dim3
+from .exchange import AXIS_NAME, _shift_from_minus, _shift_from_plus
+
+#: wire-record rows beyond the SoA fields: the three (remaining)
+#: destination offset components + the validity flag. The cost model
+#: (analysis/costmodel.migration_record_rows) derives from this — one
+#: constant, no drift.
+RECORD_EXTRA_ROWS = 4
+
+
+def migration_record_rows(n_fields: int) -> int:
+    """Rows of one migration wire record: the SoA fields plus offsets
+    and validity (see :data:`RECORD_EXTRA_ROWS`)."""
+    return int(n_fields) + RECORD_EXTRA_ROWS
+
+
+def migrate_shard(fields: Dict[str, jnp.ndarray], valid: jnp.ndarray,
+                  offsets: Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray],
+                  mesh_counts: Dim3, budget: int,
+                  axis_order: Tuple[int, ...] = (0, 1, 2)
+                  ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray,
+                             jnp.ndarray]:
+    """Migrate one shard's particles to their destination shards.
+
+    ``fields``: SoA particle arrays, all ``(capacity,)`` of ONE common
+    floating dtype. ``valid``: ``(capacity,)`` bool — live slots.
+    ``offsets``: per-axis destination offsets ``(offx, offy, offz)``,
+    integer arrays in {-1, 0, +1} (already computed by the caller from
+    positions vs its shard bounds — periodic wrap is the ring's
+    business, not this function's). ``budget``: static record slots per
+    axis-direction message.
+
+    Returns ``(fields, valid, overflow)`` where ``overflow`` is the
+    f32 count of particles DROPPED this migration (send budget
+    exceeded, or no free capacity slot on arrival). Must be traced
+    inside ``shard_map``; one ppermute per direction per active axis.
+    """
+    names = sorted(fields)  # both endpoints agree on the record layout
+    if not names:
+        raise ValueError("migrate_shard needs at least one field")
+    dt = fields[names[0]].dtype
+    for q in names:
+        if fields[q].dtype != dt:
+            raise ValueError(
+                f"migrate_shard fields must share one dtype: "
+                f"{q!r} is {fields[q].dtype}, expected {dt}")
+    capacity = fields[names[0]].shape[0]
+    budget = int(budget)
+    if budget < 1:
+        raise ValueError(f"budget must be >= 1, got {budget}")
+
+    work = {q: fields[q] for q in names}
+    # offsets ride as working rows so an arrival's REMAINING hops
+    # survive the intermediate shard (corner traffic hops per axis)
+    offs = [jnp.asarray(o).astype(dt) for o in offsets]
+    valid = jnp.asarray(valid).astype(bool)
+    overflow = jnp.zeros((), jnp.float32)
+
+    for a in axis_order:
+        n_dev = mesh_counts[a]
+        name = AXIS_NAME[a]
+        off_a = offs[a]
+        incoming = []
+        leaving = jnp.zeros_like(valid)
+        for side in (1, -1):
+            leave = valid & (off_a == jnp.asarray(side, dt))
+            leaving = leaving | leave
+            # stable sort: leavers first, then pad to the static budget
+            order = jnp.argsort(jnp.where(leave, 0, 1))
+            idx = order[:budget]
+            sent = leave[idx]
+            overflow = overflow + jnp.maximum(
+                jnp.sum(leave) - budget, 0).astype(jnp.float32)
+            rows = [work[q][idx] for q in names]
+            # the record's offset rows: this axis is CONSUMED by the
+            # hop (arrivals are home along it); the others ride on
+            rows += [jnp.zeros_like(offs[b][idx]) if b == a
+                     else offs[b][idx] for b in range(3)]
+            rows.append(sent.astype(dt))
+            buf = jnp.stack(rows)  # (record_rows, budget)
+            moved = (_shift_from_minus(buf, name, n_dev) if side == 1
+                     else _shift_from_plus(buf, name, n_dev))
+            incoming.append(moved)
+        # leavers are gone (budget-overflowed ones are LOST + counted)
+        valid = valid & ~leaving
+        # merge both directions' arrivals into free slots
+        buf = jnp.concatenate(incoming, axis=1)  # (rows, 2*budget)
+        inc_fields = {q: buf[i] for i, q in enumerate(names)}
+        nf = len(names)
+        inc_offs = [buf[nf + b] for b in range(3)]
+        inc_valid = buf[nf + 3] > jnp.asarray(0.5, dt)
+        free_order = jnp.argsort(valid)  # invalid slots first, stable
+        free_count = capacity - jnp.sum(valid)
+        rank = jnp.cumsum(inc_valid) - 1
+        ok = inc_valid & (rank < free_count)
+        slot = jnp.where(
+            ok, free_order[jnp.clip(rank, 0, capacity - 1)], capacity)
+        overflow = overflow + (jnp.sum(inc_valid)
+                               - jnp.sum(ok)).astype(jnp.float32)
+        for q in names:
+            work[q] = work[q].at[slot].set(inc_fields[q], mode="drop")
+        for b in range(3):
+            offs[b] = offs[b].at[slot].set(inc_offs[b], mode="drop")
+        valid = valid.at[slot].set(True, mode="drop")
+    return work, valid, overflow
+
+
+def migration_messages(mesh_counts: Dim3,
+                       axis_order: Sequence[int] = (0, 1, 2)) -> int:
+    """Collective-permute launches one migration performs: 2 per mesh
+    axis that actually crosses devices (1-device axes degenerate to
+    local self-copies — no collective in the lowering)."""
+    return sum(2 for a in axis_order if mesh_counts[a] > 1)

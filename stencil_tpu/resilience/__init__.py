@@ -16,7 +16,8 @@ Three cooperating parts:
 from .driver import (ResilienceError, ResiliencePolicy, ResilienceReport,
                      StepConfig, degradation_ladder, run_resilient)
 from .faults import (CheckpointCorruption, FaultPlan, HaloCorruption,
-                     NaNInjection, Preemption, TransientSaveFailure)
+                     NaNInjection, ParticleLoss, Preemption,
+                     TransientSaveFailure)
 from .health import HealthSentinel, HealthStats, make_probe, probe_shard
 
 __all__ = [
@@ -26,6 +27,7 @@ __all__ = [
     "HealthSentinel",
     "HealthStats",
     "NaNInjection",
+    "ParticleLoss",
     "Preemption",
     "ResilienceError",
     "ResiliencePolicy",

@@ -172,7 +172,8 @@ class _ResilientRun:
 
     def __init__(self, dd, step_fn, n_steps, policy, ckpt_dir, faults,
                  rebuild, extra_fn, on_restore, fields_fn,
-                 pre_checkpoint, make_segment=None):
+                 pre_checkpoint, make_segment=None,
+                 sentinel_factory=None):
         self.dd = dd
         self.step_fn = step_fn
         self.n_steps = int(n_steps)
@@ -200,6 +201,11 @@ class _ResilientRun:
             SingleCompileGuard()
             if os.environ.get(ASSERT_SINGLE_COMPILE_ENV) == "1"
             else None)
+        #: custom sentinel builder (models whose health state is wider
+        #: than dd.curr — e.g. the PIC particle lanes with the in-graph
+        #: overflow column — supply one; step-metrics riding/rebasing
+        #: is then the factory's business, not the driver's)
+        self.sentinel_factory = sentinel_factory
         self.report = ResilienceReport()
         if faults is not None:
             faults.bind(self.report.log)
@@ -265,6 +271,9 @@ class _ResilientRun:
         rebase derives from (the finalize-after-restore path must
         rebase from the PRE-degrade block, not compound the
         provisional rebase)."""
+        if self.sentinel_factory is not None:
+            self._step_metrics = None
+            return self.sentinel_factory(dd)
         from ..telemetry.probe import step_metrics_for
         if prev is None:
             prev = getattr(self, "_step_metrics", None)
@@ -728,7 +737,8 @@ def run_resilient(dd, step_fn: Callable[[], None], n_steps: int,
                   on_restore: Optional[Callable[[Dict], None]] = None,
                   fields_fn: Optional[Callable[[], Dict]] = None,
                   pre_checkpoint: Optional[Callable[[], None]] = None,
-                  make_segment: Optional[Callable] = None
+                  make_segment: Optional[Callable] = None,
+                  sentinel_factory: Optional[Callable] = None
                   ) -> ResilienceReport:
     """Drive ``step_fn`` for ``n_steps`` steps with health sentinels,
     periodic integrity-checked checkpoints, rollback-retry recovery,
@@ -756,9 +766,17 @@ def run_resilient(dd, step_fn: Callable[[], None], n_steps: int,
     return ``(dd, step_fn, make_segment)`` so a degradation rebuilds
     the fused segment too (a 2-tuple falls back to stepwise).
 
+    ``sentinel_factory(dd)``: build the health sentinel instead of the
+    driver's default ``HealthSentinel(dd, ...)`` — models whose live
+    state is wider than the domain's registered fields (PIC probes the
+    particle lanes and carries the in-graph migration-overflow column)
+    supply one; telemetry step-metrics riding is then the factory's
+    responsibility.
+
     Returns a :class:`ResilienceReport`; if it says ``preempted``,
     rerun with the same ``ckpt_dir`` to resume. If a run was previously
     preempted mid-campaign, the same call resumes it automatically."""
     return _ResilientRun(dd, step_fn, n_steps, policy, ckpt_dir, faults,
                          rebuild, extra_fn, on_restore, fields_fn,
-                         pre_checkpoint, make_segment=make_segment).run()
+                         pre_checkpoint, make_segment=make_segment,
+                         sentinel_factory=sentinel_factory).run()

@@ -14,6 +14,9 @@ Fault classes (all dataclasses on a :class:`FaultPlan`):
 * :class:`HaloCorruption` — poison a halo (pad) cell post-step (a
   poisoned exchange; the sentinel probes padded fields exactly so this
   is caught even though the next exchange would overwrite it).
+* :class:`ParticleLoss` — corrupt live particle records of a chosen
+  shard (NaN a SoA lane; the PIC analog of lost particle memory —
+  recovery restores the particle checkpoint extras).
 * :class:`TransientSaveFailure` — the next orbax save raises
   ``IOError`` for the first N attempts (an NFS blip mid-checkpoint).
 * :class:`CheckpointCorruption` — after checkpoint ``step`` lands on
@@ -102,6 +105,62 @@ class HaloCorruption:
 
 
 @dataclasses.dataclass
+class ParticleLoss:
+    """Corrupt ``count`` live particle records of shard ``shard``
+    after step ``step`` (the PIC analog of a lost/rotted memory lane):
+    the ``quantity`` lane of the chosen slots is set to NaN. Detection
+    is guaranteed two ways on the next probe — the lane itself is
+    probed non-finite by the PIC sentinel, and the next deposition
+    scatters the NaN charge into ``rho``. Recovery must restore the
+    particle lanes from the checkpoint extras and end bitwise-equal to
+    the fault-free run.
+
+    The live field dict must carry the particle SoA lanes (the PIC
+    model's ``fields_fn`` contract) and the domain must expose
+    ``particle_capacity`` (``models/pic.py`` stamps it) so the shard's
+    slot block can be located under the ``P(('z','y','x'))`` layout."""
+
+    step: int
+    count: int = 1
+    shard: Tuple[int, int, int] = (0, 0, 0)
+    quantity: str = "q"
+    repeat: int = 1
+    fired: int = 0
+
+    def due(self, step: int) -> bool:
+        return step == self.step and self.fired < self.repeat
+
+    def fire(self, dd, log: LogFn, fields=None) -> None:
+        import numpy as np
+        self.fired += 1
+        cap = getattr(dd, "particle_capacity", None)
+        if fields is None or cap is None or self.quantity not in fields:
+            LOG_WARN("ParticleLoss: no particle state on this domain "
+                     "(particle_capacity / particle lanes absent); "
+                     "fault is a no-op")
+            return
+        bx, by, bz = self.shard
+        dim = dd.placement.dim()
+        base = ((bz * dim.y + by) * dim.x + bx) * cap
+        valid = fields.get("valid")
+        if valid is not None:
+            live = np.nonzero(np.asarray(valid)[base:base + cap])[0]
+            slots = [int(base + s) for s in live[:self.count]]
+        else:
+            slots = [int(base + s) for s in range(self.count)]
+        if not slots:
+            LOG_WARN(f"ParticleLoss: shard {self.shard} holds no live "
+                     f"particles at step {self.step}; fault is a no-op")
+            return
+        arr = fields[self.quantity]
+        for s in slots:
+            arr = arr.at[s].set(float("nan"))
+        fields[self.quantity] = arr
+        log("fault_particle_loss", step=self.step, quantity=self.quantity,
+            shard=list(self.shard), slots=slots)
+
+
+@dataclasses.dataclass
 class TransientSaveFailure:
     """The checkpoint save at step ``step`` raises ``IOError`` for its
     first ``failures`` attempts, then succeeds (exercises the retry/
@@ -182,6 +241,8 @@ class FaultPlan:
 
     nans: List[NaNInjection] = dataclasses.field(default_factory=list)
     halos: List[HaloCorruption] = dataclasses.field(default_factory=list)
+    particle_losses: List[ParticleLoss] = \
+        dataclasses.field(default_factory=list)
     save_failures: List[TransientSaveFailure] = \
         dataclasses.field(default_factory=list)
     ckpt_corruptions: List[CheckpointCorruption] = \
@@ -217,6 +278,10 @@ class FaultPlan:
             if ev.due(step):
                 ev.fire(dd, self._log, fields)
                 mutated = True
+        for ev in self.particle_losses:
+            if ev.due(step):
+                ev.fire(dd, self._log, fields)
+                mutated = True
         for ev in self.preemptions:
             if ev.due(step):
                 ev.fire(self._log)
@@ -229,7 +294,8 @@ class FaultPlan:
         dispatches exactly where the stepwise loop would fire it.
         None when no such fault remains."""
         cands = [ev.step
-                 for ev in (*self.nans, *self.halos, *self.preemptions)
+                 for ev in (*self.nans, *self.halos,
+                            *self.particle_losses, *self.preemptions)
                  if ev.step > after
                  and ev.fired < getattr(ev, "repeat", 1)]
         return min(cands) if cands else None

@@ -158,8 +158,10 @@ class HealthSentinel:
     """
 
     def __init__(self, dd, window: int = 8,
-                 growth_factor: float = 1e6, metrics=None) -> None:
-        self.names = list(dd._names)
+                 growth_factor: float = 1e6, metrics=None,
+                 probe_fn=None, names: Optional[Sequence[str]] = None,
+                 extra_names: Optional[Sequence[str]] = None) -> None:
+        self.names = list(names) if names is not None else list(dd._names)
         self.window = int(window)
         self.growth_factor = float(growth_factor)
         #: telemetry step-metrics provider (``.names`` +
@@ -167,10 +169,25 @@ class HealthSentinel:
         #: telemetry.probe.StepMetrics` — its counters ride the probe's
         #: one all-reduce (no extra collectives)
         self._metrics = metrics
-        self._probe_fn = make_probe(
-            dd.mesh, self.names,
-            extra_names=tuple(metrics.names) if metrics is not None
-            else ())
+        #: custom probe program ``probe_fn(fields, step) -> (2, n+k)``
+        #: (models with non-field state — e.g. the PIC particle lanes
+        #: and their IN-GRAPH migration-overflow column — supply their
+        #: own; ``extra_names`` labels the k trailing columns, which
+        #: :meth:`poll` decodes into ``HealthStats.metrics`` exactly
+        #: like telemetry step metrics)
+        self._custom_probe = probe_fn
+        if probe_fn is not None:
+            if metrics is not None:
+                raise ValueError("pass either metrics= (host-side "
+                                 "columns) or probe_fn= (in-graph "
+                                 "columns), not both")
+            self._probe_fn = probe_fn
+            self._extra_names = tuple(extra_names or ())
+        else:
+            self._extra_names = (tuple(metrics.names)
+                                 if metrics is not None else ())
+            self._probe_fn = make_probe(dd.mesh, self.names,
+                                        extra_names=self._extra_names)
         self._pending: Deque[Tuple[int, jnp.ndarray]] = deque()
         self._history: Dict[str, Deque[float]] = {
             q: deque(maxlen=self.window) for q in self.names}
@@ -180,6 +197,10 @@ class HealthSentinel:
     def probe(self, fields: Dict[str, jnp.ndarray], step: int) -> None:
         """Enqueue one health probe of ``fields`` at ``step`` (does not
         block; the reduction rides the device queue)."""
+        if self._custom_probe is not None:
+            self._pending.append(
+                (step, self._custom_probe(dict(fields), step)))
+            return
         if self._metrics is not None:
             self._pending.append(
                 (step, self._probe_fn(dict(fields),
@@ -245,10 +266,10 @@ class HealthSentinel:
         max_abs = {q: float(host[ROW_MAX_ABS, i])
                    for i, q in enumerate(self.names)}
         stats = HealthStats(step, nonfinite, max_abs)
-        if self._metrics is not None:
+        if self._extra_names:
             n = len(self.names)
             stats.metrics = {m: float(host[ROW_NONFINITE, n + i])
-                             for i, m in enumerate(self._metrics.names)}
+                             for i, m in enumerate(self._extra_names)}
         bad_nf = [q for q, n in nonfinite.items() if n > 0]
         if bad_nf:
             stats.tripped = True

@@ -46,13 +46,17 @@ from ..utils.logging import LOG_INFO
 from .cache import default_cache_path, load_plan, store_plan
 from .fit import calibrate_link, coefficients_record, fit_alpha_beta
 from .measure import CountingTimer, FakeTimer, MeshTimer
-from .plan import (DEFAULT_DEPTHS, Candidate, Plan, TuneGeometry,
-                   candidate_space, fingerprint, fingerprint_inputs)
+from .plan import (DEFAULT_DEPTHS, Candidate, MigrationCandidate, Plan,
+                   TuneGeometry, candidate_space,
+                   migration_candidate_space, fingerprint,
+                   fingerprint_inputs, rank_migration_candidates)
 
 __all__ = [
-    "Candidate", "Plan", "TuneGeometry", "FakeTimer", "MeshTimer",
+    "Candidate", "MigrationCandidate", "Plan", "TuneGeometry",
+    "FakeTimer", "MeshTimer",
     "CountingTimer", "LinkCoefficients", "autotune_domain",
-    "run_autotune", "candidate_space", "calibrate_link",
+    "run_autotune", "candidate_space", "migration_candidate_space",
+    "rank_migration_candidates", "calibrate_link",
     "fit_alpha_beta", "fingerprint", "fingerprint_inputs",
     "default_cache_path", "load_plan", "store_plan", "DEFAULT_DEPTHS",
 ]

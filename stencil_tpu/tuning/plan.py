@@ -142,6 +142,106 @@ def candidate_space(geom: TuneGeometry,
 
 
 # ---------------------------------------------------------------------------
+# particle-migration candidates (the PIC workload's tuning axis)
+
+
+@dataclasses.dataclass(frozen=True)
+class MigrationCandidate:
+    """One point of the particle-migration configuration space: the
+    per-shard SoA ``capacity`` (HBM cost, receive headroom) and the
+    per-direction wire ``budget`` (the static message size — the whole
+    wire bill of the dynamic exchange, ``analysis/costmodel.
+    migration_wire_bytes_per_shard``)."""
+
+    capacity: int
+    budget: int
+
+    def key(self) -> str:
+        return f"migrate[cap={self.capacity},budget={self.budget}]"
+
+
+def migration_candidate_space(particles_per_shard: int,
+                              capacities: Optional[Sequence[int]] = None,
+                              budgets: Optional[Sequence[int]] = None
+                              ) -> List[MigrationCandidate]:
+    """The (capacity, budget) grid the migration tuner ranks. Defaults
+    sweep power-of-two headrooms over the mean fill (capacity 1.25x-4x
+    the per-shard particle count; budgets from capacity/32 up to
+    capacity) — a candidate must at minimum hold the uniform fill."""
+    n = max(int(particles_per_shard), 1)
+    if capacities is None:
+        capacities = sorted({max(8, int(n * f))
+                             for f in (1.25, 1.5, 2.0, 4.0)})
+    out: List[MigrationCandidate] = []
+    for cap in capacities:
+        if cap < n:
+            continue
+        bs = (budgets if budgets is not None
+              else sorted({max(1, cap // d) for d in (32, 16, 8, 4, 2, 1)}))
+        for b in bs:
+            if 1 <= b <= cap:
+                out.append(MigrationCandidate(int(cap), int(b)))
+    return out
+
+
+def migration_candidate_feasible(cand: MigrationCandidate,
+                                 particles_per_shard: int,
+                                 max_crossing_fraction: float,
+                                 headroom: float = 1.5) -> bool:
+    """Overflow-safety gate: the budget must hold the worst expected
+    per-direction flux (``particles_per_shard x max_crossing_fraction``,
+    padded by ``headroom`` for clumping) and the capacity must carry
+    the uniform fill with the same ``headroom`` factor of slack for
+    arrival imbalance — an overflowing plan DROPS particles (the
+    in-graph counter reports it), so the tuner never ranks one, no
+    matter how cheap its wire bill."""
+    n = max(int(particles_per_shard), 1)
+    need_budget = int(n * float(max_crossing_fraction)
+                      * float(headroom)) + 1
+    if cand.budget < need_budget:
+        return False
+    if cand.capacity < int(n * float(headroom)):
+        return False
+    return True
+
+
+def rank_migration_candidates(particles_per_shard: int, n_fields: int,
+                              counts, elem_size: int,
+                              max_crossing_fraction: float = 0.25,
+                              coeffs=None,
+                              candidates: Optional[
+                                  Sequence[MigrationCandidate]] = None,
+                              headroom: float = 1.5
+                              ) -> List[Tuple[float, MigrationCandidate]]:
+    """Rank feasible migration configurations by the calibrated
+    alpha-beta wire cost per step (``analysis/costmodel.
+    migration_step_seconds``), cheapest first; capacity breaks ties
+    (smaller = less HBM). The winner is the smallest overflow-safe
+    budget — wire bytes scale linearly with the budget, so safety, not
+    speed, is the binding constraint. Raises when nothing is feasible
+    (the flux outruns every candidate: shrink dt or grow capacity)."""
+    cands = (list(candidates) if candidates is not None
+             else migration_candidate_space(particles_per_shard))
+    from ..analysis.costmodel import migration_step_seconds
+
+    ranked: List[Tuple[float, MigrationCandidate]] = []
+    for c in cands:
+        if not migration_candidate_feasible(
+                c, particles_per_shard, max_crossing_fraction, headroom):
+            continue
+        ranked.append((migration_step_seconds(
+            n_fields, c.budget, counts, elem_size, coeffs), c))
+    if not ranked:
+        raise ValueError(
+            f"no feasible migration candidate for "
+            f"{particles_per_shard} particles/shard at crossing "
+            f"fraction {max_crossing_fraction} (budgets too small "
+            f"everywhere — raise capacity or lower the flux)")
+    ranked.sort(key=lambda t: (t[0], t[1].capacity, t[1].budget))
+    return ranked
+
+
+# ---------------------------------------------------------------------------
 # fingerprint
 
 

@@ -404,9 +404,14 @@ def _restore_step_arrays(dd, mgr, step: int
             ishape, cur.dtype, sharding=repl if uneven else cur.sharding)
     cur0 = dd.curr[dd._names[0]]
     for k, desc in (saved_meta.get("extra") or {}).items():
+        shape = tuple(desc["shape"])
+        # field-shaped extras (the RK accumulators) restore onto the
+        # field sharding; anything else (the PIC particle lanes are 1D
+        # SoA arrays) restores REPLICATED and the owner re-shards — a
+        # 3D PartitionSpec cannot shard a 1D array
+        sh = cur0.sharding if len(shape) == cur0.ndim else repl
         targets[f"extra:{k}"] = jax.ShapeDtypeStruct(
-            tuple(desc["shape"]), jnp.dtype(desc["dtype"]),
-            sharding=cur0.sharding)
+            shape, jnp.dtype(desc["dtype"]), sharding=sh)
     try:
         # the meta record was already read by the probe above — only
         # the state item rides this bulk restore

@@ -1,0 +1,74 @@
+"""Negative control for the particle-migration communication contract.
+
+The fixed-capacity migration's license to ride the hot loop is its
+collective bill: one ``ppermute`` per direction per active axis,
+moving exactly ``record_rows x budget`` elements — pinned by the
+``parallel.migrate.migrate_shard[hlo]`` registry target. This fixture
+is the tempting shortcut that breaks it: instead of ring-shifting each
+direction's outbox to its one receiver, every shard ``all_gather``s
+every outbox and picks its neighbor's rows locally — functionally
+identical results, but the wire now carries every shard's outbox to
+every device (the reference library's bench_alltoallv anti-pattern).
+Sold under the shipped ppermute-only contract, the hlo checker must
+flag it: ``python -m stencil_tpu.analysis
+tests/fixtures/lint/bad_migration.py`` MUST exit nonzero.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from stencil_tpu.analysis import HloSpec, HloTarget
+
+_BUDGET = 4
+_CAP = 16
+
+
+def _f32(shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _allgather_migrate_spec() -> HloSpec:
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("z", "y", "x"))
+
+    def shard(q, valid, offx):
+        # the bug: gather EVERY shard's +x outbox onto every device and
+        # slice out the -1 neighbor's, instead of one ring ppermute
+        name = "x"
+        n = 2  # mesh axis size (static, like the shipped engine's)
+        leave = valid & (offx == 1)
+        order = jnp.argsort(jnp.where(leave, 0, 1))
+        idx = order[:_BUDGET]
+        buf = jnp.stack([q[idx], leave[idx].astype(q.dtype)])
+        gath = lax.all_gather(buf, name, axis=0)  # (n, rows, budget)
+        i = lax.axis_index(name)
+        recv = gath[(i - 1) % n]
+        inc_q = recv[0]
+        inc_valid = recv[1] > 0.5
+        valid = valid & ~leave
+        free = jnp.argsort(valid)
+        rank = jnp.cumsum(inc_valid) - 1
+        ok = inc_valid & (rank < (_CAP - jnp.sum(valid)))
+        slot = jnp.where(ok, free[jnp.clip(rank, 0, _CAP - 1)], _CAP)
+        q = q.at[slot].set(inc_q, mode="drop")
+        valid = valid.at[slot].set(True, mode="drop")
+        return q, valid
+
+    spec = P(("z", "y", "x"))
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=(spec, spec), check_vma=False)
+    n = 8 * _CAP
+    valid = jax.ShapeDtypeStruct((n,), jnp.bool_)
+    off = jax.ShapeDtypeStruct((n,), jnp.int32)
+    # the shipped contract: migration lowers to collective-permute only
+    return HloSpec(fn=sm, args=(_f32((n,)), valid, off),
+                   allow=("collective_permute",))
+
+
+TARGETS = [
+    HloTarget("bad_migration.allgather_outbox[hlo]",
+              _allgather_migrate_spec),
+]

@@ -57,7 +57,7 @@ def test_checker_filter():
     report = run_targets(default_targets(), checkers=["collectives"])
     assert report.ok
     assert all(t.startswith(("parallel.exchange", "parallel.temporal",
-                             "serving.ensemble"))
+                             "parallel.migrate", "serving.ensemble"))
                for t in report.targets_checked)
     with pytest.raises(ValueError):
         run_targets([], checkers=["nope"])
@@ -103,6 +103,7 @@ def test_hlo_registry_collective_permute_only():
             assert kinds == {"all_gather"}, (key, kinds)
         elif ("resilience.health" in key
               or "serving.ensemble.probe" in key
+              or "models.pic.probe" in key
               or "telemetry." in key
               or "parallel.megastep" in key):
             # the health sentinels' contract is different by design:
@@ -455,7 +456,8 @@ def test_cli_only_accepts_target_globs(tmp_path):
                                      "bad_megastep.py",
                                      "bad_donation.py",
                                      "bad_transfer.py",
-                                     "bad_recompile.py"])
+                                     "bad_recompile.py",
+                                     "bad_migration.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""
@@ -463,7 +465,7 @@ def test_cli_nonzero_on_every_fixture(fixture):
 
     if fixture in ("bad_hlo.py", "bad_plan.py", "bad_probe.py",
                    "bad_probe_metrics.py", "bad_megastep.py",
-                   "bad_donation.py"):
+                   "bad_donation.py", "bad_migration.py"):
         from stencil_tpu.analysis.hlo import lowering_supported
 
         if not lowering_supported():

@@ -1,0 +1,541 @@
+"""Particle-in-cell workload: fixed-capacity migration + the PIC step.
+
+The dynamic-communication test base (ROADMAP item 5): the migration
+ring's routing/overflow semantics, the deposition adjoint against a
+dense oracle, bitwise charge conservation across migrations AND shard
+counts (uneven +-1 partitions included), ParticleLoss recovery proven
+bitwise through the resilience driver, particle checkpoint lanes
+through the hardened checkpoint layer (corrupt walk-back included),
+the in-graph overflow column on the sentinel's one all-reduce, the
+migration registry gates, and the capacity/budget tuner ranking.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from stencil_tpu.geometry import Dim3
+from stencil_tpu.models.pic import (PARTICLE_FIELDS, Pic,
+                                    dense_reference_rho)
+from stencil_tpu.parallel.migrate import (migrate_shard,
+                                          migration_messages,
+                                          migration_record_rows)
+from stencil_tpu.parallel.mesh import make_mesh
+
+
+MESH222 = (2, 2, 2)
+
+
+def _pic(gx=8, gy=8, gz=8, n=40, **kw):
+    kw.setdefault("mesh_shape", MESH222)
+    kw.setdefault("dtype", np.float64)
+    kw.setdefault("dt", 0.25)
+    return Pic(gx, gy, gz, n, **kw)
+
+
+def _uniform_ics(rng, g, n, charges=None):
+    return {
+        "x": rng.uniform(0, g[0], n), "y": rng.uniform(0, g[1], n),
+        "z": rng.uniform(0, g[2], n),
+        "vx": np.zeros(n), "vy": np.zeros(n), "vz": np.zeros(n),
+        "q": np.ones(n) if charges is None else charges,
+    }
+
+
+def _sorted_particles(p):
+    h = p.particles_to_host()
+    order = np.lexsort((h["z"], h["y"], h["x"], h["q"]))
+    return {k: h[k][order] for k in PARTICLE_FIELDS}
+
+
+# ----------------------------------------------------------------------
+# the migration ring
+# ----------------------------------------------------------------------
+def _run_migrate(q_vals, valid, offs, cap=8, budget=4):
+    mesh = make_mesh(MESH222, jax.devices()[:8])
+    counts = Dim3(*MESH222)
+    spec = P(("z", "y", "x"))
+    psh = NamedSharding(mesh, spec)
+
+    def shard(fields, v, ox, oy, oz):
+        f, vv, ovf = migrate_shard(fields, v, (ox, oy, oz), counts,
+                                   budget)
+        return f, vv, ovf.reshape(1)
+
+    sm = jax.jit(jax.shard_map(
+        shard, mesh=mesh, in_specs=({"q": spec}, spec, spec, spec, spec),
+        out_specs=({"q": spec}, spec, spec), check_vma=False))
+    dev = lambda a: jax.device_put(a, psh)  # noqa: E731
+    f, vv, ovf = sm({"q": dev(q_vals)}, dev(valid),
+                    *(dev(o) for o in offs))
+    return np.asarray(f["q"]), np.asarray(vv), np.asarray(ovf), cap
+
+
+def _blocks(q, valid, cap):
+    out = {}
+    for b in range(8):
+        sel = valid[b * cap:(b + 1) * cap]
+        vals = q[b * cap:(b + 1) * cap][sel]
+        if len(vals):
+            out[b] = sorted(vals.tolist())
+    return out
+
+
+def test_migrate_face_edge_corner_routing():
+    """A stayer, a +x face hop, and a (+x,+y,+z) corner hop (three
+    sequential ring hops) all land on the owning shard, payload
+    bitwise-intact, zero overflow."""
+    cap = 8
+    q = np.zeros(8 * cap)
+    valid = np.zeros(8 * cap, bool)
+    ox = np.zeros(8 * cap, np.int32)
+    oy = np.zeros(8 * cap, np.int32)
+    oz = np.zeros(8 * cap, np.int32)
+    valid[0:3] = True
+    q[0:3] = [10.0, 11.0, 12.0]
+    ox[1] = 1
+    ox[2] = oy[2] = oz[2] = 1
+    qq, vv, ovf, cap = _run_migrate(q, valid, (ox, oy, oz), cap=cap)
+    assert ovf.sum() == 0
+    # P(('z','y','x')) block order: shard (x=1,y=0,z=0) -> block 1,
+    # shard (1,1,1) -> block 7
+    assert _blocks(qq, vv, cap) == {0: [10.0], 1: [11.0], 7: [12.0]}
+
+
+def test_migrate_periodic_wrap():
+    """-x from shard 0 wraps the ring onto the last x shard."""
+    cap = 8
+    q = np.zeros(8 * cap)
+    valid = np.zeros(8 * cap, bool)
+    valid[0] = True
+    q[0] = 5.0
+    ox = np.zeros(8 * cap, np.int32)
+    ox[0] = -1
+    zero = np.zeros(8 * cap, np.int32)
+    qq, vv, ovf, cap = _run_migrate(q, valid, (ox, zero, zero), cap=cap)
+    assert ovf.sum() == 0
+    assert _blocks(qq, vv, cap) == {1: [5.0]}
+
+
+def test_migrate_send_budget_overflow_counts_and_drops():
+    """Leavers beyond the per-direction budget are dropped and counted
+    — never silently retained on the wrong shard."""
+    cap = 8
+    q = np.zeros(8 * cap)
+    valid = np.zeros(8 * cap, bool)
+    valid[0:6] = True
+    q[0:6] = np.arange(1.0, 7.0)
+    ox = np.zeros(8 * cap, np.int32)
+    ox[0:6] = 1
+    zero = np.zeros(8 * cap, np.int32)
+    qq, vv, ovf, cap = _run_migrate(q, valid, (ox, zero, zero),
+                                    cap=cap, budget=4)
+    assert ovf.sum() == 2
+    assert vv[:cap].sum() == 0          # every leaver left block 0
+    assert vv[cap:2 * cap].sum() == 4   # only budget-many arrived
+
+
+def test_migrate_receive_capacity_overflow():
+    """Arrivals beyond the receiver's free slots are dropped and
+    counted."""
+    cap = 4
+    q = np.zeros(8 * cap)
+    valid = np.zeros(8 * cap, bool)
+    # block 1 (shard x=1) is FULL; block 0 sends it 2 particles
+    valid[cap:2 * cap] = True
+    q[cap:2 * cap] = 100.0
+    valid[0:2] = True
+    q[0:2] = [1.0, 2.0]
+    ox = np.zeros(8 * cap, np.int32)
+    ox[0:2] = 1
+    zero = np.zeros(8 * cap, np.int32)
+    qq, vv, ovf, _ = _run_migrate(q, valid, (ox, zero, zero),
+                                  cap=cap, budget=4)
+    assert ovf.sum() == 2               # both arrivals dropped
+    assert vv[cap:2 * cap].sum() == cap  # receiver unchanged
+
+
+def test_migration_messages_and_record_rows():
+    assert migration_messages(Dim3(2, 2, 2)) == 6
+    assert migration_messages(Dim3(1, 2, 1)) == 2
+    assert migration_messages(Dim3(1, 1, 1)) == 0
+    assert migration_record_rows(7) == 11
+
+
+# ----------------------------------------------------------------------
+# deposition + reverse halo-accumulate
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("dep", ["ngp", "cic"])
+@pytest.mark.parametrize("grid", [(8, 8, 8), (9, 9, 9)])
+def test_deposit_accumulate_matches_dense_oracle(dep, grid):
+    """deposit + reverse accumulate + exchange over the sharded
+    (even AND uneven +-1) mesh reproduces the dense periodic oracle —
+    NGP bitwise, CIC to roundoff (scatter order differs)."""
+    rng = np.random.default_rng(1)
+    n = 40
+    ics = _uniform_ics(rng, grid, n)
+    p = _pic(*grid, n=n, deposition=dep)
+    p.set_particles(ics)
+    p.step()
+    oracle = dense_reference_rho(ics["x"], ics["y"], ics["z"], ics["q"],
+                                 grid, deposition=dep)
+    if dep == "ngp":
+        assert np.array_equal(p.rho(), oracle)
+    else:
+        np.testing.assert_allclose(p.rho(), oracle, rtol=0, atol=1e-12)
+    assert p.overflow_total() == 0
+
+
+# ----------------------------------------------------------------------
+# charge conservation (the satellite property test)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("grid", [(8, 8, 8), (9, 9, 9)])
+def test_total_charge_bitwise_across_migrations_and_meshes(grid):
+    """Total deposited charge is BITWISE-preserved across migrations
+    and shard counts, including uneven +-1 partitions: NGP deposits of
+    unit charges are exact integer sums in f64, so every step's grid
+    total equals the particle count exactly on ANY mesh."""
+    rng = np.random.default_rng(3)
+    n = 48
+    ics = _uniform_ics(rng, grid, n)
+    totals = {}
+    for ms, nd in (((1, 1, 1), 1), (MESH222, 8)):
+        p = _pic(*grid, n=n, mesh_shape=ms, deposition="ngp",
+                 devices=jax.devices()[:nd])
+        p.set_particles(ics)
+        seq = []
+        for _ in range(5):
+            p.step()
+            seq.append(p.total_charge())
+        assert p.overflow_total() == 0
+        totals[ms] = seq
+    assert totals[(1, 1, 1)] == totals[MESH222]
+    assert all(t == float(n) for t in totals[MESH222])
+
+
+def test_trajectory_and_rho_bitwise_across_meshes_cic():
+    """With dyadic ICs (1/8-lattice positions, integer charges, dyadic
+    dt) the CIC arithmetic is exact, so particles AND the deposited
+    rho are bitwise-identical between the 1-device and 8-device runs
+    after multiple push+migrate steps."""
+    rng = np.random.default_rng(5)
+    n = 16
+    lat = rng.integers(0, 64, size=(3, n)) / 8.0
+    ics = {"x": lat[0], "y": lat[1], "z": lat[2],
+           "vx": np.zeros(n), "vy": np.zeros(n), "vz": np.zeros(n),
+           "q": np.arange(1.0, n + 1.0)}
+    res = {}
+    for ms, nd in (((1, 1, 1), 1), (MESH222, 8)):
+        p = _pic(8, 8, 8, n=n, mesh_shape=ms, deposition="cic",
+                 devices=jax.devices()[:nd])
+        p.set_particles(ics)
+        p.run(2)
+        res[ms] = (_sorted_particles(p), p.rho())
+    solo, rho_solo = res[(1, 1, 1)]
+    dist, rho_dist = res[MESH222]
+    for k in PARTICLE_FIELDS:
+        assert np.array_equal(solo[k], dist[k]), k
+    assert np.array_equal(rho_solo, rho_dist)
+
+
+# ----------------------------------------------------------------------
+# ParticleLoss + resilience (bitwise recovery)
+# ----------------------------------------------------------------------
+def test_particle_loss_recovery_bitwise(tmp_path):
+    """A ParticleLoss fault trips the sentinel (the NaN'd charge lane
+    is probed non-finite), rolls back to the checkpoint whose extras
+    carry the particle lanes, and the recovered run ends BITWISE-equal
+    to the fault-free run — fields and particles both."""
+    from stencil_tpu.resilience import (FaultPlan, ParticleLoss,
+                                        ResiliencePolicy)
+
+    def mk():
+        return _pic(8, 8, 8, n=40, deposition="cic", seed=7)
+
+    ref = mk()
+    for _ in range(8):
+        ref.step()
+    ref_parts = _sorted_particles(ref)
+    ref_rho = ref.rho()
+
+    p = mk()
+    plan = FaultPlan()
+    plan.particle_losses.append(
+        ParticleLoss(step=5, count=2, shard=(0, 0, 0)))
+    pol = ResiliencePolicy(check_every=1, ckpt_every=4, base_delay=0.0,
+                           sleep=lambda s: None)
+    rep = p.run_resilient(8, policy=pol, ckpt_dir=str(tmp_path),
+                          faults=plan)
+    assert rep.steps == 8
+    assert rep.rollbacks >= 1
+    kinds = [e["event"] for e in rep.events]
+    assert "fault_particle_loss" in kinds and "restored" in kinds
+    trip = [e for e in rep.events if e["event"] == "sentinel_tripped"][0]
+    assert trip["step"] == 5
+    assert "'q'" in trip["reason"]
+    assert np.array_equal(p.rho(), ref_rho)
+    got = _sorted_particles(p)
+    for k in PARTICLE_FIELDS:
+        assert np.array_equal(ref_parts[k], got[k]), k
+
+
+def test_particle_loss_counter_exported():
+    """run_resilient exports stencil_run_particles_total through the
+    process metrics registry (README metric-table contract)."""
+    from stencil_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    c = reg.counter("stencil_run_particles_total", "")
+    before = c.value()
+    p = _pic(8, 8, 8, n=24, deposition="ngp")
+    from stencil_tpu.resilience import ResiliencePolicy
+    pol = ResiliencePolicy(check_every=2, base_delay=0.0,
+                           sleep=lambda s: None)
+    p.run_resilient(4, policy=pol)
+    assert c.value() - before == 4 * 24
+    o = reg.counter("stencil_run_migration_overflow_total", "")
+    assert o.value() >= 0.0
+
+
+def test_particle_loss_noop_without_particle_state():
+    """On a domain without particle lanes the fault warns and no-ops
+    instead of corrupting unrelated state."""
+    from stencil_tpu.models.jacobi import Jacobi3D
+    from stencil_tpu.resilience import ParticleLoss
+
+    j = Jacobi3D(8, 8, 8, mesh_shape=MESH222, dtype=np.float64,
+                 kernel="xla")
+    j.init()
+    ev = ParticleLoss(step=1)
+    logged = []
+    ev.fire(j.dd, lambda kind, **kw: logged.append(kind),
+            fields=j.dd.curr)
+    assert not logged
+    assert not np.isnan(j.temperature()).any()
+
+
+# ----------------------------------------------------------------------
+# checkpoint roundtrip for particle lanes as extras
+# ----------------------------------------------------------------------
+def test_particle_checkpoint_roundtrip_and_corrupt_walkback(tmp_path):
+    """Particle lanes ride checkpoints as extras through the hardened
+    utils/checkpoint.py layer: save/restore is bitwise, and a
+    corrupted newest step walks back to the older one."""
+    from stencil_tpu.resilience.faults import CheckpointCorruption
+    from stencil_tpu.utils.checkpoint import restore_domain, save_domain
+
+    p = _pic(8, 8, 8, n=32, deposition="cic", seed=11)
+    p.run(2)
+    snap0 = _sorted_particles(p)
+    save_domain(p.dd, str(tmp_path), 0, extra=p._particle_extras())
+    p.run(2)
+    save_domain(p.dd, str(tmp_path), 4, extra=p._particle_extras())
+    snap4 = _sorted_particles(p)
+
+    # clean restore of the newest step is bitwise
+    p.run(1)
+    step, extras = restore_domain(p.dd, str(tmp_path))
+    assert step == 4
+    p.state["rho"] = p.dd.curr["rho"]
+    p._install_particles(extras)
+    got = _sorted_particles(p)
+    for k in PARTICLE_FIELDS:
+        assert np.array_equal(snap4[k], got[k]), k
+
+    # corrupt the newest step: restore must walk back to step 0 with
+    # the step-0 particle lanes intact
+    corr = CheckpointCorruption(step=4, mode="truncate")
+    corr.fire(str(tmp_path), 4, np.random.default_rng(0),
+              lambda *a, **k: None)
+    step, extras = restore_domain(p.dd, str(tmp_path))
+    assert step == 0
+    p.state["rho"] = p.dd.curr["rho"]
+    p._install_particles(extras)
+    got = _sorted_particles(p)
+    for k in PARTICLE_FIELDS:
+        assert np.array_equal(snap0[k], got[k]), k
+
+
+# ----------------------------------------------------------------------
+# sentinel: the in-graph overflow column
+# ----------------------------------------------------------------------
+def test_sentinel_decodes_overflow_column_and_trips_on_nan():
+    """The migration-overflow counter rides the probe's ONE all-reduce
+    as an extra column and decodes into HealthStats.metrics; a NaN'd
+    particle lane trips the same probe."""
+    p = _pic(8, 8, 8, n=24, deposition="ngp")
+    s = p.make_sentinel()
+    s.probe(p.state, 3)
+    stats = s.poll(block=True)[0]
+    assert stats.step == 3
+    assert not stats.tripped
+    assert stats.metrics == {"migration_overflow": 0.0}
+    # poison one charge record: the q lane is probed non-finite
+    p.state["q"] = p.state["q"].at[0].set(float("nan"))
+    s.probe(p.state, 4)
+    stats = s.poll(block=True)[-1]
+    assert stats.tripped and "q" in stats.reason
+
+
+def test_cfl_violation_dropped_and_counted():
+    """A particle faster than one shard per step cannot be routed by
+    the +-1 ring: it must be DROPPED and COUNTED as overflow — never
+    shipped one hop short, where its deposits would silently vanish
+    and total charge would drift with no operator signal."""
+    n = 4
+    p = _pic(8, 8, 8, n=n, deposition="ngp", capacity=8, seed=0)
+    ics = {"x": np.array([1.0, 2.0, 3.0, 3.5]),
+           "y": np.full(n, 2.0), "z": np.full(n, 2.0),
+           # particle 0 jumps 10 cells = 2+ shards of the 4-cell
+           # x-extent (a 1-shard hop would still be ring-routable)
+           "vx": np.array([40.0, 0.0, 0.0, 0.0]),
+           "vy": np.zeros(n), "vz": np.zeros(n), "q": np.ones(n)}
+    p.set_particles(ics)
+    p.step()
+    assert p.overflow_total() == 1.0
+    h = p.particles_to_host()
+    assert len(h["q"]) == n - 1
+    # the survivors' charge is all that deposits from here on
+    p.step()
+    assert p.total_charge() == float(n - 1)
+
+
+def test_sentinel_reports_nonzero_overflow():
+    """Drive a real overflow (budget 1, clustered burst) and read the
+    counter back through the sentinel metrics column."""
+    rng = np.random.default_rng(2)
+    n = 24
+    p = _pic(8, 8, 8, n=n, deposition="ngp", budget=1, seed=2)
+    # a burst crossing the same +x boundary: several leavers, budget 1
+    ics = _uniform_ics(rng, (8, 8, 8), n)
+    ics["x"] = np.full(n, 3.9)   # just inside shard x=0
+    ics["vx"] = np.full(n, 1.0)  # all cross next step
+    p.set_particles(ics)
+    p.step()
+    assert p.overflow_total() > 0
+    s = p.make_sentinel()
+    s.probe(p.state, 1)
+    stats = s.poll(block=True)[0]
+    assert stats.metrics["migration_overflow"] > 0
+
+
+# ----------------------------------------------------------------------
+# registry gates
+# ----------------------------------------------------------------------
+def test_pic_registry_targets_pin_the_collective_bill():
+    """models.pic.step[hlo] pins 18 collective-permutes (accumulate +
+    exchange + migrate, 6 each) and nothing else; the cost target's
+    modeled bytes match the lowered HLO exactly; the probe target pins
+    one all-reduce."""
+    from stencil_tpu.analysis.hlo import check_hlo
+    from stencil_tpu.analysis.costmodel import check_costmodel
+    from stencil_tpu.analysis.registry import default_targets
+
+    targets = {t.name: t for t in default_targets()}
+    for name in ("models.pic.step[hlo]", "models.pic.probe[hlo]",
+                 "parallel.migrate.migrate_shard[hlo]"):
+        findings, metrics = check_hlo(targets[name])
+        assert findings == [], (name, findings)
+    f, metrics = check_costmodel(targets["models.pic.step[cost]"])
+    assert f == []
+    assert (metrics["observed_bytes_per_shard"]
+            == metrics["expected_bytes_per_shard"])
+    f, metrics = check_costmodel(
+        targets["parallel.migrate.migrate_shard[cost]"])
+    assert f == []
+    assert (metrics["observed_bytes_per_shard"]
+            == metrics["expected_bytes_per_shard"])
+
+
+def test_bad_migration_fixture_is_flagged():
+    """The all-gather 'migration' negative control must be flagged by
+    the hlo checker — the ppermute-only gate is not vacuous for the
+    dynamic pattern."""
+    import pathlib
+
+    from stencil_tpu.analysis import run_targets
+    from stencil_tpu.analysis.registry import load_targets
+
+    fx = (pathlib.Path(__file__).parent / "fixtures" / "lint"
+          / "bad_migration.py")
+    report = run_targets(load_targets(fx))
+    assert report.errors
+    assert any("all_gather" in f.message for f in report.findings)
+
+
+def test_migration_bytes_model_identity():
+    """The model the registry cross-checks: 2 messages per active axis
+    x record rows x budget x itemsize."""
+    from stencil_tpu.analysis.costmodel import (
+        migration_wire_bytes_per_shard)
+
+    assert migration_wire_bytes_per_shard(7, 8, Dim3(2, 2, 2), 4) \
+        == 6 * 11 * 8 * 4
+    assert migration_wire_bytes_per_shard(7, 8, Dim3(1, 1, 2), 4) \
+        == 2 * 11 * 8 * 4
+
+
+# ----------------------------------------------------------------------
+# tuning: capacity/budget ranking
+# ----------------------------------------------------------------------
+def test_migration_tuner_ranks_smallest_safe_budget():
+    from stencil_tpu.tuning import rank_migration_candidates
+
+    ranked = rank_migration_candidates(
+        particles_per_shard=256, n_fields=7, counts=Dim3(2, 2, 2),
+        elem_size=4, max_crossing_fraction=0.1)
+    costs = [c for c, _ in ranked]
+    assert costs == sorted(costs)
+    best = ranked[0][1]
+    # the winner's budget clears the safety floor but is the smallest
+    # that does (wire bytes scale with budget)
+    need = int(256 * 0.1 * 1.5) + 1
+    assert best.budget >= need
+    assert all(cand.budget >= best.budget for _, cand in ranked)
+
+
+def test_migration_tuner_scales_budget_with_flux():
+    from stencil_tpu.tuning import rank_migration_candidates
+
+    lo = rank_migration_candidates(256, 7, Dim3(2, 2, 2), 4,
+                                   max_crossing_fraction=0.05)[0][1]
+    hi = rank_migration_candidates(256, 7, Dim3(2, 2, 2), 4,
+                                   max_crossing_fraction=0.5)[0][1]
+    assert hi.budget > lo.budget
+
+
+def test_migration_tuner_rejects_unsafe_everything():
+    from stencil_tpu.tuning import (MigrationCandidate,
+                                    rank_migration_candidates)
+
+    with pytest.raises(ValueError, match="no feasible"):
+        rank_migration_candidates(
+            256, 7, Dim3(2, 2, 2), 4, max_crossing_fraction=1.0,
+            candidates=[MigrationCandidate(512, 4)])
+
+
+# ----------------------------------------------------------------------
+# model ergonomics
+# ----------------------------------------------------------------------
+def test_capacity_and_budget_validation():
+    with pytest.raises(ValueError, match="capacity"):
+        _pic(8, 8, 8, n=64, capacity=4)
+    with pytest.raises(ValueError, match="budget"):
+        _pic(8, 8, 8, n=8, capacity=16, budget=0)
+    with pytest.raises(ValueError, match="deposition"):
+        _pic(8, 8, 8, n=8, deposition="tsc")
+    with pytest.raises(ValueError, match="outside"):
+        p = _pic(8, 8, 8, n=4)
+        p.set_particles({"x": np.array([9.5, 1, 1, 1]),
+                         "y": np.ones(4), "z": np.ones(4)})
+
+
+def test_migration_stats_surface():
+    p = _pic(8, 8, 8, n=24, capacity=16, budget=4)
+    st = p.migration_stats()
+    assert st["capacity"] == 16 and st["budget"] == 4
+    assert st["record_bytes"] == (len(PARTICLE_FIELDS) + 4) * 8
+    assert st["migration_bytes_per_shard"] == 6 * 11 * 4 * 8
