@@ -168,3 +168,101 @@ def timed_samples(fn, sync, iters: int, warmup: int = 2) -> Statistics:
         sync()
         stats.insert(time.perf_counter() - t0)
     return stats
+
+
+# ---------------------------------------------------------------------------
+# the ONE steps/s measurement contract (performance observatory)
+#
+# Every app's steps/s claim used to re-implement its own warmup/measure/
+# block loop (bench_exchange's jacobi_steps_per_s, jacobi3d's and pic's
+# timed_samples closures) — three chances for the contract to drift.
+# These two helpers are the single source: compile+warm OUTSIDE the
+# timed window, fence with block() on both sides, count only steps that
+# actually advanced.
+
+
+def grouped_steps_per_s(run, block, iters: int, group: int = 1):
+    """Whole-loop steps/s: ``run(n)`` advances n steps in the engine's
+    fused loop; ``iters`` is rounded to whole ``group``-sized blocks so
+    differently-blocked configurations compare the same work (temporal
+    depth s, megastep check_every). Returns ``(steps, seconds,
+    steps_per_s)``."""
+    g = max(int(group), 1)
+    n = max(int(iters), g)
+    n -= n % g
+    run(g)       # compile + warm outside the timed window
+    block()
+    t0 = time.perf_counter()
+    run(n)
+    block()
+    dt = time.perf_counter() - t0
+    return n, dt, n / dt
+
+
+def sampled_steps_per_s(one, block, samples: int, batch: int,
+                        warmup: int = 2):
+    """Sampled steps/s for the CSV-reporting apps: ``one()`` advances
+    ``batch`` steps, timed ``samples`` times after ``warmup`` calls
+    (min/trimean come from the returned Statistics). Returns
+    ``(stats, steps_per_s)`` with steps/s from the trimean — the same
+    robust figure the CSV line prints."""
+    stats = timed_samples(one, block, max(int(samples), 1), warmup)
+    return stats, batch / stats.trimean()
+
+
+def add_bench_record_flags(p: argparse.ArgumentParser) -> None:
+    """``--ledger``: where ``--json-out`` runs ALSO append their
+    versioned observatory bench record (the append-only perf
+    trajectory, ``stencil_tpu/observatory/ledger.py``)."""
+    p.add_argument("--ledger", default=None, metavar="PATH",
+                   help="bench trajectory ledger (JSONL) the --json-out"
+                        " record is also appended to; default "
+                        "$STENCIL_BENCH_LEDGER, else bench/ledger.jsonl"
+                        " in this checkout; pass '' (or export "
+                        "STENCIL_BENCH_LEDGER='') to disable")
+
+
+def resolve_ledger_path(args):
+    """The ledger the record lands in, or None when disabled. An env
+    var SET to the empty string disables just like ``--ledger ''`` —
+    only a genuinely unset variable falls through to the committed
+    checkout ledger."""
+    led = getattr(args, "ledger", None)
+    if led is None:
+        led = os.environ.get("STENCIL_BENCH_LEDGER")
+        if led is None:
+            led = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "bench", "ledger.jsonl")
+    return led or None
+
+
+def emit_bench_artifacts(args, payload, source: str):
+    """The one place a bench's measured numbers leave the process:
+    write the legacy ``--json-out`` artifact AND append the versioned
+    observatory ledger record(s) derived from the SAME payload (one
+    converter serves live emission and legacy backfill —
+    ``observatory.ledger.payload_records`` — so a run and its
+    backfilled ancestors share a trajectory group by construction).
+    No-op without ``--json-out``. Returns the ledger path (None when
+    disabled)."""
+    import json
+
+    if not getattr(args, "json_out", ""):
+        return None
+    with open(args.json_out, "w") as f:
+        json.dump(payload, f, indent=2)
+    ledger = resolve_ledger_path(args)
+    if ledger:
+        from stencil_tpu.observatory.ledger import (append_record,
+                                                    payload_records)
+        records, skipped = payload_records(payload, source,
+                                           provenance="measured",
+                                           created=time.time())
+        for rec in records:
+            append_record(ledger, rec)
+        for s in skipped:
+            print(f"{source}: ledger skip: {s}", file=sys.stderr)
+        print(f"{source}: appended {len(records)} ledger record(s) -> "
+              f"{ledger}", file=sys.stderr)
+    return ledger

@@ -20,13 +20,13 @@ stdout as the CSV artifact); the sweep commentary goes to stderr.
 """
 
 import argparse
-import json
 import sys
 import time
 
-from _common import (add_device_flags, apply_device_flags,
-                     add_method_flags, csv_line, methods_from_args,
-                     timed_samples)
+from _common import (add_bench_record_flags, add_device_flags,
+                     add_method_flags, apply_device_flags, csv_line,
+                     emit_bench_artifacts, grouped_steps_per_s,
+                     methods_from_args, timed_samples)
 
 
 def _parse_depths(text: str):
@@ -79,6 +79,7 @@ def main() -> None:
                          "~/.cache/stencil_tpu/plans.json)")
     add_method_flags(ap)
     add_device_flags(ap)
+    add_bench_record_flags(ap)
     args = ap.parse_args()
     apply_device_flags(args)
 
@@ -100,20 +101,16 @@ def main() -> None:
     def jacobi_steps_per_s(methods, s):
         """Honest steps/s of the REAL blocked hot path: the Jacobi
         model's fused run loop (deep exchange + sub-steps incl. the
-        redundant ring compute) under the given configuration."""
+        redundant ring compute) under the given configuration, measured
+        by the one shared warmup/measure/block contract
+        (``_common.grouped_steps_per_s``)."""
         j = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape,
                      dtype=np.float32, kernel="xla", methods=methods,
                      exchange_every=s if s > 1 else None)
         j.init()
-        n = max(args.iters, s)
-        n -= n % s  # whole groups so configs compare the same work
-        j.run(s)    # compile + warm outside the timed window
-        j.block()
-        t0 = time.perf_counter()
-        j.run(n)
-        j.block()
-        dt = time.perf_counter() - t0
-        return n, dt, n / dt, j
+        n, dt, sps = grouped_steps_per_s(j.run, j.block, args.iters,
+                                         group=s)
+        return n, dt, sps, j
 
     results = []
     for s in depths:
@@ -307,8 +304,10 @@ def main() -> None:
             comparison["autotune"] = autotune_cmp
         if fused_cmp is not None:
             comparison["fused"] = fused_cmp
-        with open(args.json_out, "w") as f:
-            json.dump(comparison, f, indent=2)
+        # one payload, two artifacts: the legacy JSON plus the
+        # observatory ledger records derived from it (same converter
+        # the backfill CLI runs on the committed BENCH_*.json history)
+        emit_bench_artifacts(args, comparison, "bench_exchange")
         print(f"bench_exchange: wrote {args.json_out}", file=sys.stderr)
 
     if args.metrics_json:

@@ -11,12 +11,13 @@ bin/jacobi3d.cu:181-205); CSV result line
 import argparse
 import os
 
-from _common import (KERNEL_CHOICES, add_dcn_flags, add_device_flags,
-                     add_dtype_flags, add_method_flags,
-                     add_placement_flags, apply_device_flags, csv_line,
-                     dcn_from_args, dcn_mesh_shape, dtype_from_args,
-                     methods_from_args, placement_from_args,
-                     timed_samples)
+from _common import (KERNEL_CHOICES, add_bench_record_flags,
+                     add_dcn_flags, add_device_flags, add_dtype_flags,
+                     add_method_flags, add_placement_flags,
+                     apply_device_flags, csv_line, dcn_from_args,
+                     dcn_mesh_shape, dtype_from_args,
+                     emit_bench_artifacts, methods_from_args,
+                     placement_from_args, sampled_steps_per_s)
 
 
 def _run_resilient(j, args) -> None:
@@ -67,6 +68,9 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=10,
                     help="iterations per timing sample (fused loop)")
     ap.add_argument("--prefix", default="", help="output prefix")
+    ap.add_argument("--json-out", default="", metavar="PATH",
+                    help="write the timed run's bench record (steps/s "
+                         "+ byte model) as a JSON artifact")
     ap.add_argument("--paraview", action="store_true")
     ap.add_argument("--period", type=int, default=0,
                     help="paraview dump every N samples")
@@ -90,6 +94,7 @@ def main() -> None:
     add_placement_flags(ap)
     add_dcn_flags(ap)
     add_device_flags(ap)
+    add_bench_record_flags(ap)
     res = ap.add_argument_group(
         "resilience", "run under the checkpoint-rollback recovery "
         "driver (stencil_tpu/resilience); the --chaos-* flags inject "
@@ -177,7 +182,8 @@ def main() -> None:
         if args.paraview and args.period and n % args.period == 0:
             j.dd.write_paraview(f"{args.prefix}jacobi3d_{n}")
 
-    stats = timed_samples(one, j.block, samples)
+    # the one shared warmup/measure/block contract (_common)
+    stats, sps = sampled_steps_per_s(one, j.block, samples, args.batch)
     b = j.dd.exchange_bytes_per_axis()
     # honest exchange-cost estimate for the built path (the fused fast
     # paths never call dd.exchange(); see Jacobi3D.exchange_stats):
@@ -190,6 +196,22 @@ def main() -> None:
                    f"{stats.trimean() / args.batch:.6e}",
                    xstats["path"], int(xstats["bytes_per_iteration"]),
                    f"{ex_s:.6e}"))
+    emit_bench_artifacts(
+        args,
+        {"bench": "jacobi3d",
+         "config": {"grid": [gx, gy, gz], "devices": ndev,
+                    "mesh": list(mesh_shape), "kernel": xstats["path"],
+                    "methods": str(methods),
+                    "dtype": str(dtype.__name__ if hasattr(dtype, "__name__")
+                                 else dtype),
+                    "exchange_every": args.exchange_every or 1},
+         "metrics": {"steps_per_s": sps,
+                     "min_step_s": stats.min() / args.batch,
+                     "trimean_step_s": stats.trimean() / args.batch,
+                     "bytes_per_iteration_model":
+                         float(xstats["bytes_per_iteration"]),
+                     "exchange_s_per_iteration": ex_s}},
+        "jacobi3d")
     if args.paraview:
         j.dd.write_paraview(args.prefix + "jacobi3d_final")
 

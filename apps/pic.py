@@ -12,11 +12,12 @@ included) — the CI pic-smoke stage's entry point.
 """
 
 import argparse
-import json
 
-from _common import (add_device_flags, add_dtype_flags, add_method_flags,
+from _common import (add_bench_record_flags, add_device_flags,
+                     add_dtype_flags, add_method_flags,
                      apply_device_flags, csv_line, dtype_from_args,
-                     methods_from_args, timed_samples)
+                     emit_bench_artifacts, methods_from_args,
+                     sampled_steps_per_s)
 
 
 def _run_resilient(p, args) -> None:
@@ -70,9 +71,17 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--json-out", default="",
                     help="write the bench record (BENCH_pr10 schema)")
+    ap.add_argument("--metrics-json", default="", metavar="PATH",
+                    help="also record the measured numbers as a "
+                         "telemetry metrics snapshot (gauges "
+                         "stencil_bench_particle_steps_per_s{deposition"
+                         "=} and stencil_bench_migration_bytes_per_"
+                         "shard{deposition=}) so the JSON artifact and "
+                         "the metrics surface agree on one figure")
     add_dtype_flags(ap)
     add_method_flags(ap)
     add_device_flags(ap)
+    add_bench_record_flags(ap)
     res = ap.add_argument_group(
         "resilience", "run under the checkpoint-rollback driver; the "
         "--chaos-* flags inject seeded faults (CI pic-smoke)")
@@ -124,12 +133,12 @@ def main() -> None:
         p.run(args.batch)
         steps_run += args.batch
 
-    # timed_samples also runs warmup calls of one(): steps_run counts
-    # what actually advanced, so the particle-steps counter is honest
-    stats = timed_samples(one, p.block, samples)
+    # sampled_steps_per_s also runs warmup calls of one(): steps_run
+    # counts what actually advanced, so the step counter is honest
+    stats, sps = sampled_steps_per_s(one, p.block, samples, args.batch)
     mig = p.migration_stats()
-    step_s = stats.trimean() / args.batch
-    psps = n / step_s  # particle steps advanced per second
+    step_s = 1.0 / sps
+    psps = n * sps  # particle steps advanced per second
     print(csv_line("pic", methods_from_args(args), ndev, gx, gy, gz,
                    n, args.deposition,
                    f"{stats.min() / args.batch:.6e}",
@@ -137,23 +146,39 @@ def main() -> None:
                    mig["migration_bytes_per_shard"],
                    int(p.overflow_total())))
     p._export_run_metrics(steps_run)
-    if args.json_out:
-        rec = {
-            "bench": "pic",
-            "config": {"grid": [gx, gy, gz], "devices": ndev,
-                       "particles": n, "deposition": args.deposition,
-                       "dt": args.dt, "capacity": p.capacity,
-                       "budget": p.budget,
-                       "dtype": str(p._dtype)},
-            "seconds_per_step": step_s,
-            "particle_steps_per_s": psps,
-            "migration_bytes_per_shard":
-                mig["migration_bytes_per_shard"],
-            "overflow": p.overflow_total(),
-            "total_charge": p.total_charge(),
-        }
-        with open(args.json_out, "w") as f:
-            json.dump(rec, f, indent=1)
+    rec = {
+        "bench": "pic",
+        "config": {"grid": [gx, gy, gz], "devices": ndev,
+                   "particles": n, "deposition": args.deposition,
+                   "dt": args.dt, "capacity": p.capacity,
+                   "budget": p.budget,
+                   "dtype": str(p._dtype)},
+        "seconds_per_step": step_s,
+        "particle_steps_per_s": psps,
+        "migration_bytes_per_shard":
+            mig["migration_bytes_per_shard"],
+        "overflow": p.overflow_total(),
+        "total_charge": p.total_charge(),
+    }
+    emit_bench_artifacts(args, rec, "pic")
+    if args.metrics_json:
+        # one number, two artifacts: the SAME figures as the JSON
+        # record land in a telemetry metrics snapshot (the CI
+        # bench-metrics parity gate covers this gauge exactly like
+        # stencil_bench_steps_per_s{exchange_every})
+        from stencil_tpu.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.gauge("stencil_bench_particle_steps_per_s",
+                  "measured particle steps/s of the fused PIC loop, "
+                  "by deposition scheme"
+                  ).set(psps, deposition=args.deposition)
+        reg.gauge("stencil_bench_migration_bytes_per_shard",
+                  "static migration wire B/shard/step of the measured "
+                  "configuration (analytic model, HLO-cross-checked)"
+                  ).set(mig["migration_bytes_per_shard"],
+                        deposition=args.deposition)
+        reg.write_snapshot(args.metrics_json)
 
 
 if __name__ == "__main__":

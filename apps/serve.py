@@ -82,6 +82,17 @@ def main() -> int:
     p.add_argument("--tune-cache", default="",
                    help="tuning-plan cache path (shared across runs "
                         "-> the second process is a plan-cache hit)")
+    p.add_argument("--flight-dir", default="", metavar="DIR",
+                   help="arm the flight recorder: bounded black-box "
+                        "dumps (events + spans + metrics + probe "
+                        "history) land here on sentinel trip, "
+                        "preemption, and unhandled batch errors "
+                        "(default $STENCIL_FLIGHT_RECORDER_DIR)")
+    p.add_argument("--retune-on-drift", action="store_true",
+                   help="perf-drift healing: K consecutive attributed "
+                        "segments outside tolerance invalidate the "
+                        "plan-cache record so the next tune "
+                        "re-measures")
     args = p.parse_args()
     apply_device_flags(args)
 
@@ -93,7 +104,9 @@ def main() -> int:
         root, width=args.width,
         tuner_timer=FakeTimer() if args.fake_timer else None,
         plan_cache_path=args.tune_cache or None,
-        fuse_segments=args.fuse_segments)
+        fuse_segments=args.fuse_segments,
+        flight_recorder_dir=args.flight_dir or None,
+        retune_on_drift=args.retune_on_drift)
 
     metrics_server = None
     if args.metrics_port >= 0:

@@ -23,7 +23,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
-echo "== 1/11 lint (stencil-lint + ruff; tier=$TIER) =="
+echo "== 1/12 lint (stencil-lint + ruff; tier=$TIER) =="
 # stencil-lint: all nine static checkers — halo-radius footprint, DMA
 # discipline, ppermute sanity, HLO collective-permute-only lowering,
 # analytic-vs-HLO byte cross-check, the Pallas VMEM/tiling audit, and
@@ -79,10 +79,10 @@ if [ "$TIER" = "full" ]; then
   fi
 fi
 
-echo "== 2/11 native build =="
+echo "== 2/12 native build =="
 bash ci/build.sh
 
-echo "== 3/11 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+echo "== 3/12 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
 # The full tier is dominated by interpret-mode Pallas parity tests
 # (CPU-bound, independent): fan them out with pytest-xdist when the
 # machine has cores to spare. Each worker process builds its own
@@ -98,7 +98,7 @@ else
   python -m pytest tests/ -q --maxfail=1 -m "not slow"
 fi
 
-echo "== 4/11 app smoke runs =="
+echo "== 4/12 app smoke runs =="
 # overlap app smokes execute remote DMA: possible only on a TPU or
 # with the distributed (mosaic) interpreter — probe, don't assume
 RDMA_OK=$(python -c "from stencil_tpu._compat import remote_dma_runnable
@@ -123,7 +123,7 @@ smoke() { echo "-- $*"; python "$@" > /dev/null; }
   smoke bench_qap.py --sizes 4 6
 )
 
-echo "== 5/11 bench smoke: temporal blocking + autotuned plan =="
+echo "== 5/12 bench smoke: temporal blocking + autotuned plan =="
 # communication-avoiding temporal blocking must not regress steps/s of
 # the REAL blocked hot path (Jacobi3D's fused run loop, redundant ring
 # compute included) on the fake CPU mesh; the amortized byte model
@@ -138,7 +138,12 @@ echo "== 5/11 bench smoke: temporal blocking + autotuned plan =="
 BENCH_JSON="$(mktemp -t BENCH_pr4.XXXXXX.json)"
 BENCH_METRICS="$(mktemp -t BENCH_metrics.XXXXXX.json)"
 TUNE_CACHE="$(mktemp -t tune_cache.XXXXXX.json)"; rm -f "$TUNE_CACHE"
+# scratch observatory ledger: the bench (here) and pic (stage 8) smoke
+# runs append their versioned records to it; the observatory stage (9)
+# validates it, gates it, and proves a synthetic regression fails
+OBS_LEDGER="$(mktemp -t obs_ledger.XXXXXX.jsonl)"; rm -f "$OBS_LEDGER"
 ( cd apps
+  STENCIL_BENCH_LEDGER="$OBS_LEDGER" \
   python bench_exchange.py --x 8 --y 8 --z 8 --iters 20 --fake-cpu 8 \
         --exchange-every 1,4 --autotune --tune-cache "$TUNE_CACHE" \
         --fuse-segments --check-every 8 \
@@ -198,8 +203,9 @@ json.dump(d["fused"], sys.stdout, indent=1)
 EOF
 fi
 rm -f "$BENCH_JSON" "$BENCH_METRICS" "$TUNE_CACHE"
+# NOTE: "$OBS_LEDGER" survives into stages 8/9 (the observatory stage)
 
-echo "== 6/11 exchange autotuner (fake timer: search/fit/plan/cache) =="
+echo "== 6/12 exchange autotuner (fake timer: search/fit/plan/cache) =="
 # the tuner's whole pipeline with deterministic fake measurements (no
 # hardware dependence): first invocation tunes and writes the plan
 # cache, the second MUST be a cache hit performing zero measurements.
@@ -230,7 +236,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -f "$TUNE_CACHE" "$PLAN1" "$PLAN2"
 
-echo "== 7/11 chaos smoke: resilient run loop under injected faults =="
+echo "== 7/12 chaos smoke: resilient run loop under injected faults =="
 # the Jacobi app under run_resilient (stencil_tpu/resilience) with a
 # seeded fault plan: one NaN injection (must trip the health sentinel
 # and roll back to the last good checkpoint) and one transient save
@@ -244,8 +250,10 @@ echo "== 7/11 chaos smoke: resilient run loop under injected faults =="
 # hot loop fails this stage loudly.
 CHAOS_CKPT="$(mktemp -d -t chaos_ckpt.XXXXXX)"
 CHAOS_EVENTS="$(mktemp -t chaos_events.XXXXXX.json)"
+CHAOS_FLIGHT="$(mktemp -d -t chaos_flight.XXXXXX)"
 ( cd apps
   STENCIL_ASSERT_SINGLE_COMPILE=1 \
+  STENCIL_FLIGHT_RECORDER_DIR="$CHAOS_FLIGHT" \
   python jacobi3d.py --x 8 --y 8 --z 8 --iters 12 --fake-cpu 8 \
         --resilient --fuse-segments --ckpt-dir "$CHAOS_CKPT" \
         --ckpt-every 4 --check-every 1 --chaos-nan 6 \
@@ -266,13 +274,28 @@ print(f"chaos smoke OK: {d['steps']} steps completed with "
 EOF
 # the resilience report speaks the unified telemetry event schema
 python -m stencil_tpu.telemetry validate-events "$CHAOS_EVENTS"
+# flight recorder: the injected NaN trip must have produced a schema-
+# valid black-box dump whose incident timeline contains the trip AND
+# the rollback it resolved into (observatory/recorder.py)
+CHAOS_DUMP="$(ls "$CHAOS_FLIGHT"/flight_*sentinel_trip*.json | head -1)"
+python -m stencil_tpu.observatory validate "$CHAOS_DUMP"
+CHAOS_DUMP="$CHAOS_DUMP" python - <<'EOF'
+import os
+from stencil_tpu.observatory import render_timeline
+tl = render_timeline(os.environ["CHAOS_DUMP"])
+assert "sentinel_tripped" in tl, tl
+assert "restored" in tl, tl
+print("chaos flight dump OK: timeline carries the trip + rollback "
+      f"({len(tl.splitlines())} timeline rows)")
+EOF
 if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
   mkdir -p "$CI_ARTIFACT_DIR"
   cp "$CHAOS_EVENTS" "$CI_ARTIFACT_DIR/chaos_events.json"
+  cp "$CHAOS_DUMP" "$CI_ARTIFACT_DIR/chaos_flight_dump.json"
 fi
-rm -rf "$CHAOS_CKPT" "$CHAOS_EVENTS"
+rm -rf "$CHAOS_CKPT" "$CHAOS_EVENTS" "$CHAOS_FLIGHT"
 
-echo "== 8/11 pic smoke: particle migration + ParticleLoss chaos =="
+echo "== 8/12 pic smoke: particle migration + ParticleLoss chaos =="
 # the particle-in-cell workload (stencil_tpu/models/pic.py): a short
 # run proves the dynamic migration path end-to-end (CSV line, zero
 # overflow, charge conserved), then a chaos run injects a ParticleLoss
@@ -283,21 +306,37 @@ echo "== 8/11 pic smoke: particle migration + ParticleLoss chaos =="
 PIC_CKPT="$(mktemp -d -t pic_ckpt.XXXXXX)"
 PIC_EVENTS="$(mktemp -t pic_events.XXXXXX.json)"
 PIC_BENCH="$(mktemp -t pic_bench.XXXXXX.json)"
+PIC_METRICS="$(mktemp -t pic_metrics.XXXXXX.json)"
 ( cd apps
+  STENCIL_BENCH_LEDGER="$OBS_LEDGER" \
   python pic.py --x 8 --y 8 --z 8 --particles 64 --iters 4 --batch 2 \
         --fake-cpu 8 --deposition ngp --f64 \
-        --json-out "$PIC_BENCH" > /dev/null
+        --json-out "$PIC_BENCH" --metrics-json "$PIC_METRICS" \
+        > /dev/null
   python pic.py --x 8 --y 8 --z 8 --particles 64 --iters 6 --fake-cpu 8 \
         --resilient --ckpt-dir "$PIC_CKPT" --ckpt-every 2 \
         --check-every 1 --chaos-particle-loss 3 \
         --events-json "$PIC_EVENTS" > /dev/null )
-PIC_EVENTS="$PIC_EVENTS" PIC_BENCH="$PIC_BENCH" python - <<'EOF'
+PIC_EVENTS="$PIC_EVENTS" PIC_BENCH="$PIC_BENCH" \
+PIC_METRICS="$PIC_METRICS" python - <<'EOF'
 import json
 import os
 b = json.load(open(os.environ["PIC_BENCH"]))
 assert b["overflow"] == 0, b
 assert b["total_charge"] == b["config"]["particles"], b
 assert b["particle_steps_per_s"] > 0, b
+# telemetry parity: the metrics snapshot records the SAME figures the
+# pic JSON pins — one number, two artifacts, no drift (the same gate
+# stage 5 applies to stencil_bench_steps_per_s{exchange_every})
+from stencil_tpu.telemetry import snapshot_value
+snap = json.load(open(os.environ["PIC_METRICS"]))
+dep = b["config"]["deposition"]
+got = snapshot_value(snap, "stencil_bench_particle_steps_per_s",
+                     deposition=dep)
+assert got == b["particle_steps_per_s"], (got, b)
+got = snapshot_value(snap, "stencil_bench_migration_bytes_per_shard",
+                     deposition=dep)
+assert got == b["migration_bytes_per_shard"], (got, b)
 d = json.load(open(os.environ["PIC_EVENTS"]))
 assert d["steps"] == 6, d
 assert d["rollbacks"] >= 1, d
@@ -315,10 +354,60 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
   mkdir -p "$CI_ARTIFACT_DIR"
   cp "$PIC_EVENTS" "$CI_ARTIFACT_DIR/pic_events.json"
   cp "$PIC_BENCH" "$CI_ARTIFACT_DIR/BENCH_pr10.json"
+  cp "$PIC_METRICS" "$CI_ARTIFACT_DIR/pic_metrics.json"
 fi
-rm -rf "$PIC_CKPT" "$PIC_EVENTS" "$PIC_BENCH"
+rm -rf "$PIC_CKPT" "$PIC_EVENTS" "$PIC_BENCH" "$PIC_METRICS"
 
-echo "== 9/11 service smoke: concurrent multi-tenant ensemble campaigns =="
+echo "== 9/12 observatory: bench ledger validate/gate + backfill =="
+# the bench trajectory ledger (stencil_tpu/observatory/ledger.py): the
+# bench (stage 5) and pic (stage 8) smoke runs appended their records
+# to the scratch ledger — validate it, prove the regression gate
+# passes on the real run, prove an injected synthetic same-fingerprint
+# steps/s regression exits NONZERO, and backfill-convert the committed
+# legacy BENCH_*.json history (validated + diffed) the way the
+# committed bench/ledger.jsonl was seeded.
+python -m stencil_tpu.observatory validate "$OBS_LEDGER"
+python -m stencil_tpu.observatory gate "$OBS_LEDGER" --threshold 0.5
+OBS_BAD="$(mktemp -t obs_bad.XXXXXX.jsonl)"
+cp "$OBS_LEDGER" "$OBS_BAD"
+OBS_LEDGER="$OBS_LEDGER" OBS_BAD="$OBS_BAD" python - <<'EOF'
+import json
+import os
+# synthetic regression: clone the newest record with steps/s cut 10x —
+# the same-(fingerprint, bench) gate must catch it
+with open(os.environ["OBS_LEDGER"]) as f:
+    rec = json.loads(f.read().splitlines()[-1])
+rec["metrics"]["steps_per_s"] /= 10.0
+rec["created"] += 1.0
+with open(os.environ["OBS_BAD"], "a") as f:
+    f.write(json.dumps(rec) + "\n")
+EOF
+if python -m stencil_tpu.observatory gate "$OBS_BAD" --threshold 0.5; then
+  echo "observatory gate FAILED to catch the synthetic regression"
+  exit 1
+else
+  echo "observatory gate correctly rejects the synthetic regression"
+fi
+OBS_LEGACY="$(mktemp -t obs_legacy.XXXXXX.jsonl)"; rm -f "$OBS_LEGACY"
+python -m stencil_tpu.observatory backfill --out "$OBS_LEGACY" \
+  BENCH_pr3.json BENCH_pr4.json BENCH_pr8.json BENCH_pr10.json \
+  BENCH_r01.json BENCH_r02.json BENCH_r03.json BENCH_r04.json \
+  BENCH_r05.json
+python -m stencil_tpu.observatory validate "$OBS_LEGACY"
+# the live smoke records and their backfilled ancestors share one
+# converter, so the bench_exchange trajectory diffs across them
+python -m stencil_tpu.observatory diff "$OBS_LEGACY" \
+  --bench bench_exchange
+# the committed seed ledger stays in sync with the backfill converter
+python -m stencil_tpu.observatory validate bench/ledger.jsonl
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+  mkdir -p "$CI_ARTIFACT_DIR"
+  cp "$OBS_LEDGER" "$CI_ARTIFACT_DIR/bench_ledger.jsonl"
+  cp "$OBS_LEGACY" "$CI_ARTIFACT_DIR/bench_ledger_legacy.jsonl"
+fi
+rm -f "$OBS_LEDGER" "$OBS_BAD" "$OBS_LEGACY"
+
+echo "== 10/12 service smoke: concurrent multi-tenant ensemble campaigns =="
 # the campaign service (stencil_tpu/serving) on the fake CPU mesh:
 # three concurrent fake tenants share one problem fingerprint and ride
 # ONE batched ensemble dispatch stream (tenant0 gets a chaos NaN that
@@ -374,7 +463,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -rf "$SERVE_ROOT" "$SERVE_CACHE" "$SERVE_EVENTS1" "$SERVE_EVENTS2"
 
-echo "== 10/11 telemetry: metrics surface, span trace, unified events =="
+echo "== 11/12 telemetry: metrics surface, span trace, unified events =="
 # the observability acceptance gate (stencil_tpu/telemetry): a first
 # service process (cold: tunes once) and a second process on the same
 # plan cache (warm) each export their metrics snapshot, span trace,
@@ -445,7 +534,7 @@ fi
 rm -rf "$TM_ROOT" "$TM_CACHE" "$TM_EVENTS1" "$TM_EVENTS2" \
        "$TM_METRICS1" "$TM_METRICS2" "$TM_TRACE"
 
-echo "== 11/11 multi-chip certification sweep =="
+echo "== 12/12 multi-chip certification sweep =="
 python __graft_entry__.py 8 | tail -1
 
 echo "CI PASSED"

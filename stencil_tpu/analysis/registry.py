@@ -934,6 +934,60 @@ def _megastep_segment_cost() -> CostModelSpec:
 
 
 # ---------------------------------------------------------------------------
+# performance-observatory attribution targets: model-vs-measured
+# attribution (observatory/attribution.py) is a HOST wall clock around
+# the dispatch — the dispatched program must be byte-identical to the
+# uninstrumented one. These targets lower exactly what
+# PerfAttributor.attributed() hands the dispatcher and pin it to the
+# SAME exact collective counts, the SAME analytic byte bill, and the
+# SAME dispatch-stable compile fingerprint as the bare megastep/PIC
+# entries above — attribution adds zero collectives, zero wire bytes,
+# zero retraces. tests/fixtures/lint/bad_attribution.py (a timer that
+# sneaks a host callback into the step) is the negative control.
+
+
+def _attributed(spec):
+    """The bare spec with its fn routed through
+    ``PerfAttributor.attributed`` — everything else (exact counts,
+    byte expectations, allowed vocabulary) stays the BARE target's by
+    construction, so the two registrations cannot drift apart: any
+    future attribution scheme that edits the program fails the bare
+    target's own pins under the attribution name."""
+    import dataclasses
+
+    from ..observatory.attribution import PerfAttributor
+
+    return dataclasses.replace(spec,
+                               fn=PerfAttributor.attributed(spec.fn))
+
+
+def _attribution_segment_hlo() -> HloSpec:
+    return _attributed(_megastep_segment_hlo())
+
+
+def _attribution_segment_cost() -> CostModelSpec:
+    return _attributed(_megastep_segment_cost())
+
+
+def _attributed_segment_entry():
+    from ..observatory.attribution import PerfAttributor
+
+    fn, args = _megastep_segment_entry()
+    return PerfAttributor.attributed(fn), args
+
+
+def _attributed_pic_entry():
+    from ..observatory.attribution import PerfAttributor
+
+    fn, args = _pic_step_entry()
+    return PerfAttributor.attributed(fn), args
+
+
+def _attribution_pic_hlo() -> HloSpec:
+    return _attributed(_pic_step_hlo())
+
+
+# ---------------------------------------------------------------------------
 # particle-migration / PIC targets: the DYNAMIC communication pattern.
 # The fixed-capacity migration ring must lower to collective-permute
 # only with its static budget x record-rows wire bill matching the
@@ -1608,6 +1662,27 @@ def default_targets() -> List[Target]:
         CostModelTarget(
             f"parallel.megastep.segment[k={_MEGASTEP_K},cost]",
             _megastep_segment_cost),
+    ]
+    # performance observatory: the ATTRIBUTED entry points (what
+    # PerfAttributor.attributed hands the dispatcher) lower to the
+    # IDENTICAL program as the bare ones — same exact collective
+    # counts, same analytic byte bill, no host escapes, unchanged
+    # compile fingerprints under the recompile checker. Attribution
+    # is host-side by contract; these targets make the contract a gate
+    targets += [
+        HloTarget("observatory.attribution.segment[hlo]",
+                  _attribution_segment_hlo),
+        CostModelTarget("observatory.attribution.segment[cost]",
+                        _attribution_segment_cost),
+        TransferTarget("observatory.attribution.segment[transfer]",
+                       lambda: _transfer_spec(_attributed_segment_entry)),
+        RecompileTarget("observatory.attribution.segment[recompile]",
+                        lambda: _recompile_spec(_attributed_segment_entry,
+                                                ((0, (0,)),))),
+        HloTarget("observatory.attribution.pic_step[hlo]",
+                  _attribution_pic_hlo),
+        TransferTarget("observatory.attribution.pic_step[transfer]",
+                       lambda: _transfer_spec(_attributed_pic_entry)),
     ]
     # the particle-migration ring and the fused PIC step: the dynamic
     # communication pattern under the same gates as the static sweep —

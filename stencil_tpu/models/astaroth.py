@@ -1183,7 +1183,8 @@ class Astaroth:
             pre_checkpoint=self.sync_domain,
             make_segment=(self.make_segment
                           if self._segment_builder is not None
-                          else None))
+                          else None),
+            perf_entry="astaroth")
 
 
 # ----------------------------------------------------------------------

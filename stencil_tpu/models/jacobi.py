@@ -865,7 +865,8 @@ class Jacobi3D:
                              make_segment=(
                                  self.make_segment
                                  if self._segment_builder is not None
-                                 else None))
+                                 else None),
+                             perf_entry="jacobi")
 
 
 def dense_reference_step(temp: np.ndarray, hot_c: Tuple[int, int, int],

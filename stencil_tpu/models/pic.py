@@ -454,6 +454,40 @@ class Pic:
         """Particles dropped by migration so far (all shards)."""
         return float(np.sum(np.asarray(self.state["overflow"])))
 
+    def perf_model_step_seconds(self) -> Optional[float]:
+        """The calibrated cost-model prediction of this engine's wire
+        seconds per STEP, for the performance observatory: the reverse
+        halo-accumulate plus the forward exchange (two radius-2 sweeps
+        — the adjoint pair the fused step pays) priced by the generic
+        exchange model, plus the migration ring priced by
+        ``analysis/costmodel.migration_step_seconds`` — the same
+        figures whose byte bills the ``models.pic.step[cost]`` registry
+        target pins HLO-exactly. None on an unsharded mesh (nothing on
+        the wire to attribute)."""
+        from ..analysis.costmodel import migration_step_seconds
+        from ..observatory.attribution import model_step_seconds_for
+
+        sweep = model_step_seconds_for(self.dd)
+        if sweep is None:
+            return None
+        counts = mesh_dim(self.dd.mesh)
+        mig = migration_step_seconds(len(PARTICLE_FIELDS), self.budget,
+                                     counts, self._dtype.itemsize)
+        total = 2.0 * sweep + mig
+        return total if total > 0 else None
+
+    def perf_model_bytes_per_step(self) -> float:
+        """Whole-mesh modeled wire B/step for attribution: two
+        radius-2 sweeps (accumulate + exchange) plus the migration
+        ring on every shard — the byte side of
+        :meth:`perf_model_step_seconds`, so the exported
+        achieved-vs-modeled B/s gauges price the FULL fused step."""
+        counts = mesh_dim(self.dd.mesh)
+        n_shards = counts.flatten()
+        mig = self.migration_stats()["migration_bytes_per_shard"]
+        return (2.0 * float(self.dd.exchange_bytes_amortized_per_step())
+                + mig * n_shards)
+
     def migration_stats(self) -> dict:
         """The wire-cost identity of this engine's migration step —
         the same figures the costmodel registry target pins against
@@ -509,7 +543,10 @@ class Pic:
             ckpt_dir=ckpt_dir, faults=faults,
             extra_fn=self._particle_extras, on_restore=on_restore,
             fields_fn=lambda: self.state,
-            sentinel_factory=lambda dd: self.make_sentinel())
+            sentinel_factory=lambda dd: self.make_sentinel(),
+            model_step_seconds=self.perf_model_step_seconds(),
+            model_bytes_per_step=self.perf_model_bytes_per_step(),
+            perf_entry="pic")
         self._export_run_metrics(report.steps, ovf0)
         return report
 

@@ -1,0 +1,63 @@
+"""Performance observatory: close the loop between model and machine.
+
+The audit stack proves what a program *will* do (exact collectives,
+exact wire bytes, donation, zero host transfers — ``analysis/``) and
+the tuner prices what it *should* cost (calibrated alpha-beta,
+``tuning/`` + ``analysis/costmodel.py``), but neither observes what
+dispatches *actually achieve*. This package is that third leg,
+TEMPI-style (arXiv:2012.14363 — communication claims stand on
+systematic measured-vs-modeled validation), in three coupled pieces:
+
+* **Attribution** (:mod:`.attribution`) — :class:`PerfAttributor`
+  wraps every fused-segment and stepwise dispatch in the resilient
+  driver, the campaign service, and the bench apps, pairing measured
+  seconds/step (``jax.block_until_ready``-fenced, amortized over the
+  segment's k steps) against the calibrated cost-model prediction for
+  the active plan. Exported as
+  ``stencil_perf_model_error_ratio{entry,method,s}`` plus achieved-vs-
+  modeled bytes/s; a drift detector emits a v1-schema ``perf_drift``
+  event after K consecutive segments outside tolerance and (opt-in,
+  ``ResiliencePolicy.retune_on_drift``) invalidates the plan-cache
+  record so the tuner re-measures — stale plans heal themselves.
+  Attribution is HOST-side (wall clock around the dispatch): the
+  ``observatory.attribution.*`` registry targets prove the attributed
+  entry points lower to the IDENTICAL HLO as uninstrumented ones.
+
+* **Ledger** (:mod:`.ledger`) — ONE versioned bench-record schema
+  every app's ``--json-out`` path also appends to
+  ``bench/ledger.jsonl``, keyed by the tuning fingerprint + bench id.
+  ``python -m stencil_tpu.observatory`` validates records, backfills
+  the legacy ``BENCH_*.json`` snapshots, diffs records, and gates
+  same-fingerprint steps/s regressions (nonzero exit) — the perf
+  trajectory becomes append-only history instead of per-PR snapshots.
+
+* **Flight recorder** (:mod:`.recorder`) — a bounded black box
+  (recent events via :class:`~stencil_tpu.telemetry.RingSink`, recent
+  spans, a metrics snapshot, health/probe history) dumped atomically
+  on health trip, degradation, SIGTERM, and unhandled dispatch error;
+  ``observatory replay <dump>`` renders the incident timeline.
+"""
+
+from .attribution import (METRIC_ACHIEVED_BYTES_PER_S,
+                          METRIC_MODEL_ERROR_RATIO,
+                          METRIC_MODELED_BYTES_PER_S, PerfAttributor,
+                          make_drift_invalidator,
+                          model_step_seconds_for)
+from .ledger import (LEDGER_SCHEMA_VERSION, append_record,
+                     backfill_records, config_fingerprint, diff_records,
+                     gate_regressions, make_record, payload_records,
+                     read_ledger, validate_record)
+from .recorder import (ENV_FLIGHT_DIR, FLIGHT_SCHEMA_VERSION,
+                       FlightRecorder, render_timeline, validate_dump)
+
+__all__ = [
+    "PerfAttributor", "model_step_seconds_for",
+    "make_drift_invalidator",
+    "METRIC_MODEL_ERROR_RATIO", "METRIC_ACHIEVED_BYTES_PER_S",
+    "METRIC_MODELED_BYTES_PER_S",
+    "LEDGER_SCHEMA_VERSION", "make_record", "validate_record",
+    "append_record", "read_ledger", "diff_records", "gate_regressions",
+    "backfill_records", "payload_records", "config_fingerprint",
+    "FLIGHT_SCHEMA_VERSION", "ENV_FLIGHT_DIR", "FlightRecorder",
+    "validate_dump", "render_timeline",
+]

@@ -1,0 +1,230 @@
+"""CLI entry: ``python -m stencil_tpu.observatory``.
+
+Subcommands (all artifact-facing — none touch accelerators):
+
+* ``validate PATH``  — schema-check a bench ledger (JSONL, or a single
+  record / array of records) or a flight-recorder dump (autodetected
+  by shape); nonzero exit on problems (the CI gate).
+* ``backfill --out LEDGER FILES...`` — convert the legacy
+  ``BENCH_*.json`` artifacts into ledger records with provenance
+  ``legacy`` (unusable legacy runs are reported as skipped, never
+  invented), appended to ``--out`` in argument order.
+* ``diff A [B]``     — metric-by-metric comparison: with one ledger,
+  the two newest records of the newest record's (fingerprint, bench)
+  group; with two paths, the last record of each.
+* ``gate LEDGER``    — the regression gate: within every same-
+  (fingerprint, bench) group of ``measured`` records, the newest
+  steps/s may not drop more than ``--threshold`` below the best
+  earlier one; nonzero exit on any regression. ``--include-legacy``
+  widens the gate to backfilled history (off by default — legacy
+  snapshots come from other sessions/machines).
+* ``replay DUMP``    — render a flight-recorder dump's merged incident
+  timeline (events + probes + spans).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _load_any(path: str):
+    """(kind, payload): 'dump' | 'records'. A ledger is JSONL or a
+    JSON array / single record; a flight dump is one JSON object with
+    kind == flight_recorder."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        payload = json.loads(text)
+    except ValueError:
+        from .ledger import read_ledger
+        return "records", read_ledger(path)
+    if isinstance(payload, dict):
+        if payload.get("kind") == "flight_recorder":
+            return "dump", payload
+        return "records", [payload]
+    if isinstance(payload, list):
+        return "records", payload
+    raise ValueError(f"{path}: neither ledger records nor a flight dump")
+
+
+def _print_diff(diff: dict) -> None:
+    a_b, a_f = diff["bench"], diff["fingerprint"]
+    print(f"diff: {a_b[0]} [{(a_f[0] or '?')[:12]}] -> "
+          f"{a_b[1]} [{(a_f[1] or '?')[:12]}] "
+          f"(provenance {diff['provenance'][0]} -> "
+          f"{diff['provenance'][1]})")
+    if not diff["comparable"]:
+        print("  NOTE: records key different trajectories "
+              "(bench/fingerprint differ) — ratios are apples/oranges")
+    for name, row in diff["metrics"].items():
+        ratio = row.get("ratio")
+        tail = f"  (x{ratio:.3f})" if ratio is not None else ""
+        print(f"  {name:<34} {row['a']!r:>16} -> {row['b']!r:>16}{tail}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m stencil_tpu.observatory",
+        description="performance observatory tools: validate bench "
+                    "ledgers and flight-recorder dumps, backfill "
+                    "legacy BENCH_*.json history, diff records, gate "
+                    "regressions, replay incidents")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_val = sub.add_parser("validate", help="schema-check a ledger or "
+                                            "flight dump")
+    p_val.add_argument("path")
+
+    p_bf = sub.add_parser("backfill", help="convert legacy BENCH_*.json"
+                                           " into ledger records")
+    p_bf.add_argument("files", nargs="+")
+    p_bf.add_argument("--out", required=True, metavar="LEDGER",
+                      help="ledger JSONL to append the records to")
+
+    p_diff = sub.add_parser("diff", help="compare two bench records")
+    p_diff.add_argument("a")
+    p_diff.add_argument("b", nargs="?", default=None)
+    p_diff.add_argument("--bench", default=None,
+                        help="single-ledger mode: diff this bench's "
+                             "newest group instead of the newest "
+                             "record's")
+
+    p_gate = sub.add_parser("gate", help="fail on same-fingerprint "
+                                         "steps/s regressions")
+    p_gate.add_argument("ledger")
+    p_gate.add_argument("--threshold", type=float, default=0.2,
+                        help="max tolerated relative steps/s drop "
+                             "(default 0.2)")
+    p_gate.add_argument("--bench", default=None,
+                        help="gate only this bench id")
+    p_gate.add_argument("--include-legacy", action="store_true",
+                        help="also gate provenance=legacy records")
+
+    p_rep = sub.add_parser("replay", help="render a flight dump's "
+                                          "incident timeline")
+    p_rep.add_argument("dump")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "validate":
+        from .ledger import validate_ledger
+        from .recorder import validate_dump
+        try:
+            kind, payload = _load_any(args.path)
+        except (OSError, ValueError) as e:
+            print(f"observatory: cannot load {args.path}: {e}",
+                  file=sys.stderr)
+            return 2
+        problems = (validate_dump(payload) if kind == "dump"
+                    else validate_ledger(payload))
+        for p in problems:
+            print(f"  BAD  {p}")
+        if problems:
+            print(f"observatory: {kind} {args.path}: "
+                  f"{len(problems)} problem(s)")
+            return 1
+        n = len(payload["events"]) if kind == "dump" else len(payload)
+        what = ("flight dump" if kind == "dump"
+                else f"ledger ({n} record(s))")
+        print(f"observatory: {args.path} OK ({what})")
+        return 0
+
+    if args.cmd == "backfill":
+        from .ledger import append_record, backfill_files
+        try:
+            records, skipped = backfill_files(args.files)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"observatory: backfill failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+        for rec in records:
+            append_record(args.out, rec)
+        for s in skipped:
+            print(f"  SKIP {s}")
+        print(f"observatory: backfilled {len(records)} record(s) from "
+              f"{len(args.files)} file(s) into {args.out}"
+              + (f" ({len(skipped)} skipped)" if skipped else ""))
+        return 0
+
+    if args.cmd == "diff":
+        from .ledger import diff_records, group_records
+        try:
+            _, recs_a = _load_any(args.a)
+        except (OSError, ValueError) as e:
+            print(f"observatory: cannot load {args.a}: {e}",
+                  file=sys.stderr)
+            return 2
+        if args.b is not None:
+            try:
+                _, recs_b = _load_any(args.b)
+            except (OSError, ValueError) as e:
+                print(f"observatory: cannot load {args.b}: {e}",
+                      file=sys.stderr)
+                return 2
+            if not recs_a or not recs_b:
+                print("observatory: nothing to diff", file=sys.stderr)
+                return 2
+            _print_diff(diff_records(recs_a[-1], recs_b[-1]))
+            return 0
+        groups = group_records(recs_a)
+        if args.bench is not None:
+            groups = {k: g for k, g in groups.items()
+                      if k[1] == args.bench}
+        pairs = [g for g in groups.values() if len(g) >= 2]
+        if not pairs:
+            print("observatory: no (fingerprint, bench) group has two "
+                  "records to diff", file=sys.stderr)
+            return 2
+        # the group whose newest record is newest overall
+        group = max(pairs, key=lambda g: g[-1].get("created", 0.0))
+        _print_diff(diff_records(group[-2], group[-1]))
+        return 0
+
+    if args.cmd == "gate":
+        from .ledger import (PROVENANCES, gate_regressions, read_ledger,
+                             validate_ledger)
+        try:
+            records = read_ledger(args.ledger)
+        except (OSError, ValueError) as e:
+            print(f"observatory: cannot load {args.ledger}: {e}",
+                  file=sys.stderr)
+            return 2
+        problems = validate_ledger(records)
+        if problems:
+            for p in problems:
+                print(f"  BAD  {p}")
+            print(f"observatory: ledger {args.ledger} is invalid — "
+                  f"fix it before gating")
+            return 2
+        prov = PROVENANCES if args.include_legacy else ("measured",)
+        failures = gate_regressions(records,
+                                    threshold=args.threshold,
+                                    provenances=prov, bench=args.bench)
+        for f in failures:
+            print(f"  REGRESSION  {f}")
+        if failures:
+            print(f"observatory: gate FAILED "
+                  f"({len(failures)} regression(s))")
+            return 1
+        print(f"observatory: gate OK ({len(records)} record(s), "
+              f"threshold {100 * args.threshold:.0f}%)")
+        return 0
+
+    # replay
+    from .recorder import render_timeline, validate_dump
+    problems = validate_dump(args.dump)
+    if problems:
+        for p in problems:
+            print(f"  BAD  {p}")
+        print(f"observatory: dump {args.dump}: "
+              f"{len(problems)} problem(s)")
+        return 1
+    sys.stdout.write(render_timeline(args.dump))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

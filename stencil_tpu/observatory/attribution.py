@@ -1,0 +1,277 @@
+"""Model-vs-measured attribution: what did the dispatch actually cost?
+
+The calibrated cost model (``analysis/costmodel.configured_step_seconds``
+for halo sweeps, ``migration_step_seconds`` for PIC) predicts what a
+step *should* cost; :class:`PerfAttributor` measures what the shipped
+dispatch *achieves* — wall seconds around the dispatch, fenced by
+``jax.block_until_ready``, amortized over the segment's ``k`` steps —
+and exports the ratio as ``stencil_perf_model_error_ratio{entry,
+method,s}`` gauges next to achieved-vs-modeled bytes/s.
+
+Attribution is strictly HOST-side: the dispatched program is returned
+unchanged by :meth:`PerfAttributor.attributed` (an identity the
+``observatory.attribution.*`` registry targets pin — same HLO, same
+collective bill, same compile fingerprint as the uninstrumented entry
+point; a timer that sneaks a host callback into the step is the
+negative control, ``tests/fixtures/lint/bad_attribution.py``).
+
+Drift detection: the raw error ratio absorbs everything the wire model
+deliberately does not price (compute, dispatch overhead, the host
+loop), so its absolute value is platform-shaped. What IS actionable is
+a *departure*: the first observation calibrates a reference ratio —
+which then stays FIXED until :meth:`~PerfAttributor.reset` (a moving
+reference would chase a gradual slowdown and never flag it) — and
+``window`` (K) consecutive observations whose ratio deviates from that
+reference by more than ``tolerance`` (relative) raise one ``perf_drift``
+event (v1 telemetry schema) and fire ``on_drift`` — which, when the
+resilience policy opts in (``retune_on_drift``), invalidates the
+plan-cache record so the tuner re-measures. A re-tuned or rebuilt plan
+calls :meth:`reset`, which clears the gauge and re-arms the detector.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, Optional
+
+#: measured/modeled seconds-per-step of attributed dispatches
+METRIC_MODEL_ERROR_RATIO = "stencil_perf_model_error_ratio"
+#: wire bytes/s the dispatch actually achieved (model bytes / measured s)
+METRIC_ACHIEVED_BYTES_PER_S = "stencil_perf_achieved_bytes_per_s"
+#: wire bytes/s the calibrated model promises (model bytes / model s)
+METRIC_MODELED_BYTES_PER_S = "stencil_perf_modeled_bytes_per_s"
+
+
+def make_drift_invalidator(cache_path, log: Callable) -> Callable:
+    """The ``on_drift`` hook the driver and the service share when
+    their policy opts into ``retune_on_drift``: drop the drifted
+    plan's cache record (:func:`stencil_tpu.tuning.invalidate_plan`)
+    so the next tune re-measures, and log ``plan_invalidated`` through
+    the caller's versioned event front end (``log(kind, **attrs)``)."""
+    def on_drift(attrs: Dict) -> None:
+        fp = attrs.get("fingerprint")
+        if not fp:
+            return
+        from ..tuning.cache import invalidate_plan
+        removed = invalidate_plan(fp, cache_path)
+        log("plan_invalidated", fingerprint=fp, removed=bool(removed))
+    return on_drift
+
+
+def model_step_seconds_for(dd) -> Optional[float]:
+    """The calibrated cost-model prediction of exchange seconds per
+    STEP for ``dd``'s active configuration: ``configured_step_seconds``
+    with the tuned plan's fitted alpha-beta coefficients when the
+    domain carries one (bottleneck combination across link classes, the
+    same convention the tuner ranks with), the assumed ICI defaults
+    otherwise. Returns None when the domain has no price — unsharded
+    mesh (zero wire traffic), unrealized domain, or a geometry the
+    model cannot host — so callers can disable attribution instead of
+    dividing by zero. Never raises."""
+    try:
+        from ..analysis.costmodel import (DEFAULT_ICI_COEFFS,
+                                          LinkCoefficients,
+                                          configured_step_seconds)
+        from ..parallel.mesh import mesh_dim
+        from ..parallel.methods import pick_method
+
+        method = pick_method(dd.methods).name
+        counts = mesh_dim(dd.mesh)
+        local = dd.local_size
+        elem_sizes = tuple(dd._dtypes[q].itemsize for q in dd._names)
+        coeffs = DEFAULT_ICI_COEFFS
+        plan = getattr(dd, "plan", None)
+        if plan is not None and getattr(plan, "coefficients", None):
+            coeffs = LinkCoefficients(
+                alpha_s=max(c["alpha_s"]
+                            for c in plan.coefficients.values()),
+                beta_bytes_per_s=min(c["beta_bytes_per_s"]
+                                     for c in plan.coefficients.values()))
+        groups = len({str(dd._dtypes[q]) for q in dd._names})
+        model = configured_step_seconds(
+            method, (local.z, local.y, local.x), dd.radius, counts,
+            elem_sizes, int(dd.exchange_every), coeffs, groups)
+        return model if model > 0.0 else None
+    except Exception:  # noqa: BLE001 - no price -> attribution off
+        return None
+
+
+class PerfAttributor:
+    """Measured-vs-modeled attribution for one dispatch entry point.
+
+    ``entry``/``method``/``exchange_every`` become the stable
+    ``{entry,method,s}`` labels of the exported gauges.
+    ``model_step_seconds`` is the calibrated prediction the
+    measurements are paired against (falsy disables the attributor —
+    :attr:`enabled` — so unpriceable configurations cost nothing).
+    ``emit(kind, **attrs)`` receives the ``perf_drift`` event (wire it
+    to a versioned :class:`~stencil_tpu.telemetry.EventLog` front end
+    like ``ResilienceReport.log``); ``on_drift(attrs)`` fires once per
+    drift episode (plan-cache invalidation hook)."""
+
+    def __init__(self, entry: str, method: str = "", exchange_every: int = 1,
+                 model_step_seconds: Optional[float] = None,
+                 model_bytes_per_step: float = 0.0,
+                 tolerance: float = 0.5, window: int = 3,
+                 warmup: int = 0,
+                 emit: Optional[Callable] = None,
+                 on_drift: Optional[Callable[[Dict], None]] = None,
+                 fingerprint: Optional[str] = None,
+                 registry=None,
+                 clock: Callable[[], float] = time.perf_counter) -> None:
+        if float(tolerance) <= 0:
+            raise ValueError(f"tolerance must be > 0, got {tolerance}")
+        if int(window) < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.entry = str(entry)
+        self.method = str(method)
+        self.exchange_every = int(exchange_every)
+        self.model_step_seconds = (float(model_step_seconds)
+                                   if model_step_seconds else 0.0)
+        self.model_bytes_per_step = float(model_bytes_per_step)
+        self.tolerance = float(tolerance)
+        self.window = int(window)
+        #: observations to EXCLUDE from drift calibration (gauges still
+        #: export): the driver/service pass 1 because their first
+        #: dispatch pays XLA compilation — calibrating the reference
+        #: ratio on a compile-contaminated window would make every
+        #: later (faster) segment look like drift
+        self._warmup = max(int(warmup), 0)
+        self.fingerprint = fingerprint
+        self._emit = emit
+        self._on_drift = on_drift
+        self._clock = clock
+        if registry is None:
+            from ..telemetry import get_registry
+            registry = get_registry()
+        self._g_ratio = registry.gauge(
+            METRIC_MODEL_ERROR_RATIO,
+            "measured/modeled seconds-per-step of attributed dispatches "
+            "(block_until_ready-fenced, amortized over the segment's k "
+            "steps); 0 = not yet observed / reset after a re-tune")
+        self._g_achieved = registry.gauge(
+            METRIC_ACHIEVED_BYTES_PER_S,
+            "wire B/s the attributed dispatch actually achieved "
+            "(modeled bytes over measured seconds)")
+        self._g_modeled = registry.gauge(
+            METRIC_MODELED_BYTES_PER_S,
+            "wire B/s the calibrated cost model promises for the "
+            "active plan")
+        self.last_ratio: Optional[float] = None
+        self._baseline: Optional[float] = None
+        self._streak = 0
+        self._drifted = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.model_step_seconds > 0.0
+
+    def labels(self) -> Dict[str, str]:
+        return {"entry": self.entry, "method": self.method,
+                "s": str(self.exchange_every)}
+
+    # -- the honesty contract -------------------------------------------
+    @staticmethod
+    def attributed(fn):
+        """The program the attributor dispatches — the caller's ``fn``,
+        UNCHANGED. Attribution is a wall clock around the dispatch,
+        never an edit of the compiled program; the
+        ``observatory.attribution.*`` registry targets lower what this
+        returns and pin it to the uninstrumented entry point's exact
+        collective bill, byte model, and compile fingerprint. Any
+        future attribution scheme that wraps the program (and would
+        therefore change its HLO) breaks those targets loudly."""
+        return fn
+
+    # -- measurement ----------------------------------------------------
+    @contextlib.contextmanager
+    def dispatch(self, k: int, block: Callable[[], None],
+                 step: Optional[int] = None):
+        """Time one dispatch advancing ``k`` steps: the wall clock runs
+        from entry to after ``block()`` (``jax.block_until_ready`` on
+        the live state — async dispatch must not be credited with the
+        seconds it merely deferred), then :meth:`observe` attributes
+        the measurement. Disabled attributors pass straight through."""
+        if not self.enabled:
+            yield self
+            return
+        t0 = self._clock()
+        yield self
+        block()
+        self.observe(k, self._clock() - t0, step=step)
+
+    def observe(self, k: int, seconds: float,
+                step: Optional[int] = None) -> Optional[Dict]:
+        """Attribute one measured dispatch of ``k`` steps taking
+        ``seconds``: export the gauges, run the drift detector, and
+        return the ``perf_drift`` attrs when this observation fired a
+        drift (None otherwise)."""
+        if not self.enabled:
+            return None
+        measured = float(seconds) / max(int(k), 1)
+        ratio = measured / self.model_step_seconds
+        self.last_ratio = ratio
+        labels = self.labels()
+        self._g_ratio.set(ratio, **labels)
+        if self.model_bytes_per_step > 0.0 and measured > 0.0:
+            self._g_achieved.set(self.model_bytes_per_step / measured,
+                                 **labels)
+            self._g_modeled.set(
+                self.model_bytes_per_step / self.model_step_seconds,
+                **labels)
+        if self._warmup > 0:
+            self._warmup -= 1  # compile-contaminated: export, don't
+            return None        # calibrate or count toward drift
+        if not self._baseline:
+            # first usable observation calibrates; a degenerate zero
+            # ratio (fake clocks) cannot anchor a relative comparison,
+            # so calibration waits for a nonzero one
+            self._baseline = ratio
+            return None
+        # the reference stays FIXED until reset(): a baseline that
+        # chased the ratio (EWMA) would let boiling-frog degradations
+        # — thermal throttling, a slowly failing link — walk the
+        # reference along and never register as drift, which is
+        # exactly the failure class this detector exists to catch
+        rel = abs(ratio - self._baseline) / self._baseline
+        if rel <= self.tolerance:
+            self._streak = 0
+            self._drifted = False
+            return None
+        self._streak += 1
+        if self._streak < self.window or self._drifted:
+            return None
+        self._drifted = True
+        attrs: Dict = {
+            "entry": self.entry, "method": self.method,
+            "s": self.exchange_every, "ratio": ratio,
+            "baseline": self._baseline, "consecutive": self._streak,
+            "tolerance": self.tolerance, "window": self.window,
+        }
+        if step is not None:
+            attrs["step"] = int(step)
+        if self.fingerprint:
+            attrs["fingerprint"] = self.fingerprint
+        if self._emit is not None:
+            self._emit("perf_drift", **attrs)
+        if self._on_drift is not None:
+            self._on_drift(dict(attrs))
+        return attrs
+
+    def reset(self, model_step_seconds: Optional[float] = None,
+              fingerprint: Optional[str] = None) -> None:
+        """A re-tuned (or rebuilt) plan supersedes everything observed
+        under the old one: clear the error-ratio gauge back to the
+        not-yet-observed 0, drop the calibrated reference, and re-arm
+        the drift latch. Pass the new model price / fingerprint when
+        they changed."""
+        if model_step_seconds is not None:
+            self.model_step_seconds = float(model_step_seconds)
+        if fingerprint is not None:
+            self.fingerprint = fingerprint
+        self._g_ratio.set(0.0, **self.labels())
+        self.last_ratio = None
+        self._baseline = None
+        self._streak = 0
+        self._drifted = False

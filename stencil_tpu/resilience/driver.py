@@ -29,6 +29,7 @@ event log — the CI chaos-smoke artifact.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -76,6 +77,28 @@ class ResiliencePolicy:
     # location; the segment's final step is always probed)
     fuse_segments: bool = True
     probe_every: int = 1
+    # performance observatory (observatory/attribution.py): pair every
+    # dispatch's measured seconds/step (block_until_ready-fenced,
+    # amortized over the segment's k steps) against the calibrated
+    # cost-model prediction of the active plan, exported as
+    # stencil_perf_model_error_ratio{entry,method,s}. After
+    # drift_window consecutive segments whose ratio departs from its
+    # calibrated reference by more than drift_tolerance (relative), a
+    # perf_drift event is emitted; retune_on_drift additionally
+    # invalidates the plan-cache record (plan_cache_path, default
+    # cache) so the tuner re-measures — stale plans heal themselves
+    attribute_perf: bool = True
+    drift_tolerance: float = 0.5
+    drift_window: int = 3
+    retune_on_drift: bool = False
+    plan_cache_path: Optional[str] = None
+    # flight recorder (observatory/recorder.py): bounded black box
+    # (recent events + spans + metrics + probe history) dumped
+    # atomically into this directory on sentinel trip, degradation,
+    # SIGTERM preemption (before the preemption checkpoint), and
+    # unhandled dispatch error; None falls back to
+    # $STENCIL_FLIGHT_RECORDER_DIR, empty/unset disarms
+    flight_recorder_dir: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -173,7 +196,8 @@ class _ResilientRun:
     def __init__(self, dd, step_fn, n_steps, policy, ckpt_dir, faults,
                  rebuild, extra_fn, on_restore, fields_fn,
                  pre_checkpoint, make_segment=None,
-                 sentinel_factory=None):
+                 sentinel_factory=None, model_step_seconds=None,
+                 model_bytes_per_step=None, perf_entry=None):
         self.dd = dd
         self.step_fn = step_fn
         self.n_steps = int(n_steps)
@@ -255,6 +279,35 @@ class _ResilientRun:
                   self._m_save_retries, self._m_checkpoints,
                   self._m_degradations):
             c.inc(0)
+        # performance observatory: model-vs-measured attribution of
+        # every dispatch (observatory/attribution.py) and the bounded
+        # flight recorder (observatory/recorder.py). The attributed
+        # program is the SAME compiled fn — attribution is a host-side
+        # wall clock; the observatory.attribution.* registry targets
+        # pin the HLO identity
+        self._perf_entry = perf_entry or "resilience"
+        self._model_step_seconds = model_step_seconds
+        self._model_bytes_per_step = model_bytes_per_step
+        self.attributor = (self._make_attributor()
+                           if self.policy.attribute_perf else None)
+        #: stepwise attribution window (accumulated dispatch seconds +
+        #: base step): the stepwise loop attributes one
+        #: check_every-sized WINDOW per observation — only step_fn
+        #: dispatch time plus ONE fence at the health boundary is
+        #: timed (blocking checkpoint saves, probe polls, and fault
+        #: host work between steps are excluded, and the async-
+        #: readback design of the stepwise loop survives attribution)
+        self._att_window_s = 0.0
+        self._att_window_base = None
+        from ..observatory.recorder import ENV_FLIGHT_DIR, FlightRecorder
+        self._flight_dir = (self.policy.flight_recorder_dir
+                            or os.environ.get(ENV_FLIGHT_DIR) or None)
+        self.flight = None
+        if self._flight_dir:
+            self.flight = FlightRecorder(run_id=self.report.run_id,
+                                         registry=reg,
+                                         tracer=self._tracer)
+            self.report.add_sink(self.flight)
 
     def _make_sentinel(self, dd,
                        rebase_step: Optional[int] = None,
@@ -289,6 +342,69 @@ class _ResilientRun:
         return HealthSentinel(dd, window=self.policy.window,
                               growth_factor=self.policy.growth_factor,
                               metrics=self._step_metrics)
+
+    # -- performance observatory ----------------------------------------
+    def _make_attributor(self):
+        """A :class:`~stencil_tpu.observatory.PerfAttributor` for the
+        CURRENT engine configuration, or None when no calibrated price
+        exists (unsharded mesh, unpriceable geometry). The model price
+        is the caller's override (PIC passes its migration+sweep
+        figure) on the first build; a degradation rebuild re-derives
+        from the rebuilt domain — the old figure priced a dead
+        configuration."""
+        from ..observatory.attribution import (PerfAttributor,
+                                               model_step_seconds_for)
+        model = self._model_step_seconds
+        if model is None:
+            model = model_step_seconds_for(self.dd)
+        if not model:
+            return None
+        cfg = _current_config(self.dd)
+        plan = getattr(self.dd, "plan", None)
+        p = self.policy
+        nbytes = self._model_bytes_per_step
+        if nbytes is None:
+            nbytes = (self._step_metrics.bytes_per_step
+                      if getattr(self, "_step_metrics", None) is not None
+                      else 0.0)
+        return PerfAttributor(
+            entry=self._perf_entry, method=cfg.method.name,
+            exchange_every=cfg.exchange_every,
+            model_step_seconds=model,
+            model_bytes_per_step=float(nbytes),
+            tolerance=p.drift_tolerance, window=p.drift_window,
+            warmup=1,  # the first dispatch pays XLA compilation
+            emit=self.report.log,
+            on_drift=(self._on_perf_drift if p.retune_on_drift
+                      else None),
+            fingerprint=(plan.fingerprint if plan is not None else None))
+
+    def _on_perf_drift(self, attrs: Dict) -> None:
+        """``retune_on_drift``: the plan whose prediction the machine
+        stopped matching is stale evidence — drop its plan-cache record
+        so the next tune re-measures instead of serving the hit
+        (shared hook: ``observatory.make_drift_invalidator``)."""
+        from ..observatory.attribution import make_drift_invalidator
+        make_drift_invalidator(self.policy.plan_cache_path,
+                               self.report.log)(attrs)
+
+    def _block_fields(self) -> None:
+        import jax
+
+        jax.block_until_ready(self._fields())
+
+    def _attributed(self, k: int):
+        """The timing context for one dispatch of ``k`` steps (a
+        no-op when attribution is off/unpriceable)."""
+        if self.attributor is None:
+            return contextlib.nullcontext()
+        return self.attributor.dispatch(k, self._block_fields,
+                                        step=self.step + k)
+
+    def _flight_dump(self, reason: str, **attrs) -> Optional[str]:
+        from ..observatory.recorder import safe_dump
+        return safe_dump(self.flight, self._flight_dir, reason,
+                         step=self.step, **attrs)
 
     # -- helpers --------------------------------------------------------
     def _fields(self):
@@ -402,6 +518,9 @@ class _ResilientRun:
         IS the model-exact byte price — the costmodel checker pins it
         against HLO); after a degradation the probe figure is the
         campaign-average across the configurations actually run."""
+        if self.flight is not None:
+            for stats in results:
+                self.flight.record_probe(stats.to_record())
         if self._step_metrics is None:
             return
         for stats in results:
@@ -429,6 +548,9 @@ class _ResilientRun:
                 self.dd, rebase_step=step, prev=pre_degrade)
         self.sentinel.reset()
         self._last_clean_health = None
+        # a rolled-back window is replay, not fresh progress: never
+        # attribute wall time that spans the restore
+        self._att_window_base = None
         self.report.log("restored", step=step)
 
     def _handle_trip(self, tripped: List[HealthStats]) -> None:
@@ -460,6 +582,10 @@ class _ResilientRun:
         self.policy.sleep(self.policy.base_delay
                           * (2 ** max(self.attempts - 1, 0)))
         self._restore()
+        # the black box captures the WHOLE incident — trip, any
+        # degradation, and the rollback it resolved into
+        self._flight_dump("sentinel_trip", trip_step=stats.step,
+                          trip_reason=stats.reason)
 
     def _degrade_or_die(self, stats: HealthStats) -> None:
         if self.ladder is None:
@@ -522,6 +648,14 @@ class _ResilientRun:
             self.report.degradations.append(cfg.key())
             self._m_degradations.inc()
             self.report.log("degraded", config=cfg.key())
+            if self.attributor is not None:
+                # the degraded engine has a new model price and labels;
+                # the caller's override (if any) priced the dead config
+                self._model_step_seconds = None
+                self._model_bytes_per_step = None
+                self.attributor = self._make_attributor()
+                self._att_window_base = None
+            self._flight_dump("degraded", config=cfg.key())
             return
         raise ResilienceError(
             f"retries exhausted ({self.policy.max_retries}) at "
@@ -563,13 +697,23 @@ class _ResilientRun:
             return False
         base = self.step
         with self._tracer.span("megastep", steps=k, step=base):
-            # the hot-loop dataflow contract, enforced at runtime: the
-            # fused dispatch moves NOTHING implicitly between host and
-            # device (the probe trace stays on device, the metric base
-            # vec is an explicit replicated device_put) — see
-            # analysis/transfer.py; STENCIL_ALLOW_TRANSFERS=1 opts out
-            with hot_loop_transfer_guard():
-                trace = seg.run(base)
+            # one Perfetto box per COMPILED PROGRAM (the megastep span
+            # also covers guard/bookkeeping overhead around it), timed
+            # by the attributor — model-vs-measured attribution is a
+            # host wall clock; the dispatched program is unchanged
+            with self._tracer.span("segment.dispatch", k=k,
+                                   check_every=self.policy.check_every,
+                                   entry=self._perf_entry):
+                with self._attributed(k):
+                    # the hot-loop dataflow contract, enforced at
+                    # runtime: the fused dispatch moves NOTHING
+                    # implicitly between host and device (the probe
+                    # trace stays on device, the metric base vec is an
+                    # explicit replicated device_put) — see
+                    # analysis/transfer.py; STENCIL_ALLOW_TRANSFERS=1
+                    # opts out
+                    with hot_loop_transfer_guard():
+                        trace = seg.run(base)
         if self._compile_guard is not None:
             self._compile_guard.observe(seg.fn, "megastep segment")
         self.step += k
@@ -580,9 +724,17 @@ class _ResilientRun:
 
     # -- the loop -------------------------------------------------------
     def run(self) -> ResilienceReport:
-        with self._tracer.span("resilience.run", run=self.report.run_id,
-                               n_steps=self.n_steps):
-            return self._run()
+        try:
+            with self._tracer.span("resilience.run",
+                                   run=self.report.run_id,
+                                   n_steps=self.n_steps):
+                return self._run()
+        except Exception as e:
+            # unhandled dispatch/recovery error: the black box is the
+            # post-mortem (the raise still propagates unchanged)
+            self._flight_dump("unhandled_error",
+                              error=f"{type(e).__name__}: {e}")
+            raise
 
     def _run(self) -> ResilienceReport:
         policy = self.policy
@@ -610,6 +762,10 @@ class _ResilientRun:
                 self._poll_pending_save()
                 if self._preempt:
                     self._flush_pending_save()
+                    # black box BEFORE the preemption checkpoint: if
+                    # the final save itself dies, the incident record
+                    # already exists on disk
+                    self._flight_dump("preempt")
                     if self.ckpt_dir is not None:
                         # same invariant as periodic checkpoints:
                         # poisoned state must never be persisted — if
@@ -664,10 +820,33 @@ class _ResilientRun:
                         if self._preempt:
                             continue  # SIGTERM landed at the boundary
                 else:
-                    self.step_fn()
+                    att = self.attributor
+                    if att is not None:
+                        if self._att_window_base is None:
+                            self._att_window_base = self.step
+                            self._att_window_s = 0.0
+                        t0 = time.perf_counter()
+                        self.step_fn()
+                        self._att_window_s += time.perf_counter() - t0
+                    else:
+                        self.step_fn()
                     self.step += 1
                     self.report.steps = self.step
                     self._m_steps.inc()
+                    if att is not None \
+                            and self.step % policy.check_every == 0:
+                        # boundary-amortized: the accumulated step
+                        # dispatch time plus ONE fence per check_every
+                        # window (the fused path's k-step
+                        # amortization, mirrored) — never a fence per
+                        # step, and never the saves/probes/fault host
+                        # work that run between steps
+                        t0 = time.perf_counter()
+                        self._block_fields()
+                        self._att_window_s += time.perf_counter() - t0
+                        att.observe(self.step - self._att_window_base,
+                                    self._att_window_s, step=self.step)
+                        self._att_window_base = None
                     if self.faults is not None:
                         # faults hit the LIVE fields — the same dict
                         # the sentinel probes (interior-resident fast
@@ -738,7 +917,10 @@ def run_resilient(dd, step_fn: Callable[[], None], n_steps: int,
                   fields_fn: Optional[Callable[[], Dict]] = None,
                   pre_checkpoint: Optional[Callable[[], None]] = None,
                   make_segment: Optional[Callable] = None,
-                  sentinel_factory: Optional[Callable] = None
+                  sentinel_factory: Optional[Callable] = None,
+                  model_step_seconds: Optional[float] = None,
+                  model_bytes_per_step: Optional[float] = None,
+                  perf_entry: Optional[str] = None
                   ) -> ResilienceReport:
     """Drive ``step_fn`` for ``n_steps`` steps with health sentinels,
     periodic integrity-checked checkpoints, rollback-retry recovery,
@@ -773,10 +955,21 @@ def run_resilient(dd, step_fn: Callable[[], None], n_steps: int,
     supply one; telemetry step-metrics riding is then the factory's
     responsibility.
 
+    ``model_step_seconds``/``model_bytes_per_step``/``perf_entry``:
+    the performance observatory's attribution inputs — the calibrated
+    cost-model prediction of seconds/step and modeled wire B/step
+    (models whose wire bill the generic exchange model cannot see,
+    like PIC's migration ring, pass their own; None derives both from
+    ``dd``) and the ``entry`` label of the exported
+    ``stencil_perf_model_error_ratio{entry,method,s}`` gauges.
+
     Returns a :class:`ResilienceReport`; if it says ``preempted``,
     rerun with the same ``ckpt_dir`` to resume. If a run was previously
     preempted mid-campaign, the same call resumes it automatically."""
     return _ResilientRun(dd, step_fn, n_steps, policy, ckpt_dir, faults,
                          rebuild, extra_fn, on_restore, fields_fn,
                          pre_checkpoint, make_segment=make_segment,
-                         sentinel_factory=sentinel_factory).run()
+                         sentinel_factory=sentinel_factory,
+                         model_step_seconds=model_step_seconds,
+                         model_bytes_per_step=model_bytes_per_step,
+                         perf_entry=perf_entry).run()

@@ -42,6 +42,7 @@ in-process counter block.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -63,6 +64,14 @@ from .queue import CampaignRequest, RequestQueue
 
 class CampaignFailed(RuntimeError):
     """A campaign exhausted its per-tenant retry budget."""
+
+
+def _block_state(eng) -> None:
+    """Fence the ensemble's live state (the attribution clock must not
+    credit async dispatch with seconds it merely deferred)."""
+    import jax
+
+    jax.block_until_ready(eng.state)
 
 
 @dataclasses.dataclass
@@ -125,7 +134,11 @@ class CampaignService:
                  window: int = 8, growth_factor: float = 1e6,
                  max_to_keep: int = 3, events_capacity: int = 4096,
                  run_id: Optional[str] = None, registry=None,
-                 tracer=None, fuse_segments: bool = True) -> None:
+                 tracer=None, fuse_segments: bool = True,
+                 flight_recorder_dir: Optional[str] = None,
+                 attribute_perf: bool = True,
+                 drift_tolerance: float = 0.5, drift_window: int = 3,
+                 retune_on_drift: bool = False) -> None:
         if int(width) < 1:
             raise ValueError(f"width must be >= 1, got {width}")
         #: megastep mode (default): each batch segment is ONE fused
@@ -172,6 +185,25 @@ class CampaignService:
             SingleCompileGuard()
             if os.environ.get(ASSERT_SINGLE_COMPILE_ENV) == "1"
             else None)
+        # performance observatory: per-engine model-vs-measured
+        # attribution (observatory/attribution.py — host wall clock,
+        # the dispatched program is unchanged) and the bounded flight
+        # recorder (observatory/recorder.py) dumped on sentinel trip,
+        # preemption, and unhandled batch errors
+        self._attribute = bool(attribute_perf)
+        self._drift_tolerance = float(drift_tolerance)
+        self._drift_window = int(drift_window)
+        self._retune_on_drift = bool(retune_on_drift)
+        self._attributors: Dict[str, object] = {}
+        from ..observatory.recorder import ENV_FLIGHT_DIR, FlightRecorder
+        self._flight_dir = (flight_recorder_dir
+                            or os.environ.get(ENV_FLIGHT_DIR) or None)
+        self.flight = None
+        if self._flight_dir:
+            self.flight = FlightRecorder(run_id=self.run_id,
+                                         registry=self.metrics,
+                                         tracer=self.tracer)
+            self._elog.add_sink(self.flight)
         self._preempt = False
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -350,6 +382,47 @@ class CampaignService:
         # events correlate with the enclosing telemetry span (if any)
         self._elog.emit(kind, span=self.tracer.current_span_id(), **kw)
 
+    def _flight_dump(self, reason: str, **attrs) -> None:
+        from ..observatory.recorder import safe_dump
+        safe_dump(self.flight, self._flight_dir, reason, **attrs)
+
+    def _make_attributor(self, eng):
+        """A :class:`~stencil_tpu.observatory.PerfAttributor` for one
+        cached engine, or None when its domain has no calibrated wire
+        price. Gauges land in THIS service's registry (labels
+        entry="service"); drift events flow through the service's
+        versioned event log."""
+        from ..observatory.attribution import (PerfAttributor,
+                                               model_step_seconds_for)
+        from ..parallel.methods import pick_method
+        model = model_step_seconds_for(eng.dd)
+        if not model:
+            return None
+        plan = getattr(eng.dd, "plan", None)
+        try:
+            nbytes = float(eng.dd.exchange_bytes_amortized_per_step())
+        except Exception:  # noqa: BLE001 - no byte model: B/s gauges off
+            nbytes = 0.0
+        return PerfAttributor(
+            entry="service", method=pick_method(eng.dd.methods).name,
+            exchange_every=int(eng.dd.exchange_every),
+            model_step_seconds=model, model_bytes_per_step=nbytes,
+            tolerance=self._drift_tolerance, window=self._drift_window,
+            warmup=1,  # the first segment dispatch pays compilation
+            emit=self._log, registry=self.metrics,
+            on_drift=(self._on_perf_drift if self._retune_on_drift
+                      else None),
+            fingerprint=(plan.fingerprint if plan is not None
+                         else None))
+
+    def _on_perf_drift(self, attrs: Dict) -> None:
+        """``retune_on_drift``: invalidate the drifted plan's cache
+        record so the next fingerprint-identical tune re-measures —
+        stale plans heal themselves (shared hook:
+        ``observatory.make_drift_invalidator``)."""
+        from ..observatory.attribution import make_drift_invalidator
+        make_drift_invalidator(self._plan_cache_path, self._log)(attrs)
+
     def _plan_for(self, fingerprint: str, req: CampaignRequest):
         """The exchange plan for a fingerprint: cache hit (zero
         measurements) or a one-time tune when a timer is configured
@@ -430,6 +503,10 @@ class CampaignService:
             self._m_recompiles.inc()
         self._built.add(key)
         self._m_engine_size.set(len(self._engines))
+        if self._attribute:
+            att = self._make_attributor(eng)
+            if att is not None:
+                self._attributors[key] = att
         return eng, True, plan
 
     def _admit_lane(self, eng, lane: _Lane) -> None:
@@ -506,6 +583,9 @@ class CampaignService:
                 f"{req.tenant}/{req.campaign}: retries exhausted "
                 f"({req.max_retries}) at step {lane.counter}: "
                 f"{reason}"))
+            self._flight_dump("campaign_failed", tenant=req.tenant,
+                              campaign=req.campaign,
+                              member=lane.index, trip_reason=reason)
             return
         with self.tracer.span("rollback", tenant=req.tenant,
                               member=lane.index):
@@ -516,6 +596,10 @@ class CampaignService:
         self._m_rollbacks.inc(tenant=req.tenant)
         self._log("rollback", tenant=req.tenant, campaign=req.campaign,
                   member=lane.index, restored_step=step)
+        # the black box captures trip AND rollback in one incident
+        self._flight_dump("sentinel_trip", tenant=req.tenant,
+                          campaign=req.campaign, member=lane.index,
+                          trip_step=lane.counter, trip_reason=reason)
 
     def _complete_lane(self, eng, lane: _Lane,
                        preempted: bool = False) -> None:
@@ -543,9 +627,16 @@ class CampaignService:
 
     def _run_batch(self, batch) -> None:
         fp = batch[0].fingerprint
-        with self.tracer.span("campaign.batch", fingerprint=fp,
-                              members=len(batch)):
-            self._serve_batch(batch)
+        try:
+            with self.tracer.span("campaign.batch", fingerprint=fp,
+                                  members=len(batch)):
+                self._serve_batch(batch)
+        except Exception as e:
+            # unhandled dispatch error: the black box is the
+            # post-mortem (the raise still propagates unchanged)
+            self._flight_dump("unhandled_error", fingerprint=fp,
+                              error=f"{type(e).__name__}: {e}")
+            raise
 
     def _serve_batch(self, batch) -> None:
         fp = batch[0].fingerprint
@@ -624,6 +715,9 @@ class CampaignService:
                 # preempted results — completion deactivates the lane
                 # and would silently drop them
                 poll_snapshots(block=True)
+                # black box BEFORE the preemption checkpoints: if a
+                # final save dies, the incident record already exists
+                self._flight_dump("preempt", fingerprint=fp)
                 for lane in lanes:
                     if lane.active:
                         eng.save_member(lane.ckpt_dir, lane.counter,
@@ -644,23 +738,35 @@ class CampaignService:
                 from ..parallel.megastep import MAX_UNROLL
                 seg = min(seg, MAX_UNROLL)
             trace = None
+            att = self._attributors.get(self._engine_key(fp, req0))
+            timed = (att.dispatch(seg, lambda: _block_state(eng))
+                     if att is not None else contextlib.nullcontext())
             with self.tracer.span("segment", steps=seg,
                                   fused=self._fuse):
                 if self._fuse:
-                    # megastep: the per-member probe trace rides the
-                    # same single dispatch (one all-reduce per row),
-                    # under the hot-loop transfer guard — nothing moves
+                    # one Perfetto box per COMPILED PROGRAM, timed by
+                    # the attributor (host wall clock — the dispatched
+                    # program is unchanged); the megastep runs the
+                    # per-member probe trace in the same single
+                    # dispatch (one all-reduce per row), under the
+                    # hot-loop transfer guard — nothing moves
                     # implicitly between host and device inside the
                     # fused dispatch (analysis/transfer.py;
                     # STENCIL_ALLOW_TRANSFERS=1 opts out)
-                    with hot_loop_transfer_guard():
-                        trace = eng.run_segment(seg)
+                    with self.tracer.span(
+                            "segment.dispatch", k=seg,
+                            check_every=int(req0.check_every),
+                            entry="service"):
+                        with timed:
+                            with hot_loop_transfer_guard():
+                                trace = eng.run_segment(seg)
                     if self._compile_guard is not None:
                         for name, fn in eng.jit_entry_points().items():
                             self._compile_guard.observe(
                                 fn, f"ensemble {name}")
                 else:
-                    eng.run(seg)
+                    with timed:
+                        eng.run(seg)
             n_active = 0
             for lane in lanes:
                 if lane.active:

@@ -43,7 +43,8 @@ from ..analysis.costmodel import (LinkCoefficients,
                                   configured_step_seconds,
                                   predict_exchange_every)
 from ..utils.logging import LOG_INFO
-from .cache import default_cache_path, load_plan, store_plan
+from .cache import (default_cache_path, invalidate_plan, load_plan,
+                    store_plan)
 from .fit import calibrate_link, coefficients_record, fit_alpha_beta
 from .measure import CountingTimer, FakeTimer, MeshTimer
 from .plan import (DEFAULT_DEPTHS, Candidate, MigrationCandidate, Plan,
@@ -58,7 +59,8 @@ __all__ = [
     "run_autotune", "candidate_space", "migration_candidate_space",
     "rank_migration_candidates", "calibrate_link",
     "fit_alpha_beta", "fingerprint", "fingerprint_inputs",
-    "default_cache_path", "load_plan", "store_plan", "DEFAULT_DEPTHS",
+    "default_cache_path", "load_plan", "store_plan", "invalidate_plan",
+    "DEFAULT_DEPTHS",
 ]
 
 

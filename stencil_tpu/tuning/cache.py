@@ -149,38 +149,82 @@ def _write_lock(p: Path):
                 os.close(fd)
 
 
+def _publish(p: Path, plans: Dict[str, Dict]) -> None:
+    """Atomically publish the plans table (tmp + rename — lock-free
+    readers never observe a torn file)."""
+    payload = {"schema": SCHEMA_VERSION, "plans": plans}
+    fd, tmp = tempfile.mkstemp(dir=str(p.parent),
+                               prefix=p.name, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+        os.replace(tmp, p)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _mutate_cache(p: Path, mutate) -> None:
+    """The ONE read-mutate-publish discipline both writers share:
+    under :func:`_write_lock` (so two concurrent workers touching
+    DIFFERENT fingerprints cannot drop each other's records), with
+    transient-I/O retry. ``mutate(plans)`` edits the table in place
+    and returns False to abandon the write (no-op mutation)."""
+
+    def mutate_once():
+        with _write_lock(p):
+            plans = load_cache(p)
+            if mutate(plans) is False:
+                return
+            _publish(p, plans)
+
+    retry(mutate_once, attempts=_RETRY_ATTEMPTS,
+          base_delay=_RETRY_BASE_DELAY, sleep=_RETRY_SLEEP)
+
+
 def store_plan(plan: Plan, path: Union[str, Path, None] = None) -> Path:
-    """Insert/replace ``plan`` under its fingerprint. The whole
-    read-merge-write runs under :func:`_write_lock`, so two concurrent
-    service workers storing DIFFERENT fingerprints cannot drop each
-    other's records; the publish itself stays an atomic tmp+rename so
-    lock-free readers never observe a torn file."""
+    """Insert/replace ``plan`` under its fingerprint (see
+    :func:`_mutate_cache` for the locking/publish discipline)."""
     p = _resolve(path)
     p.parent.mkdir(parents=True, exist_ok=True)
 
-    def merge_and_publish():
-        plans = load_cache(p)
+    def merge(plans):
         plans[plan.fingerprint] = plan.to_record()
-        payload = {"schema": SCHEMA_VERSION, "plans": plans}
-        fd, tmp = tempfile.mkstemp(dir=str(p.parent),
-                                   prefix=p.name, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(payload, f, indent=1, sort_keys=True)
-            os.replace(tmp, p)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
 
-    def write_once():
-        with _write_lock(p):
-            merge_and_publish()
-
-    retry(write_once, attempts=_RETRY_ATTEMPTS,
-          base_delay=_RETRY_BASE_DELAY, sleep=_RETRY_SLEEP)
+    _mutate_cache(p, merge)
     LOG_DEBUG(f"plan cache {p}: stored {plan.config.key()} under "
               f"{plan.fingerprint[:12]}...")
     return p
+
+
+def invalidate_plan(fingerprint: str,
+                    path: Union[str, Path, None] = None) -> bool:
+    """Drop the cached record for ``fingerprint`` so the next tune
+    re-measures — the performance observatory's drift healer
+    (``ResiliencePolicy.retune_on_drift``): a plan whose measured
+    behavior departed from its calibrated prediction is stale evidence
+    and must not keep serving cache hits. Same locking and atomic
+    publish as :func:`store_plan` (shared :func:`_mutate_cache`).
+    Returns True when a record was removed (False on a miss or an
+    absent cache file)."""
+    p = _resolve(path)
+    if not p.exists():
+        return False
+    removed = False
+
+    def drop(plans):
+        nonlocal removed
+        if fingerprint not in plans:
+            return False
+        del plans[fingerprint]
+        removed = True
+
+    _mutate_cache(p, drop)
+    if removed:
+        LOG_WARN(f"plan cache {p}: invalidated "
+                 f"{fingerprint[:12]}... (perf drift — the next tune "
+                 f"re-measures)")
+    return removed

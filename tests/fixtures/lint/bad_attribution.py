@@ -1,0 +1,63 @@
+"""Negative control for the observatory attribution contract: a timer
+that sneaks a host callback INTO the step program.
+
+Attribution must be a host wall clock AROUND the dispatch
+(``PerfAttributor.attributed`` returns the program unchanged — the
+``observatory.attribution.*`` registry targets pin the HLO identity).
+The tempting wrong implementation is to read the clock *inside* the
+compiled step via a callback, which serializes every dispatch on a
+host round-trip and changes the lowered program. Both spellings here —
+a ``pure_callback`` timestamp folded into the output and an
+``io_callback`` side-channel timer — must be flagged by the transfer
+checker (nonzero CLI exit).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from stencil_tpu.analysis.transfer import TransferSpec, TransferTarget
+
+
+def _arg():
+    return jax.ShapeDtypeStruct((8, 8), jnp.float32)
+
+
+def _pure_callback_timer_step() -> TransferSpec:
+    def host_clock():
+        return np.float32(time.perf_counter())
+
+    def step(x):
+        t = jax.pure_callback(
+            host_clock, jax.ShapeDtypeStruct((), jnp.float32))
+        y = x * 0.5
+        # "attribute" the step by folding the timestamp into the
+        # output so XLA cannot elide the callback
+        return y + 0.0 * t
+
+    return TransferSpec(fn=step, args=(_arg(),))
+
+
+def _io_callback_timer_step() -> TransferSpec:
+    samples = []
+
+    def record(t):
+        samples.append(float(t))
+
+    def step(x):
+        y = x * 0.5
+        jax.experimental.io_callback(record, None, y[0, 0],
+                                     ordered=True)
+        return y
+
+    return TransferSpec(fn=step, args=(_arg(),))
+
+
+TARGETS = [
+    TransferTarget("fixture.pure_callback_timer_in_step",
+                   _pure_callback_timer_step),
+    TransferTarget("fixture.io_callback_timer_in_step",
+                   _io_callback_timer_step),
+]

@@ -105,7 +105,11 @@ def test_hlo_registry_collective_permute_only():
               or "serving.ensemble.probe" in key
               or "models.pic.probe" in key
               or "telemetry." in key
-              or "parallel.megastep" in key):
+              or "parallel.megastep" in key
+              or "observatory.attribution" in key):
+            # (the observatory's attributed segment IS the megastep
+            # program — identical HLO is the whole point — so it
+            # carries the same one-reduce-per-probe-row contract)
             # the health sentinels' contract is different by design:
             # exactly ONE small all-reduce (pinned via exact_counts on
             # their HloSpecs; the ensemble probe batches per-member
@@ -457,7 +461,8 @@ def test_cli_only_accepts_target_globs(tmp_path):
                                      "bad_donation.py",
                                      "bad_transfer.py",
                                      "bad_recompile.py",
-                                     "bad_migration.py"])
+                                     "bad_migration.py",
+                                     "bad_attribution.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""
