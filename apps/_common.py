@@ -260,6 +260,14 @@ def emit_bench_artifacts(args, payload, source: str):
                                            provenance="measured",
                                            created=time.time())
         for rec in records:
+            # tiling-plan provenance: stamp the VMEM planner's
+            # prescribed block shapes onto the record AFTER the
+            # fingerprint is fixed — a provenance note (future real-TPU
+            # numbers group against the shapes that produced them),
+            # never a trajectory-group fork
+            if payload.get("tiling_plan"):
+                rec["config"].setdefault("tiling_plan",
+                                         payload["tiling_plan"])
             append_record(ledger, rec)
         for s in skipped:
             print(f"{source}: ledger skip: {s}", file=sys.stderr)

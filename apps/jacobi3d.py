@@ -10,6 +10,7 @@ bin/jacobi3d.cu:181-205); CSV result line
 
 import argparse
 import os
+import sys
 
 from _common import (KERNEL_CHOICES, add_bench_record_flags,
                      add_dcn_flags, add_device_flags, add_dtype_flags,
@@ -196,9 +197,26 @@ def main() -> None:
                    f"{stats.trimean() / args.batch:.6e}",
                    xstats["path"], int(xstats["bytes_per_iteration"]),
                    f"{ex_s:.6e}"))
+    # tiling-plan provenance for the ledger: when a Pallas kernel path
+    # ran, record the block shapes the VMEM planner prescribed for this
+    # shard geometry (observatory records then group real-TPU numbers
+    # against the shapes that produced them)
+    tiling_plan = None
+    if "xla" not in xstats["path"]:
+        try:
+            from stencil_tpu.parallel.mesh import mesh_dim
+            from stencil_tpu.tuning import (geometry_from_domain,
+                                            tiling_record)
+
+            tiling_plan = tiling_record(
+                geometry_from_domain(j.dd, mesh_dim(j.dd.mesh)))
+        except Exception as e:  # noqa: BLE001 — provenance best-effort
+            print(f"jacobi3d: tiling provenance unavailable: {e}",
+                  file=sys.stderr)
     emit_bench_artifacts(
         args,
         {"bench": "jacobi3d",
+         **({"tiling_plan": tiling_plan} if tiling_plan else {}),
          "config": {"grid": [gx, gy, gz], "devices": ndev,
                     "mesh": list(mesh_shape), "kernel": xstats["path"],
                     "methods": str(methods),

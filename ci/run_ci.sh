@@ -24,11 +24,13 @@ cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
 echo "== 1/12 lint (stencil-lint + ruff; tier=$TIER) =="
-# stencil-lint: all nine static checkers — halo-radius footprint, DMA
+# stencil-lint: all ten static checkers — halo-radius footprint, DMA
 # discipline, ppermute sanity, HLO collective-permute-only lowering,
-# analytic-vs-HLO byte cross-check, the Pallas VMEM/tiling audit, and
-# the dataflow trio (donation aliasing, host-transfer hygiene,
-# recompile-hazard fingerprints)
+# analytic-vs-HLO byte cross-check, the Pallas VMEM/tiling audit, the
+# dataflow trio (donation aliasing, host-transfer hygiene,
+# recompile-hazard fingerprints), and the prescriptive block-shape
+# tiling gate (every Pallas kernel at 256^3/512^3-per-device shapes
+# against the PHYSICAL VMEM budget — trace-only, no TPU)
 # (python -m stencil_tpu.analysis, see README "Static analysis").
 # The hlo/costmodel byte checks capability-gate themselves on the
 # image's JAX (StableHLO lowering support is probed; Pallas targets
@@ -47,6 +49,15 @@ fi
 if [ "$lint_rc" -ne 0 ]; then
   echo "stencil-lint failed (exit $lint_rc)"
   exit "$lint_rc"
+fi
+# the prescriptive tiling PLAN report (ranked legal block shapes /
+# named binding constraints for every registered Pallas kernel at the
+# production per-device shapes) — a CI artifact for real-TPU runs to
+# pick their shapes from; the audit itself already gated above
+python -m stencil_tpu.analysis --plan-tiling 'analysis.tiling.*' \
+  --json stencil_tiling_plans.json > /dev/null
+if [ -n "${CI_ARTIFACT_DIR:-}" ] && [ -f stencil_tiling_plans.json ]; then
+  cp stencil_tiling_plans.json "$CI_ARTIFACT_DIR/"
 fi
 # registry-count ratchet: audit coverage may only grow. A refactor
 # that drops targets (deregisters an entry point, deletes a checker

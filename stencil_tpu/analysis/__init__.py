@@ -1,7 +1,7 @@
 """stencil-lint / stencil-audit: static invariant checking for the
 stencil framework.
 
-Nine checkers prove, WITHOUT executing anything (jaxpr tracing plus
+Ten checkers prove, WITHOUT executing anything (jaxpr tracing plus
 lower-only StableHLO inspection and alias-map parsing of compiled —
 never dispatched — programs; seconds on any CPU box, no TPU, no
 interpreter), the invariants the whole framework hangs on:
@@ -32,7 +32,15 @@ interpreter), the invariants the whole framework hangs on:
 * :mod:`.recompile`   — every entry point's abstract fingerprint is
   dispatch-stable: no Python-scalar args, no weak-type promotion, no
   dtype/shape drift between paired curr/next buffers (plus the
-  runtime ``assert_single_compile`` trace-count guard).
+  runtime ``assert_single_compile`` trace-count guard);
+* :mod:`.tiling`      — the prescriptive half of the VMEM audit: a
+  block-shape planner derives the legal (sublane, 128)-aligned,
+  grid-divisible, budget-fitting block shapes for every Pallas
+  kernel, the kernels select their defaults through it, and registry
+  targets gate every kernel at 256^3/512^3-per-device shapes against
+  the PHYSICAL VMEM budget (raised ``vmem_limit_bytes`` deliberately
+  distrusted — the SNIPPETS.md 512^3 Mosaic allocation failure,
+  reproduced and closed).
 
 Run ``python -m stencil_tpu.analysis`` (exit nonzero on findings,
 ``--json`` for the CI artifact, ``--only``/``--list`` to select
@@ -59,10 +67,13 @@ from .recompile import (RecompileGuardError, RecompileSpec,
 from .report import ERROR, WARNING, Finding, Report
 from .transfer import (TransferSpec, TransferTarget, check_transfer,
                        hot_loop_transfer_guard)
+from .tiling import (TilingInfeasibleError, TilingPlan, TilingSpec,
+                     TilingTarget, check_tiling, plan_blocks,
+                     snap_blocks)
 from .vmem import VmemSpec, VmemTarget, check_vmem
 
 CHECKERS = ("footprint", "dma", "collectives", "hlo", "costmodel",
-            "vmem", "donation", "transfer", "recompile")
+            "vmem", "donation", "transfer", "recompile", "tiling")
 
 CHECKER_DOC = {
     "footprint": "26-direction access footprint vs declared Radius",
@@ -74,6 +85,7 @@ CHECKER_DOC = {
     "donation": "donate_argnums buffers alias in the compiled HLO",
     "transfer": "no host-callback/infeed/outfeed escape in hot paths",
     "recompile": "dispatch-stable abstract fingerprints (no retrace)",
+    "tiling": "prescriptive VMEM block-shape planner at 256^3/512^3",
 }
 
 __all__ = [
@@ -87,8 +99,9 @@ __all__ = [
     "alias_param_ids", "assert_single_compile", "check_collectives",
     "check_costmodel", "check_donation", "check_hlo",
     "check_pallas_kernels", "check_recompile", "check_stencil_op",
-    "check_transfer", "check_vmem", "hot_loop_transfer_guard",
-    "run_targets",
+    "check_tiling", "check_transfer", "check_vmem",
+    "hot_loop_transfer_guard", "plan_blocks", "run_targets",
+    "snap_blocks",
 ]
 
 _DISPATCH = {
@@ -101,6 +114,7 @@ _DISPATCH = {
     "donation": check_donation,
     "transfer": check_transfer,
     "recompile": check_recompile,
+    "tiling": check_tiling,
 }
 
 

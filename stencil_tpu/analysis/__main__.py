@@ -44,7 +44,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="stencil-lint: static halo-radius / DMA-discipline "
                     "/ collective-permutation / HLO-lowering / "
                     "cost-model / VMEM / donation / host-transfer / "
-                    "recompile checks (no execution)")
+                    "recompile / prescriptive-tiling checks "
+                    "(no execution)")
     parser.add_argument("fixtures", nargs="*",
                         help="fixture module paths (files defining "
                              "TARGETS) to check instead of the shipped "
@@ -61,6 +62,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="list the available checkers and the "
                              "registry target counts per group, then "
                              "exit")
+    parser.add_argument("--plan-tiling", metavar="GLOB",
+                        dest="plan_tiling",
+                        help="print the ranked VMEM block-shape plan "
+                             "(shape, footprint bytes, amplification, "
+                             "legality) for the analysis.tiling.* "
+                             "targets matching GLOB; --json writes the "
+                             "machine-readable plan report instead of "
+                             "the findings artifact")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the per-target OK lines")
     args = parser.parse_args(argv)
@@ -87,6 +96,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     patterns = [v for v in (args.only or []) if v not in CHECKERS]
 
     _setup_backend()
+
+    if args.plan_tiling:
+        import json as _json
+
+        from .registry import default_targets
+        from .tiling import plan_tiling_report, render_plan_table
+
+        tiling = [t for t in default_targets() if t.checker == "tiling"]
+        chosen = [t for t in tiling
+                  if fnmatch.fnmatchcase(t.name, args.plan_tiling)
+                  or fnmatch.fnmatchcase(
+                      t.name.replace("analysis.tiling.", "", 1),
+                      args.plan_tiling)]
+        if not chosen:
+            print(f"stencil-lint: no tiling targets match "
+                  f"{args.plan_tiling!r} ({len(tiling)} registered "
+                  f"under analysis.tiling.*)", file=sys.stderr)
+            return 2
+        report = plan_tiling_report(chosen)
+        print(render_plan_table(report))
+        if args.json:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                _json.dump({"tool": "stencil-lint", "mode": "plan-tiling",
+                            "plans": report}, fh, indent=2)
+            print(f"stencil-lint: tiling plan report written to "
+                  f"{args.json}")
+        return 0
 
     from . import run_targets
     from .registry import default_targets, load_targets

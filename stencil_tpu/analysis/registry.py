@@ -133,7 +133,7 @@ def _mhd_rates_spec() -> StencilOpSpec:
 # DMA-discipline targets: every Pallas kernel issuing (remote) DMA
 
 
-def _rdma_exchange_spec() -> PallasKernelSpec:
+def _rdma_exchange_spec(side: int = 8) -> PallasKernelSpec:
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -150,12 +150,13 @@ def _rdma_exchange_spec() -> PallasKernelSpec:
 
     sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
                        out_specs=P("z", "y", "x"), check_vma=False)
-    return PallasKernelSpec(fn=sm, args=(_f32((16, 16, 16)),),
+    g = 2 * side
+    return PallasKernelSpec(fn=sm, args=(_f32((g, g, g)),),
                             axis_names=("x", "y", "z"),
                             expect_remote_dma=True)
 
 
-def _jacobi_overlap_spec() -> PallasKernelSpec:
+def _jacobi_overlap_spec(side: int = 8) -> PallasKernelSpec:
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -165,22 +166,26 @@ def _jacobi_overlap_spec() -> PallasKernelSpec:
 
     mesh = _mesh((1, 2, 2))
     counts = Dim3(1, 2, 2)
+    bz = 4 if side <= 8 else 8
 
     def shard(q):
         iz = jax.lax.axis_index("z")
         iy = jax.lax.axis_index("y")
-        org = jnp.stack([iz * 8, iy * 8, jnp.int32(0)]).astype(jnp.int32)
-        return jacobi7_overlap_pallas(q, org, (2, 4, 4), (5, 4, 4), 1,
-                                      counts, block_z=4, interpret=False)
+        org = jnp.stack([iz * side, iy * side,
+                         jnp.int32(0)]).astype(jnp.int32)
+        return jacobi7_overlap_pallas(
+            q, org, (side // 4, side // 2, side // 2),
+            (5 * side // 8, side // 2, side // 2), 1, counts,
+            block_z=bz, interpret=False)
 
     sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
                        out_specs=P("z", "y", "x"), check_vma=False)
-    return PallasKernelSpec(fn=sm, args=(_f32((16, 16, 8)),),
+    return PallasKernelSpec(fn=sm, args=(_f32((2 * side, 2 * side, side)),),
                             axis_names=("x", "y", "z"),
                             expect_remote_dma=True)
 
 
-def _mhd_overlap_spec(pair: bool) -> PallasKernelSpec:
+def _mhd_overlap_spec(pair: bool, side: int = 8) -> PallasKernelSpec:
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -202,14 +207,14 @@ def _mhd_overlap_spec(pair: bool) -> PallasKernelSpec:
     fspec = {q: spec for q in FIELDS}
     sm = jax.shard_map(shard, mesh=mesh, in_specs=(fspec, fspec),
                        out_specs=(fspec, fspec), check_vma=False)
-    fields = {q: _f32((16, 16, 8)) for q in FIELDS}
-    w = {q: _f32((16, 16, 8)) for q in FIELDS}
+    fields = {q: _f32((2 * side, 2 * side, side)) for q in FIELDS}
+    w = {q: _f32((2 * side, 2 * side, side)) for q in FIELDS}
     return PallasKernelSpec(fn=sm, args=(fields, w),
                             axis_names=("x", "y", "z"),
                             expect_remote_dma=True)
 
 
-def _jacobi_halo_kernel_spec() -> PallasKernelSpec:
+def _jacobi_halo_kernel_spec(side: int = 8) -> PallasKernelSpec:
     """The fused halo kernel: no DMA at all — the checker proves its
     discipline vacuously and (more importantly) that it never gained a
     stray semaphore/DMA without review."""
@@ -217,7 +222,7 @@ def _jacobi_halo_kernel_spec() -> PallasKernelSpec:
 
     from ..ops.pallas_halo import jacobi7_halo_pallas
 
-    Z = Y = X = 8
+    Z = Y = X = side
     slabs = {"zlo": _f32((1, Y, X)), "zhi": _f32((1, Y, X)),
              "ylo": _f32((Z, 8, X)), "yhi": _f32((Z, 8, X))}
 
@@ -1369,37 +1374,41 @@ def _vmem_from_kernel(build) -> VmemSpec:
     return VmemSpec(fn=ks.fn, args=ks.args)
 
 
-def _jacobi7_plane_vmem_spec() -> VmemSpec:
+def _jacobi7_plane_vmem_spec(side: int = 8) -> VmemSpec:
     from ..geometry import Dim3, Radius
     from ..ops.pallas_stencil import jacobi7_pallas
 
     radius = Radius.constant(1)
-    interior = Dim3(8, 8, 8)
+    interior = Dim3(side, side, side)
+    g = side + 2
 
     def fn(p):
         return jacobi7_pallas(p, radius, interior, interpret=False)
 
-    return VmemSpec(fn=fn, args=(_f32((10, 10, 10)),))
+    return VmemSpec(fn=fn, args=(_f32((g, g, g)),))
 
 
-def _laplace6_vmem_spec() -> VmemSpec:
+def _laplace6_vmem_spec(side: int = 8) -> VmemSpec:
     from ..geometry import Dim3, Radius
     from ..ops.pallas_stencil import laplace6_pallas
 
     radius = Radius.constant(3)
-    interior = Dim3(8, 8, 8)
+    interior = Dim3(side, side, side)
+    g = side + 6
 
     def fn(p):
         return laplace6_pallas(p, radius, interior, interpret=False)
 
-    return VmemSpec(fn=fn, args=(_f32((14, 14, 14)),))
+    return VmemSpec(fn=fn, args=(_f32((g, g, g)),))
 
 
-def _jacobi_wrap_vmem_spec(steps: int) -> VmemSpec:
+def _jacobi_wrap_vmem_spec(steps: int, side: int = 16) -> VmemSpec:
     from ..ops.pallas_stencil import (jacobi7_wrap_pallas,
                                       jacobi7_wrapn_pallas)
 
-    hot, cold, r = (4, 8, 8), (12, 8, 8), 2
+    hot = (side // 4, side // 2, side // 2)
+    cold = (3 * side // 4, side // 2, side // 2)
+    r = side // 8
 
     def fn(q):
         if steps == 1:
@@ -1407,10 +1416,10 @@ def _jacobi_wrap_vmem_spec(steps: int) -> VmemSpec:
         return jacobi7_wrapn_pallas(q, hot, cold, r, steps=steps,
                                     interpret=False)
 
-    return VmemSpec(fn=fn, args=(_f32((16, 16, 16)),))
+    return VmemSpec(fn=fn, args=(_f32((side, side, side)),))
 
 
-def _mhd_wrap_vmem_spec(pair: bool) -> VmemSpec:
+def _mhd_wrap_vmem_spec(pair: bool, side: int = 16) -> VmemSpec:
     from ..models.astaroth import FIELDS, MhdParams
     from ..ops.pallas_mhd import (mhd_substep01_wrap_pallas,
                                   mhd_substep_wrap_pallas)
@@ -1427,7 +1436,7 @@ def _mhd_wrap_vmem_spec(pair: bool) -> VmemSpec:
                                            interpret=False)
         return tuple(f[q] for q in FIELDS) + tuple(w[q] for q in FIELDS)
 
-    return VmemSpec(fn=fn, args=tuple(_f32((16, 16, 16))
+    return VmemSpec(fn=fn, args=tuple(_f32((side, side, side))
                                       for _ in FIELDS))
 
 
@@ -1499,6 +1508,181 @@ def _mhd_halo_vmem_spec(pair: bool) -> VmemSpec:
                        out_specs=(fspec, fspec), check_vma=False)
     fields = {q: _f32((2 * Z, 2 * Y, X)) for q in FIELDS}
     return VmemSpec(fn=sm, args=(fields,))
+
+
+# ---------------------------------------------------------------------------
+# prescriptive-tiling targets (checker 10): every shipped Pallas
+# compute/exchange kernel audited at 256^3- and 512^3-PER-DEVICE
+# shapes against the PHYSICAL VMEM budget — trace-only, so tier-1 on
+# CPU proves the production-size story the 8^3 bench trajectory never
+# could. Expectations are part of the registered contract:
+#
+# * "legal"      — the kernel's planner-derived default block shape
+#                  passes the full VMEM audit at this size (the
+#                  SNIPPETS.md 512^3 Mosaic failure, closed: the old
+#                  (16, 128) Jacobi halo default is the bad_tiling
+#                  fixture, proven flagged);
+# * "infeasible" — the planner must REFUSE this size (build raises
+#                  TilingInfeasibleError) or the audit must flag it:
+#                  the full-lane (X-wide) MHD halo corner segments and
+#                  the 7-plane laplace window genuinely cannot stage
+#                  under 16 MiB at these shapes — re-tiling the lane
+#                  dim is the named ROADMAP follow-up, and until then
+#                  the gate proves the model paths decline loudly
+#                  instead of dying in Mosaic's allocator.
+
+from .tiling import TilingSpec, TilingTarget  # noqa: E402
+
+
+def _tiling_from_vmem(build) -> TilingSpec:
+    ks = build()
+    return TilingSpec(fn=ks.fn, args=ks.args)
+
+
+def _jacobi_halon_tiling_spec(side: int) -> TilingSpec:
+    """The N=2 halo pair kernel called directly at a production
+    per-device shape; slab shapes derive from the SAME planner fit the
+    model deploys (fit_pair_halo_blocks raises when infeasible — the
+    refused-at-build verdict)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.pallas_halo import (fit_pair_halo_blocks,
+                                   jacobi7_halon_pallas)
+
+    S = side
+    bz, by = fit_pair_halo_blocks(S, S, S, 4, 2)
+    slabs = {"zlo": _f32((bz, S, S)), "zhi": _f32((bz, S, S)),
+             "ylo": _f32((S + 2 * bz, 8, S)),
+             "yhi": _f32((S + 2 * bz, 8, S))}
+    org = jax.ShapeDtypeStruct((3,), jnp.int32)
+
+    def fn(interior, zlo, zhi, ylo, yhi, o):
+        return jacobi7_halon_pallas(
+            interior, {"zlo": zlo, "zhi": zhi, "ylo": ylo, "yhi": yhi},
+            o, (S, S, S), (S // 4, S // 2, S // 2),
+            (3 * S // 4, S // 2, S // 2), S // 8, steps=2,
+            block_z=bz, block_y=by, interpret=False)
+
+    return TilingSpec(fn=fn, args=(_f32((S, S, S)), slabs["zlo"],
+                                   slabs["zhi"], slabs["ylo"],
+                                   slabs["yhi"], org))
+
+
+def _mhd_halo_tiling_spec(pair: bool, side: int) -> TilingSpec:
+    """The MHD halo kernels at a production per-device shape, direct
+    call. ``mhd_halo_blocks`` (the same fit the model and the slab
+    exchange share) raises at these sizes — the full-lane corner
+    segments bind — so the registered expectation is the refusal."""
+    from ..models.astaroth import FIELDS, MhdParams
+    from ..ops.pallas_halo import (mhd_halo_blocks,
+                                   mhd_substep01_halo_pallas,
+                                   mhd_substep_halo_pallas)
+
+    S = side
+    bz, _by = mhd_halo_blocks(S, S, 8, 32, 8, X=S, itemsize=4)
+    prm = MhdParams()
+    fields = {q: _f32((S, S, S)) for q in FIELDS}
+    slabs = {q: {"zlo": _f32((bz, S, S)), "zhi": _f32((bz, S, S)),
+                 "ylo": _f32((S + 2 * bz, 8, S)),
+                 "yhi": _f32((S + 2 * bz, 8, S))} for q in FIELDS}
+
+    def fn(fields, slabs):
+        if pair:
+            return mhd_substep01_halo_pallas(fields, slabs, prm, prm.dt,
+                                             interpret=False)
+        return mhd_substep_halo_pallas(fields, None, slabs, 0, prm,
+                                       prm.dt, interpret=False)
+
+    return TilingSpec(fn=fn, args=(fields, slabs))
+
+
+def _tiling_targets() -> List[Target]:
+    targets: List[Target] = []
+
+    def vmem_backed(prefix: str, build_for_side):
+        for side in _TILING_SIDES:
+            targets.append(TilingTarget(
+                f"analysis.tiling.{prefix}[{side}]",
+                lambda b=build_for_side, s=side:
+                    _tiling_from_vmem(lambda: b(s)),
+                expect=_TILING_EXPECT[prefix][side]))
+
+    vmem_backed("ops.pallas_stencil.jacobi7_pallas",
+                _jacobi7_plane_vmem_spec)
+    vmem_backed("ops.pallas_stencil.laplace6_pallas",
+                _laplace6_vmem_spec)
+    vmem_backed("ops.pallas_stencil.jacobi7_wrap_pallas",
+                lambda s: _jacobi_wrap_vmem_spec(1, s))
+    vmem_backed("ops.pallas_stencil.jacobi7_wrapn_pallas[n=2]",
+                lambda s: _jacobi_wrap_vmem_spec(2, s))
+    vmem_backed("ops.pallas_stencil.jacobi7_wrapn_pallas[n=4]",
+                lambda s: _jacobi_wrap_vmem_spec(4, s))
+    vmem_backed("ops.pallas_halo.jacobi7_halo_pallas",
+                _jacobi_halo_kernel_spec)
+    vmem_backed("ops.pallas_mhd.mhd_substep_wrap_pallas",
+                lambda s: _mhd_wrap_vmem_spec(False, s))
+    vmem_backed("ops.pallas_mhd.mhd_substep01_wrap_pallas",
+                lambda s: _mhd_wrap_vmem_spec(True, s))
+    vmem_backed("ops.pallas_overlap.jacobi7_overlap_pallas",
+                lambda s: _jacobi_overlap_spec(s))
+    vmem_backed("ops.pallas_mhd_overlap.mhd_substep_overlap",
+                lambda s: _mhd_overlap_spec(False, s))
+    vmem_backed("parallel.pallas_exchange.exchange_shard_pallas",
+                lambda s: _rdma_exchange_spec(s))
+    for side in _TILING_SIDES:
+        targets.append(TilingTarget(
+            f"analysis.tiling.ops.pallas_halo."
+            f"jacobi7_halon_pallas[n=2][{side}]",
+            lambda s=side: _jacobi_halon_tiling_spec(s),
+            expect=_TILING_EXPECT[
+                "ops.pallas_halo.jacobi7_halon_pallas[n=2]"][side]))
+        for pair, key in ((False, "ops.pallas_halo.mhd_substep_halo_pallas"),
+                          (True,
+                           "ops.pallas_halo.mhd_substep01_halo_pallas")):
+            targets.append(TilingTarget(
+                f"analysis.tiling.{key}[{side}]",
+                lambda p=pair, s=side: _mhd_halo_tiling_spec(p, s),
+                expect=_TILING_EXPECT[key][side]))
+    return targets
+
+
+_TILING_SIDES = (256, 512)
+
+#: the registered per-size verdicts (see the block comment above);
+#: probed on this image and pinned — a kernel whose story changes must
+#: change this table in review
+_TILING_EXPECT = {
+    "ops.pallas_stencil.jacobi7_pallas": {256: "legal", 512: "legal"},
+    "ops.pallas_stencil.laplace6_pallas": {256: "legal",
+                                           512: "infeasible"},
+    "ops.pallas_stencil.jacobi7_wrap_pallas": {256: "legal",
+                                               512: "legal"},
+    "ops.pallas_stencil.jacobi7_wrapn_pallas[n=2]": {256: "legal",
+                                                     512: "legal"},
+    "ops.pallas_stencil.jacobi7_wrapn_pallas[n=4]": {256: "legal",
+                                                     512: "legal"},
+    "ops.pallas_halo.jacobi7_halo_pallas": {256: "legal", 512: "legal"},
+    "ops.pallas_halo.jacobi7_halon_pallas[n=2]": {256: "legal",
+                                                  512: "legal"},
+    "ops.pallas_mhd.mhd_substep_wrap_pallas": {256: "legal",
+                                               512: "infeasible"},
+    "ops.pallas_mhd.mhd_substep01_wrap_pallas": {256: "legal",
+                                                 512: "infeasible"},
+    "ops.pallas_halo.mhd_substep_halo_pallas": {256: "infeasible",
+                                                512: "infeasible"},
+    "ops.pallas_halo.mhd_substep01_halo_pallas": {256: "infeasible",
+                                                  512: "infeasible"},
+    # the RDMA overlap kernel stages its slab exchange buffers as
+    # block-independent VMEM scratch: ~42 MB at 512^3/device — no
+    # block shape can fix that; lane re-tiling is the named follow-up
+    "ops.pallas_overlap.jacobi7_overlap_pallas": {256: "legal",
+                                                  512: "infeasible"},
+    "ops.pallas_mhd_overlap.mhd_substep_overlap": {256: "infeasible",
+                                                   512: "infeasible"},
+    "parallel.pallas_exchange.exchange_shard_pallas": {256: "legal",
+                                                       512: "legal"},
+}
 
 
 # ---------------------------------------------------------------------------
@@ -1738,6 +1922,9 @@ def default_targets() -> List[Target]:
         VmemTarget("ops.pallas_halo.mhd_substep01_halo_pallas",
                    lambda: _mhd_halo_vmem_spec(pair=True)),
     ]
+    # prescriptive tiling: every shipped Pallas kernel gated at
+    # 256^3/512^3-per-device shapes (checker 10)
+    targets += _tiling_targets()
     return targets
 
 

@@ -129,9 +129,16 @@ def _block_dim(b) -> int:
         return 1
 
 
-def audit_pallas_call(eqn, budget: int, kname: str, target_name: str
+def audit_pallas_call(eqn, budget: int, kname: str, target_name: str,
+                      honor_kernel_limit: bool = True
                       ) -> Tuple[List[Finding], Dict]:
-    """Audit one pallas_call eqn: footprint, alignment, divisibility."""
+    """Audit one pallas_call eqn: footprint, alignment, divisibility.
+
+    ``honor_kernel_limit=False`` audits against ``budget`` verbatim —
+    the tiling checker's physical-VMEM mode, where a kernel's own
+    raised ``vmem_limit_bytes`` is exactly the thing being distrusted
+    (a raise defers the overflow from the Mosaic check to the
+    allocator; see analysis/tiling.py)."""
     import numpy as np
 
     findings: List[Finding] = []
@@ -141,7 +148,8 @@ def audit_pallas_call(eqn, budget: int, kname: str, target_name: str
                         f"kernel '{kname}': pallas_call carries no "
                         f"grid_mapping on this JAX; VMEM audit "
                         f"unavailable", WARNING)], {}
-    budget = _kernel_limit(eqn.params, budget)
+    if honor_kernel_limit:
+        budget = _kernel_limit(eqn.params, budget)
     steps = _grid_steps(tuple(gm.grid))
     block_bytes = 0
     n_vmem_blocks = 0
@@ -248,6 +256,21 @@ def check_vmem(target: VmemTarget) -> Tuple[List[Finding], Dict]:
                 "vmem", f"{target.name}:{kname}",
                 f"VMEM audit failed on this kernel's grid mapping: "
                 f"{type(e).__name__}: {e}", WARNING)], {}
+        if f and any(x.severity != WARNING for x in f):
+            # prescriptive mode: every real finding carries the block-
+            # shape planner's concrete fix (analysis/tiling.py),
+            # planned against whatever budget THIS audit used (never
+            # looser — a suggestion must satisfy the budget it was
+            # flagged against)
+            from .tiling import TILE_SELECT_BUDGET_BYTES, suggest_for_eqn
+
+            audited = m.get("budget_bytes", spec.budget_bytes)
+            sug = suggest_for_eqn(eqn, min(TILE_SELECT_BUDGET_BYTES,
+                                           audited), kernel=kname)
+            f = [dataclasses.replace(x, message=f"{x.message}; {sug}")
+                 if x.severity != WARNING else x for x in f]
+            m = dict(m)
+            m["suggestion"] = sug
         findings.extend(f)
         metrics["kernels"][kname] = m
     if spec.expect_pallas and not metrics["kernels"]:

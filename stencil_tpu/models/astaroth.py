@@ -434,11 +434,40 @@ class Astaroth:
         # takes it on real TPU hardware with f32 fields
         rdma_overlap_ok = (self._overlap and counts.x == 1
                            and aligned_t and pallas_s_ok)
+
+        def _blocks_feasible(path: str) -> bool:
+            """auto only: does the VMEM block planner find a legal
+            shape for this Pallas path at this shard? An explicit
+            kernel= request still raises the planner's
+            TilingInfeasibleError (the operator asked for exactly that
+            path); auto declines to the next path LOUDLY instead — the
+            same catch-and-fall-back the Jacobi pair path got."""
+            from ..analysis.tiling import TilingInfeasibleError
+            from ..ops.pallas_halo import mhd_halo_blocks
+            from ..ops.pallas_mhd import _fit_blocks
+
+            blk_z, blk_y = (getattr(self, "_halo_blocks", None)
+                            or (8, 32))
+            isz = np.dtype(self._dtype).itemsize
+            try:
+                if path == "wrap":
+                    _fit_blocks(local.z, local.y, blk_z, blk_y, tile,
+                                X=local.x, itemsize=isz)
+                else:
+                    mhd_halo_blocks(local.z, local.y, blk_z, blk_y,
+                                    tile, X=local.x, itemsize=isz)
+                return True
+            except TilingInfeasibleError as e:
+                from ..utils.logging import LOG_WARN
+                LOG_WARN(f"astaroth auto declines the {path} path: {e}")
+                return False
+
         if rdma_overlap_ok:
             from ..ops.pallas_stencil import on_tpu
             if (kernel == "halo"
                     or (kernel == "auto" and on_tpu()
-                        and _fast_dtype_ok(self._dtype))):
+                        and _fast_dtype_ok(self._dtype)
+                        and _blocks_feasible("halo"))):
                 from ..utils.logging import LOG_INFO
                 self.kernel_path = "halo-overlap"
                 self._build_halo_overlap_step()
@@ -449,8 +478,9 @@ class Astaroth:
             from ..ops.pallas_stencil import on_tpu
             from ..utils.logging import LOG_INFO
             if on_tpu() and _fast_dtype_ok(self._dtype):
-                kernel = ("wrap" if wrap_ok
-                          else "halo" if halo_ok else "xla")
+                kernel = ("wrap" if wrap_ok and _blocks_feasible("wrap")
+                          else "halo" if halo_ok
+                          and _blocks_feasible("halo") else "xla")
             else:
                 kernel = "xla"
             why = ""
@@ -799,7 +829,9 @@ class Astaroth:
         dt = prm.dt
         tile = mhd_tile(self._dtype)   # 8 f32/f64, 16 bf16 slabs
         blk_z, blk_y = getattr(self, "_halo_blocks", None) or (8, 32)
-        bz, by = mhd_halo_blocks(local.z, local.y, blk_z, blk_y, tile)
+        bz, by = mhd_halo_blocks(local.z, local.y, blk_z, blk_y, tile,
+                                 X=local.x,
+                                 itemsize=np.dtype(self._dtype).itemsize)
         spec = P("z", "y", "x")
         fields_spec = {q: spec for q in FIELDS}
 
@@ -903,7 +935,9 @@ class Astaroth:
         dt = prm.dt
         tile = mhd_tile(self._dtype)   # 8 f32/f64, 16 bf16 slabs
         blk_z, blk_y = getattr(self, "_halo_blocks", None) or (8, 32)
-        bz, by = mhd_halo_blocks(local.z, local.y, blk_z, blk_y, tile)
+        bz, by = mhd_halo_blocks(local.z, local.y, blk_z, blk_y, tile,
+                                 X=local.x,
+                                 itemsize=np.dtype(self._dtype).itemsize)
         spec = P("z", "y", "x")
         fields_spec = {q: spec for q in FIELDS}
 

@@ -669,15 +669,22 @@ class Jacobi3D:
         pair_ok = (rem == Dim3(0, 0, 0) and N > 1 and esub == tile
                    and not wrap2_disabled())
         if pair_ok:
-            pbz, pby = fit_pair_halo_blocks(
-                local.z, local.y, local.x,
-                jnp.dtype(self._dtype).itemsize, N)
-            if pbz < N:
+            from ..analysis.tiling import TilingInfeasibleError
+
+            try:
+                pbz, pby = fit_pair_halo_blocks(
+                    local.z, local.y, local.x,
+                    jnp.dtype(self._dtype).itemsize, N)
+            except TilingInfeasibleError as e:
+                # the planner found no legal blocking for the N-step
+                # kernel at this shard: fall back to the single-step
+                # kernel LOUDLY (the old fitter clamped silently and
+                # let Mosaic fail at compile time). The planner
+                # enforces bz >= steps, so a partial clamp cannot
+                # happen — it is all-or-nothing by construction.
                 from ..utils.logging import LOG_WARN
-                LOG_WARN(f"halo temporal depth clamped to bz={pbz} "
-                         f"(requested {N})")
-            N = min(N, pbz)
-            pair_ok = N > 1
+                LOG_WARN(f"halo temporal blocking declined: {e}")
+                pair_ok = False
         if pair_ok:
             from ..utils.logging import LOG_INFO
             LOG_INFO(f"jacobi halo path: {N}-step temporal blocking, "

@@ -51,7 +51,9 @@ R = 3     # MHD stencil radius (6th order)
 
 def _shrink_block(dim: int, block: int, mult: int = 1) -> int:
     """Largest power-of-two-ish block <= ``block`` that divides ``dim``
-    and is a multiple of ``mult`` (or equals mult)."""
+    and is a multiple of ``mult`` (or equals mult). Kept for block-sweep
+    scripts; the kernels' own selection goes through the block-shape
+    planner (``analysis/tiling.py``)."""
     b = block
     while b > mult and dim % b:
         b //= 2
@@ -61,40 +63,46 @@ def _shrink_block(dim: int, block: int, mult: int = 1) -> int:
     return b
 
 
-# Mosaic's default scoped-VMEM allocation limit is 16 MiB; leave slack
-# for semaphores/scratch so a chosen blocking never fails to compile.
-_VMEM_BUDGET = 14 * 2**20
+# the kernel-side selection budget (physical VMEM minus slack for
+# semaphores/compute temporaries) now lives with the planner; the old
+# name stays as an alias for block-sweep scripts
+from ..analysis.tiling import TILE_SELECT_BUDGET_BYTES as _VMEM_BUDGET  # noqa: E402,E501
+
+
+def _jacobi_halo_elems(esub: int):
+    """Per-lane-column element model of one jacobi7_halo_pallas grid
+    step for the planner: main block + 4 single-plane z rows
+    (zprev/znext/zlo/zhi) + 4 esub-col y slabs in, the block out."""
+    return lambda bz, by: (bz * by + 4 * by + 4 * bz * esub,
+                           bz * by, 0)
 
 
 def _jacobi_block_bytes(bz: int, by: int, X: int, esub: int,
                         itemsize: int) -> int:
     """Scoped-VMEM estimate for one jacobi7_halo_pallas grid step:
-    main + out (bz,by,X); 4 single-plane z rows (zprev/znext/zlo/zhi);
-    4 y slabs (bz,esub,X); everything double-buffered by the Pallas
-    pipeline (hence the factor 2)."""
-    main_out = 2 * bz * by * X
-    zrows = 4 * by * X
-    yslabs = 4 * bz * esub * X
-    return 2 * itemsize * (main_out + zrows + yslabs)
+    the streamed blocks of ``_jacobi_halo_elems``, double-buffered by
+    the Pallas pipeline (hence the factor 2)."""
+    ein, eout, _held = _jacobi_halo_elems(esub)(bz, by)
+    return 2 * itemsize * X * (ein + eout)
 
 
 def fit_jacobi_halo_blocks(Z: int, Y: int, X: int, esub: int,
                            itemsize: int, block_z: int,
                            block_y: int) -> Tuple[int, int]:
-    """(bz, by) for the Jacobi halo kernel, shrunk (bz first — the
-    judge-measured fast point at 512^3 is (8, 128)) until the scoped
-    VMEM estimate fits Mosaic's allocation limit, so kernel="auto"
-    never selects a blocking that fails to compile."""
-    bz = _shrink_block(Z, block_z)
-    by = _shrink_block(Y, block_y, esub)
-    while _jacobi_block_bytes(bz, by, X, esub, itemsize) > _VMEM_BUDGET:
-        if bz > 1:
-            bz = _shrink_block(Z, max(bz // 2, 1))
-        elif by > esub:
-            by = _shrink_block(Y, max(by // 2, esub), esub)
-        else:
-            break
-    return bz, by
+    """Planner-derived (bz, by) for the Jacobi halo kernel: the
+    cheapest-HBM-traffic legal shape at or below the (block_z, block_y)
+    ceiling whose double-buffered footprint fits the physical VMEM
+    budget, so kernel="auto" never selects a blocking Mosaic refuses —
+    at 512^3 this lands on the judge-measured fast point (8, 128)
+    where the old default (16, 128) overflowed (SNIPPETS.md). Raises
+    :class:`~stencil_tpu.analysis.tiling.TilingInfeasibleError` when
+    no legal shape exists (the old loop silently clamped to the
+    sublane floor and let Mosaic fail at compile time)."""
+    from ..analysis.tiling import plan_blocks
+
+    return plan_blocks("jacobi7_halo_pallas", Z, Y, X, itemsize,
+                       _jacobi_halo_elems(esub), sublane_y=esub,
+                       cap_z=block_z, cap_y=block_y).blocks()
 
 
 def jacobi7_halo_pallas(interior: jnp.ndarray,
@@ -147,11 +155,15 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
                                         16, 128)
     else:
         # explicit blocks (tuning sweeps) are honored as-given modulo
-        # divisibility; a VMEM overflow then surfaces as the compile
-        # error the operator asked to measure
-        bz = _shrink_block(Z, block_z if block_z is not None else 16)
-        by = _shrink_block(Y, block_y if block_y is not None else 128,
-                           esub)
+        # divisibility (warned once when replaced); a VMEM overflow
+        # then surfaces as the compile error the operator asked to
+        # measure
+        from ..analysis.tiling import snap_blocks
+
+        bz, by = snap_blocks(
+            "jacobi7_halo_pallas", Z, Y,
+            block_z if block_z is not None else 16,
+            block_y if block_y is not None else 128, sublane_y=esub)
     hx, hy, hz = hot_c
     cx, cy, cz = cold_c
     r2 = sph_r * sph_r
@@ -252,37 +264,47 @@ def jacobi7_halo_pallas(interior: jnp.ndarray,
       slabs["ylo"], slabs["yhi"])
 
 
+def _pair_halo_elems(esub: int, steps: int):
+    """Per-lane-column element model of one jacobi7_halon_pallas grid
+    step: main block + 2N z-in singles + 2N z-slab singles + 4 esub-col
+    y slabs + 12N esub-col corner singles in, the block out, plus the
+    held assembled (bz+2N, by+2N) window and its first shrinking
+    intermediate (allocated once, not pipelined)."""
+    N = int(steps)
+
+    def elems(bz: int, by: int):
+        ein = (bz * by + 4 * N * by + 4 * bz * esub
+               + 12 * N * esub)
+        held = ((bz + 2 * N) * (by + 2 * N)
+                + (bz + 2 * N - 2) * (by + 2 * N - 2))
+        return ein, bz * by, held
+
+    return elems
+
+
 def _pair_block_bytes(bz: int, by: int, X: int, itemsize: int,
                       steps: int = 2) -> int:
     """Scoped-VMEM estimate for one jacobi7_halon_pallas grid step:
-    main + out (bz,by,X) and the thin ring segments, double-buffered by
-    the pipeline, plus the assembled (bz+2N, by+2N, X) window and the
-    first intermediate held during compute."""
-    N = steps
+    the streamed blocks of ``_pair_halo_elems`` double-buffered by the
+    pipeline, plus the held window bytes."""
     esub = sublane_tile_bytes(itemsize)
-    streamed = 2 * (2 * bz * by * X + 4 * N * by * X
-                    + 8 * bz * esub * X)
-    held = ((bz + 2 * N) * (by + 2 * N) * X
-            + (bz + 2 * N - 2) * (by + 2 * N - 2) * X)
-    return itemsize * (streamed + held)
+    ein, eout, held = _pair_halo_elems(esub, steps)(bz, by)
+    return itemsize * X * (2 * (ein + eout) + held)
 
 
 def fit_pair_halo_blocks(Z: int, Y: int, X: int, itemsize: int,
                          steps: int = 2) -> Tuple[int, int]:
-    """(bz, by) for the N-step halo kernel, shrunk bz-first until the
-    VMEM estimate fits (same policy as fit_jacobi_halo_blocks). bz is
-    kept >= steps (the in-shard ring reads rows kz*bz - N)."""
+    """Planner-derived (bz, by) for the N-step halo kernel (ceiling
+    (16, 128), bz kept >= steps — the in-shard ring reads rows
+    kz*bz - N). Raises ``TilingInfeasibleError`` when no legal shape
+    fits the budget instead of clamping to the sublane floor."""
+    from ..analysis.tiling import plan_blocks
+
     esub = sublane_tile_bytes(itemsize)
-    bz = _shrink_block(Z, 16)
-    by = _shrink_block(Y, 128, esub)
-    while _pair_block_bytes(bz, by, X, itemsize, steps) > _VMEM_BUDGET:
-        if bz > max(2, steps):
-            bz = _shrink_block(Z, max(bz // 2, 2, steps))
-        elif by > esub:
-            by = _shrink_block(Y, max(by // 2, esub), esub)
-        else:
-            break
-    return bz, by
+    return plan_blocks(f"jacobi7_halon_pallas[n={steps}]", Z, Y, X,
+                       itemsize, _pair_halo_elems(esub, steps),
+                       sublane_y=esub, min_z=max(2, int(steps)),
+                       cap_z=16, cap_y=128).blocks()
 
 
 def jacobi7_halon_pallas(interior: jnp.ndarray,
@@ -333,9 +355,12 @@ def jacobi7_halon_pallas(interior: jnp.ndarray,
     if block_z is None and block_y is None:
         bz, by = fit_pair_halo_blocks(Z, Y, X, dt.itemsize, N)
     else:
-        bz = _shrink_block(Z, block_z if block_z is not None else 16)
-        by = _shrink_block(Y, block_y if block_y is not None else 128,
-                           esub)
+        from ..analysis.tiling import snap_blocks
+
+        bz, by = snap_blocks(
+            f"jacobi7_halon_pallas[n={N}]", Z, Y,
+            block_z if block_z is not None else 16,
+            block_y if block_y is not None else 128, sublane_y=esub)
     if bz < N:
         raise ValueError(f"halo pair kernel needs bz >= steps, got "
                          f"bz={bz}, steps={N} for Z={Z}")
@@ -538,18 +563,49 @@ def jacobi7_halo2_pallas(interior: jnp.ndarray,
                                 interpret=interpret)
 
 
+def _mhd_halo_elems(esub: int, rr: int = R, nf: int = 8):
+    """Per-lane-column element model of one MHD halo-kernel grid step
+    (``_mhd_window_plan`` segments x ``nf`` fields, the worst-case
+    substep: w read + both output sweeps). Thin-z (default): main +
+    2rr in-shard single rows + 2rr slab single rows + 4 esub-col y
+    slabs + 12 esub^2 corner segments per field; tiled
+    (STENCIL_MHD_THINZ=0) swaps the single rows for esub tiles."""
+    from .pallas_mhd import _thin_z
+
+    zrows = 2 * rr if _thin_z() else 2 * esub
+
+    def elems(bz: int, by: int):
+        per_field = (bz * by + 2 * zrows * by + 4 * bz * esub
+                     + 12 * esub * esub)
+        ein = nf * (per_field + bz * by)     # fields + w
+        return ein, 2 * nf * bz * by, 0      # f and w outputs
+
+    return elems
+
+
 def mhd_halo_blocks(Z: int, Y: int, block_z: int = 8,
-                    block_y: int = 32,
-                    esub: int = ESUB) -> Tuple[int, int]:
+                    block_y: int = 32, esub: int = ESUB,
+                    X: "int | None" = None,
+                    itemsize: int = 4) -> Tuple[int, int]:
     """The (bz, by) blocking the MHD halo kernel will use for a
     (Z, Y, ·) shard — exposed so the slab exchange can size its z slabs
     to match (zlo/zhi must be (bz, Y, X); see mhd_substep_halo_pallas).
     Both are multiples of the dtype's ``esub`` sublane tile (8 f32 /
-    16 bf16) and divide Z / Y. One rule shared with the wrap kernels
-    (pallas_mhd._fit_blocks) so the two paths never diverge."""
-    from .pallas_mhd import _fit_blocks
+    16 bf16) and divide Z / Y, chosen by the block-shape planner
+    against the halo window plan's own byte model (the radius-R
+    worst-case substep; the 2R pair kernels reuse the SAME blocks so
+    slab shapes stay substep-invariant — their extra VMEM pressure is
+    pinned by the ``analysis.tiling`` production-size targets). Pass
+    ``X``/``itemsize`` to apply the VMEM budget; without ``X`` (legacy
+    callers) only alignment/divisibility constrain, which at budget-
+    irrelevant sizes chooses identical shapes."""
+    from ..analysis.tiling import plan_blocks
 
-    return _fit_blocks(Z, Y, block_z, block_y, esub)
+    budget_x = X if X is not None else 1  # X=1: budget never binds
+    return plan_blocks("mhd_substep_halo_pallas", Z, Y, budget_x,
+                       itemsize, _mhd_halo_elems(esub),
+                       n_streams=8, sublane_z=esub, sublane_y=esub,
+                       cap_z=block_z, cap_y=block_y).blocks()
 
 
 def _mhd_window_plan(Z: int, Y: int, X: int, bz: int, by: int,
@@ -805,8 +861,11 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
 
     ``slabs[q]`` comes from ``exchange_interior_slabs(fields[q],
     counts, rz=bz, ry=esub, radius_rows=R, y_z_extended=True)`` with
-    (bz, _) = ``mhd_halo_blocks(Z, Y, block_z, block_y)``.
-    Returns (new_fields, new_w).
+    (bz, _) = ``mhd_halo_blocks(Z, Y, block_z, block_y, esub, X=X,
+    itemsize=...)`` — pass the SAME ``X``/``itemsize`` the kernel sees
+    (it recomputes the blocking internally with them; a budget-bound
+    fit without them would size the slabs differently and trip the
+    shape asserts). Returns (new_fields, new_w).
 
     Dead-w elision as in ``mhd_substep_wrap_pallas``: ``w=None`` drops
     the w read sweep (only valid at alpha_s == 0, i.e. substep 0);
@@ -825,7 +884,8 @@ def mhd_substep_halo_pallas(fields: Dict[str, jnp.ndarray],
     dtype = fields[FIELDS[0]].dtype
     esub = mhd_tile(dtype)
     comp = compute_dtype(dtype)
-    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub)
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub, X=X,
+                             itemsize=jnp.dtype(dtype).itemsize)
     for q in FIELDS:
         assert slabs[q]["zlo"].shape == (bz, Y, X), slabs[q]["zlo"].shape
         assert slabs[q]["ylo"].shape == (Z + 2 * bz, esub, X), \
@@ -924,7 +984,9 @@ def mhd_substep01_halo_pallas(fields: Dict[str, jnp.ndarray],
 
     ``slabs[q]`` must come from ``exchange_interior_slabs(fields[q],
     counts, rz=bz, ry=esub, radius_rows=2*R, y_z_extended=True)`` —
-    2R valid rows, not R (the window reaches 2R across shard edges).
+    2R valid rows, not R (the window reaches 2R across shard edges) —
+    with bz from ``mhd_halo_blocks(..., X=X, itemsize=...)`` exactly
+    as the single-substep kernel documents.
     Needs 2R <= min(bz, esub) (6 <= 8). Returns (new_fields, new_w).
     """
     from ..models.astaroth import FIELDS
@@ -936,7 +998,8 @@ def mhd_substep01_halo_pallas(fields: Dict[str, jnp.ndarray],
     dtype = fields[FIELDS[0]].dtype
     from .pallas_mhd import mhd_tile
     esub = mhd_tile(dtype)
-    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub)
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub, X=X,
+                             itemsize=jnp.dtype(dtype).itemsize)
     assert R2 <= esub and R2 <= bz, (R2, esub, bz)
     for q in FIELDS:
         assert slabs[q]["zlo"].shape == (bz, Y, X), slabs[q]["zlo"].shape

@@ -146,23 +146,41 @@ def _window_plan(Z: int, Y: int, X: int, bz: int, by: int,
     return specs, assemble
 
 
+def _wrap_mhd_elems(esub: int, rr: int = R, nf: int = 8):
+    """Per-lane-column element model of one MHD wrap-kernel grid step
+    for the block planner (the ``_window_plan`` segment cross product
+    x ``nf`` fields, worst-case substep: w read + both output sweeps):
+    per field ``(bz + zextra) * (by + 2*esub)`` streamed in, where
+    ``zextra`` is 2rr single rows (thin-z) or two esub tiles."""
+    zextra = 2 * rr if _thin_z() else 2 * esub
+
+    def elems(bz: int, by: int):
+        per_field = (bz + zextra) * (by + 2 * esub)
+        ein = nf * (per_field + bz * by)     # field windows + w
+        return ein, 2 * nf * bz * by, 0      # f and w outputs
+
+    return elems
+
+
 def _fit_blocks(Z: int, Y: int, block_z: int, block_y: int,
-                esub: int = ESUB) -> Tuple[int, int]:
-    """Shrink (block_z, block_y) to divide (Z, Y) while staying
-    multiples of the dtype's ``esub`` tile — the one block-shrink rule
-    both wrap substep kernels share."""
+                esub: int = ESUB, X: "int | None" = None,
+                itemsize: int = 4) -> Tuple[int, int]:
+    """Planner-derived (block_z, block_y) for the wrap substep kernels:
+    multiples of the dtype's ``esub`` tile dividing (Z, Y) at or below
+    the requested ceiling, budget-checked against the wrap window
+    plan's byte model when ``X``/``itemsize`` are given (without ``X``
+    — legacy callers — only alignment/divisibility constrain, which
+    chooses identical shapes wherever the budget is slack). Raises
+    ``TilingInfeasibleError`` when nothing legal exists instead of
+    clamping to the esub floor."""
+    from ..analysis.tiling import plan_blocks
+
     assert Z % esub == 0 and Y % esub == 0, (Z, Y, esub)
-    bz, by = block_z, block_y
-    if bz % esub or bz < esub:
-        bz = max((bz // esub) * esub, esub)
-    if by % esub or by < esub:
-        by = max((by // esub) * esub, esub)
-    while bz > esub and Z % bz:
-        bz -= esub
-    while by > esub and Y % by:
-        by -= esub
-    assert bz % esub == 0 and by % esub == 0 and Z % bz == 0 and Y % by == 0
-    return bz, by
+    return plan_blocks("mhd_substep_wrap_pallas", Z, Y,
+                       X if X is not None else 1, itemsize,
+                       _wrap_mhd_elems(esub), n_streams=8,
+                       sublane_z=esub, sublane_y=esub,
+                       cap_z=block_z, cap_y=block_y).blocks()
 
 
 def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
@@ -203,7 +221,8 @@ def mhd_substep_wrap_pallas(fields: Dict[str, jnp.ndarray],
     dtype = fields[FIELDS[0]].dtype
     esub = mhd_tile(dtype)
     comp = compute_dtype(dtype)
-    bz, by = _fit_blocks(Z, Y, block_z, block_y, esub)
+    bz, by = _fit_blocks(Z, Y, block_z, block_y, esub, X=X,
+                         itemsize=jnp.dtype(dtype).itemsize)
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
     alpha = float(RK3_ALPHA[s])
     beta = float(RK3_BETA[s])
@@ -359,7 +378,8 @@ def mhd_substep01_wrap_pallas(fields: Dict[str, jnp.ndarray],
     dtype = fields[FIELDS[0]].dtype
     esub = mhd_tile(dtype)
     comp = compute_dtype(dtype)
-    bz, by = _fit_blocks(Z, Y, block_z, block_y, esub)
+    bz, by = _fit_blocks(Z, Y, block_z, block_y, esub, X=X,
+                         itemsize=jnp.dtype(dtype).itemsize)
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
     beta0 = float(RK3_BETA[0])
     alpha1 = float(RK3_ALPHA[1])

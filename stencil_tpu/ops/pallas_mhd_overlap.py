@@ -101,7 +101,8 @@ def mhd_substep_overlap_pallas(fields: Dict[str, jnp.ndarray],
     dtype = fields[FIELDS[0]].dtype
     esub = mhd_tile(dtype)         # slab row tile: 8 f32/f64, 16 bf16
     comp = compute_dtype(dtype)    # bf16 stores, f32 computes
-    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub)
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub, X=X,
+                             itemsize=jnp.dtype(dtype).itemsize)
     assert hr <= min(bz, esub), (hr, bz, esub)
     dta = jnp.dtype(comp)
     inv_ds = (1.0 / prm.dsx, 1.0 / prm.dsy, 1.0 / prm.dsz)
@@ -385,7 +386,9 @@ def mhd_substep_fixup_pallas(fields: Dict[str, jnp.ndarray],
     Z, Y, X = fields[FIELDS[0]].shape
     esub = mhd_tile(fields[FIELDS[0]].dtype)
     comp = compute_dtype(fields[FIELDS[0]].dtype)
-    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub)
+    bz, by = mhd_halo_blocks(Z, Y, block_z, block_y, esub, X=X,
+                             itemsize=jnp.dtype(
+                                 fields[FIELDS[0]].dtype).itemsize)
     nzg = Z // bz
     nyg = Y // by
     if strip == "z":
@@ -520,9 +523,11 @@ def mhd_substep_overlap(fields: Dict[str, jnp.ndarray],
     and returns (new_fields, None)."""
     from ..models.astaroth import FIELDS
 
-    Z, Y, _ = fields[FIELDS[0]].shape
+    Z, Y, X = fields[FIELDS[0]].shape
     bz, _by = mhd_halo_blocks(Z, Y, block_z, block_y,
-                              mhd_tile(fields[FIELDS[0]].dtype))
+                              mhd_tile(fields[FIELDS[0]].dtype), X=X,
+                              itemsize=jnp.dtype(
+                                  fields[FIELDS[0]].dtype).itemsize)
     nzg = Z // bz
     # the caller's interpret mode passes through VERBATIM: an
     # InterpretParams (e.g. detect_races=True from the sanitizer tests)

@@ -103,10 +103,39 @@ def jacobi7_pallas(padded: jnp.ndarray, radius: Radius, interior: Dim3,
     )(padded, padded, padded)
 
 
+#: default block-shape ceilings for the wrap kernels — the planner
+#: picks the cheapest-traffic legal shape at or below these
+_WRAP_CAPS = (8, 128)
+_WRAPN_CAPS = (16, 128)
+
+
+def _wrap_elems(esub: int, n_steps: int = 0):
+    """Per-lane-column element model of the wrap kernels for the block
+    planner (analysis/tiling.py): streamed inputs (main + 2 z segments
+    of ``max(n_steps, 1)`` rows + 2 esub-col y slabs + 4*n_steps corner
+    singles on the N-step kernel), the output block, and — for the
+    N-step kernel — the held assembled window plus its first shrinking
+    intermediate. Must count at least what the GridMapping will show
+    (the plan -> audit round-trip contract)."""
+    n = max(int(n_steps), 0)
+    rows = max(n, 1)
+
+    def elems(bz: int, by: int):
+        ein = bz * by + 2 * rows * by + 2 * bz * esub + 4 * n * esub
+        held = 0
+        if n:
+            held = ((bz + 2 * n) * (by + 2 * n)
+                    + (bz + 2 * n - 2) * (by + 2 * n - 2))
+        return ein, bz * by, held
+
+    return elems
+
+
 def jacobi7_wrap_pallas(interior: jnp.ndarray,
                         hot_c: Tuple[int, int, int],
                         cold_c: Tuple[int, int, int], sph_r: int,
-                        block_z: int = 8, block_y: int = 128,
+                        block_z: Optional[int] = None,
+                        block_y: Optional[int] = None,
                         interpret: Optional[bool] = None) -> jnp.ndarray:
     """Fully-fused periodic Jacobi step for a single-shard axis layout:
     7-point update + Dirichlet sphere sources on an UNPADDED (Z, Y, X)
@@ -118,24 +147,37 @@ def jacobi7_wrap_pallas(interior: jnp.ndarray,
 
     ``hot_c``/``cold_c`` are (cx, cy, cz) sphere centers. Blocks tile
     (z, y); edge reads come from four thin wrapped slabs, so the read
-    amplification is ``1 + 2/block_z + 2/block_y`` and VMEM use is
-    ``~2 * 2 * block_z * block_y * X`` elements.
+    amplification is ``1 + 2/block_z + 2/block_y`` (esub-scaled for the
+    slab fetches) and VMEM use is ``~2 * 2 * block_z * block_y * X``
+    elements. Default (None) blocks come from the VMEM block-shape
+    planner (``analysis/tiling.py``: cheapest legal traffic at or
+    below ``_WRAP_CAPS``, raising when nothing legal exists); explicit
+    blocks are snapped to alignment with a one-shot warning when
+    replaced (budget deliberately unchecked — sweeps measure what they
+    asked for).
     """
+    from ..analysis.tiling import plan_blocks, snap_blocks
+
     if interpret is None:
         interpret = default_interpret()
     Z, Y, X = interior.shape
+    dt_i = jnp.dtype(interior.dtype)
     # y edge slabs are esub rows: the dtype's min sublane tile (8 f32 /
     # 16 bf16) when Y allows, else single rows (small/interpret grids)
     esub = sublane_tile(interior.dtype)
     if Y % esub:
         esub = 1
-    while Z % block_z:
-        block_z //= 2
-    while Y % block_y or block_y % esub:
-        block_y //= 2
-    if block_y < esub:
-        block_y = esub
-    bz, by = block_z, block_y
+    if block_z is None and block_y is None:
+        bz, by = plan_blocks(
+            "jacobi7_wrap_pallas", Z, Y, X, dt_i.itemsize,
+            _wrap_elems(esub), sublane_y=esub,
+            cap_z=_WRAP_CAPS[0], cap_y=_WRAP_CAPS[1]).blocks()
+    else:
+        bz, by = snap_blocks(
+            "jacobi7_wrap_pallas", Z, Y,
+            block_z if block_z is not None else _WRAP_CAPS[0],
+            block_y if block_y is not None else _WRAP_CAPS[1],
+            sublane_y=esub)
     dt = jnp.dtype(interior.dtype)
     hx, hy, hz = hot_c
     cx, cy, cz = cold_c
@@ -205,7 +247,8 @@ def jacobi7_wrapn_pallas(interior: jnp.ndarray,
                          hot_c: Tuple[int, int, int],
                          cold_c: Tuple[int, int, int], sph_r: int,
                          steps: int = 2,
-                         block_z: int = 16, block_y: int = 128,
+                         block_z: Optional[int] = None,
+                         block_y: Optional[int] = None,
                          interpret: Optional[bool] = None) -> jnp.ndarray:
     """``steps`` fused periodic Jacobi iterations (+ sphere sources
     after each) in ONE HBM pass — temporal blocking. The single-step
@@ -226,6 +269,8 @@ def jacobi7_wrapn_pallas(interior: jnp.ndarray,
     exact-radius). Needs Z % bz == 0, Y and by multiples of the
     dtype's sublane tile (8 f32 / 16 bf16), and steps <= that tile.
     """
+    from ..analysis.tiling import plan_blocks, snap_blocks
+
     if interpret is None:
         interpret = default_interpret()
     N = int(steps)
@@ -237,13 +282,18 @@ def jacobi7_wrapn_pallas(interior: jnp.ndarray,
     if Y % esub:
         raise ValueError(f"wrap{N} kernel needs Y % {esub} == 0, "
                          f"got Y={Y}")
-    bz, by = max(block_z, 1), block_y
-    while bz > 1 and Z % bz:
-        bz //= 2
-    while by > esub and (Y % by or by % esub):
-        by //= 2
-    if by < esub or Y % by or by % esub:
-        by = esub
+    isz = jnp.dtype(interior.dtype).itemsize
+    if block_z is None and block_y is None:
+        bz, by = plan_blocks(
+            f"jacobi7_wrapn_pallas[n={N}]", Z, Y, X, isz,
+            _wrap_elems(esub, N), sublane_y=esub,
+            cap_z=_WRAPN_CAPS[0], cap_y=_WRAPN_CAPS[1]).blocks()
+    else:
+        bz, by = snap_blocks(
+            f"jacobi7_wrapn_pallas[n={N}]", Z, Y,
+            block_z if block_z is not None else _WRAPN_CAPS[0],
+            block_y if block_y is not None else _WRAPN_CAPS[1],
+            sublane_y=esub)
     # N-row slab fetches when block alignment permits (fewer, fatter
     # DMAs — the N=2 default then matches the original pair kernel's
     # descriptor structure exactly); single-row fetches otherwise
@@ -362,7 +412,8 @@ def jacobi7_wrapn_pallas(interior: jnp.ndarray,
 def jacobi7_wrap2_pallas(interior: jnp.ndarray,
                          hot_c: Tuple[int, int, int],
                          cold_c: Tuple[int, int, int], sph_r: int,
-                         block_z: int = 16, block_y: int = 128,
+                         block_z: Optional[int] = None,
+                         block_y: Optional[int] = None,
                          interpret: Optional[bool] = None) -> jnp.ndarray:
     """Two fused iterations per HBM pass — ``jacobi7_wrapn_pallas``
     with steps=2. Kept as a stable named entry for kernel-level tests

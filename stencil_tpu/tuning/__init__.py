@@ -48,16 +48,19 @@ from .cache import (default_cache_path, invalidate_plan, load_plan,
 from .fit import calibrate_link, coefficients_record, fit_alpha_beta
 from .measure import CountingTimer, FakeTimer, MeshTimer
 from .plan import (DEFAULT_DEPTHS, Candidate, MigrationCandidate, Plan,
-                   TuneGeometry, candidate_space,
+                   TilingCandidate, TuneGeometry, candidate_space,
                    migration_candidate_space, fingerprint,
-                   fingerprint_inputs, rank_migration_candidates)
+                   fingerprint_inputs, rank_migration_candidates,
+                   rank_tiling_candidates, tiling_candidate_space,
+                   tiling_record)
 
 __all__ = [
-    "Candidate", "MigrationCandidate", "Plan", "TuneGeometry",
-    "FakeTimer", "MeshTimer",
+    "Candidate", "MigrationCandidate", "Plan", "TilingCandidate",
+    "TuneGeometry", "FakeTimer", "MeshTimer",
     "CountingTimer", "LinkCoefficients", "autotune_domain",
     "run_autotune", "candidate_space", "migration_candidate_space",
-    "rank_migration_candidates", "calibrate_link",
+    "rank_migration_candidates", "rank_tiling_candidates",
+    "tiling_candidate_space", "tiling_record", "calibrate_link",
     "fit_alpha_beta", "fingerprint", "fingerprint_inputs",
     "default_cache_path", "load_plan", "store_plan", "invalidate_plan",
     "DEFAULT_DEPTHS",
@@ -158,7 +161,11 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
                 created=_time.time(),
                 library_version=str(inputs.get("library_version", "")),
                 fingerprint_inputs=dict(inputs),
-                predicted_best_depth=best_depth)
+                predicted_best_depth=best_depth,
+                # the VMEM planner's prescribed Pallas block shape for
+                # this geometry rides the plan record: Method.Auto
+                # ships tile shapes the way it ships exchange methods
+                tiling=tiling_record(geom))
     LOG_INFO(f"autotune: measured {len(survivors)}/{len(cands)} "
              f"candidates (pruned {pruned} by the calibrated model; "
              f"depth crossover predicts s={best_depth}) -> "

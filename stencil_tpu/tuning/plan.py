@@ -142,6 +142,101 @@ def candidate_space(geom: TuneGeometry,
 
 
 # ---------------------------------------------------------------------------
+# VMEM tiling candidates (the Pallas block-shape tuning axis)
+
+#: sustained HBM bytes/s one core can stream — a TPU-v4-ballpark
+#: constant (the tuner's pingpong fit calibrates the WIRE, not HBM;
+#: ranking block shapes only needs a monotone price, and amplification
+#: differences dominate any bandwidth rescale)
+DEFAULT_HBM_BYTES_PER_S = 1.2e12
+
+
+@dataclasses.dataclass(frozen=True)
+class TilingCandidate:
+    """One planner-legal Pallas block shape, priced by the static VMEM
+    planner (``analysis/tiling.py``): the double-buffered footprint it
+    stages and the modeled HBM read amplification its edge refetches
+    cost. The tuner ranks these exactly like exchange methods — the
+    calibrated model orders, the plan record carries the winner — so
+    ``Method.Auto`` ships a tile shape the same way it ships an
+    exchange strategy."""
+
+    block_z: int
+    block_y: int
+    footprint_bytes: int = 0
+    amplification: float = 1.0
+
+    def key(self) -> str:
+        return f"tile[bz={self.block_z},by={self.block_y}]"
+
+
+def tiling_candidate_space(geom: TuneGeometry,
+                           kernel: str = "jacobi7_halo_pallas",
+                           cap_z: int = 16, cap_y: int = 128
+                           ) -> List[TilingCandidate]:
+    """Every planner-legal block shape for the production multi-device
+    Pallas kernel (the Jacobi halo kernel — the SNIPPETS.md 512^3
+    failure's kernel) at this shard geometry, planner-ranked. Empty
+    when the planner proves the shard infeasible (the model then
+    declines the Pallas path; ``Plan.tiling`` records the constraint)."""
+    from ..analysis.tiling import plan_blocks
+    from ..ops.pallas_halo import _jacobi_halo_elems
+    from ..ops.pallas_stencil import sublane_tile_bytes
+
+    z, y, x = geom.shard_interior_zyx
+    isz = max(geom.elem_sizes) if geom.elem_sizes else 4
+    esub = sublane_tile_bytes(isz)
+    if y % esub:
+        esub = 1
+    plan = plan_blocks(kernel, z, y, x, isz, _jacobi_halo_elems(esub),
+                       sublane_y=esub, cap_z=cap_z, cap_y=cap_y)
+    return [TilingCandidate(o.block_z, o.block_y, o.footprint_bytes,
+                            o.amplification) for o in plan.options]
+
+
+def rank_tiling_candidates(geom: TuneGeometry,
+                           candidates: Optional[
+                               Sequence[TilingCandidate]] = None,
+                           hbm_bytes_per_s: float = DEFAULT_HBM_BYTES_PER_S
+                           ) -> List[Tuple[float, TilingCandidate]]:
+    """Rank legal tile shapes by modeled HBM seconds per step:
+    ``(amplification + 1) x interior bytes / bandwidth`` (one amplified
+    read pass + one write pass), cheapest first; ties prefer the fatter
+    ``block_y`` then ``block_z`` (fatter lane-aligned DMAs)."""
+    cands = (list(candidates) if candidates is not None
+             else tiling_candidate_space(geom))
+    z, y, x = geom.shard_interior_zyx
+    isz = max(geom.elem_sizes) if geom.elem_sizes else 4
+    interior_bytes = z * y * x * isz
+    ranked = [((c.amplification + 1.0) * interior_bytes
+               / float(hbm_bytes_per_s), c) for c in cands]
+    ranked.sort(key=lambda t: (t[0], -t[1].block_y, -t[1].block_z))
+    return ranked
+
+
+def tiling_record(geom: TuneGeometry) -> Dict[str, Dict]:
+    """The ``Plan.tiling`` payload: the prescribed block shape (and its
+    planner metrics) per production Pallas kernel for this geometry —
+    what a fleet pre-baking plans ships, and what the observatory
+    ledger stamps bench records with so future real-TPU numbers group
+    against the shapes that produced them. The kernels re-derive the
+    identical shape deterministically from the same planner, so the
+    record is provenance, not a second source of truth."""
+    ranked = rank_tiling_candidates(geom)
+    if not ranked:
+        return {"jacobi7_halo_pallas": {
+            "infeasible": "no planner-legal block shape at this shard "
+                          "geometry (see analysis.tiling targets)"}}
+    modeled_s, c = ranked[0]
+    return {"jacobi7_halo_pallas": {
+        "block": [c.block_z, c.block_y],
+        "footprint_bytes": c.footprint_bytes,
+        "amplification": c.amplification,
+        "modeled_hbm_s_per_step": modeled_s,
+    }}
+
+
+# ---------------------------------------------------------------------------
 # particle-migration candidates (the PIC workload's tuning axis)
 
 
@@ -302,6 +397,10 @@ class Plan:
     #: predict_exchange_every's calibrated depth-crossover estimate
     #: (observability: what the analytic model alone would have picked)
     predicted_best_depth: Optional[int] = None
+    #: kernel -> the VMEM planner's prescribed block shape + metrics
+    #: (:func:`tiling_record`) — plan-cache records carry the chosen
+    #: tile shape the same way they carry the chosen exchange method
+    tiling: Dict[str, Dict] = dataclasses.field(default_factory=dict)
 
     def to_record(self) -> Dict:
         rec = dataclasses.asdict(self)  # recurses into Candidate
@@ -324,4 +423,5 @@ class Plan:
             library_version=str(rec.get("library_version", "")),
             fingerprint_inputs=rec.get("fingerprint_inputs"),
             predicted_best_depth=rec.get("predicted_best_depth"),
+            tiling=dict(rec.get("tiling", {})),
         )

@@ -1,0 +1,51 @@
+"""Negative control for the prescriptive-tiling checker.
+
+The SNIPPETS.md motivating failure, reproduced verbatim as a fixture:
+the Jacobi halo kernel pinned to its OLD default block shape (16, 128)
+at the 512^3-per-device size where the judge measured Mosaic's VMEM
+allocation failing on real TPU — 20 MiB of double-buffered blocks
+against the 16 MiB physical budget (the kernel's raised
+``vmem_limit_bytes`` hid it from the plain VMEM checker, which honors
+declared limits; the tiling checker deliberately does not). The
+planner's prescription for this size is (8, 128) at 11 MiB — the
+registered ``analysis.tiling...jacobi7_halo_pallas[512]`` target
+proves that shape clean; THIS target proves the checker flags the bad
+one, with the suggestion attached.
+``python -m stencil_tpu.analysis tests/fixtures/lint/bad_tiling.py``
+MUST exit nonzero.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.analysis import TilingSpec, TilingTarget
+
+
+def _f32(shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _old_default_at_512() -> TilingSpec:
+    from stencil_tpu.ops.pallas_halo import jacobi7_halo_pallas
+
+    S = 512
+    slabs = {"zlo": _f32((1, S, S)), "zhi": _f32((1, S, S)),
+             "ylo": _f32((S, 8, S)), "yhi": _f32((S, 8, S))}
+    org = jax.ShapeDtypeStruct((3,), jnp.int32)
+
+    def fn(interior, zlo, zhi, ylo, yhi, o):
+        return jacobi7_halo_pallas(
+            interior, {"zlo": zlo, "zhi": zhi, "ylo": ylo, "yhi": yhi},
+            o, (128, 256, 256), (384, 256, 256), 64,
+            block_z=16, block_y=128,   # the pre-planner default shape
+            interpret=False)
+
+    return TilingSpec(fn=fn, args=(_f32((S, S, S)), slabs["zlo"],
+                                   slabs["zhi"], slabs["ylo"],
+                                   slabs["yhi"], org))
+
+
+TARGETS = [
+    TilingTarget("fixture.jacobi_halo_old_default_shape_at_512",
+                 _old_default_at_512),
+]

@@ -26,7 +26,7 @@ FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "lint"
 
 @pytest.fixture(scope="module")
 def full_report():
-    """One run of all nine checkers over the shipped registry, shared
+    """One run of all ten checkers over the shipped registry, shared
     by every test that asserts on it (the donation block compiles all
     its entry points — paying that once per module, not per test)."""
     return run_targets(default_targets())
@@ -47,10 +47,10 @@ def test_shipped_registry_is_clean(full_report):
     assert floor >= 105  # the PR 9 acceptance criterion itself
     assert len(report.targets_checked) >= floor
     assert report.ok
-    # all nine checkers actually ran (and were timed)
+    # all ten checkers actually ran (and were timed)
     assert set(report.checker_seconds) == {
         "footprint", "dma", "collectives", "hlo", "costmodel", "vmem",
-        "donation", "transfer", "recompile"}
+        "donation", "transfer", "recompile", "tiling"}
 
 
 def test_checker_filter():
@@ -320,6 +320,51 @@ def test_dataflow_entry_points_all_pass(full_report):
     assert m["aliased_params"] and 0 in m["aliased_params"]
 
 
+def test_tiling_fixture_flagged():
+    """The SNIPPETS.md 512^3 failure as a negative control: the Jacobi
+    halo kernel pinned to the old default (16, 128) block shape is
+    flagged at the PHYSICAL budget (its raised vmem_limit_bytes hid it
+    from the plain vmem checker) and the finding carries the planner's
+    concrete prescription — the (8, 128) shape the registry's legal
+    512^3 target proves clean."""
+    report = run_targets(load_targets(FIXTURES / "bad_tiling.py"))
+    assert not report.ok
+    (f,) = report.errors
+    assert f.checker == "tiling"
+    assert f.target.startswith(
+        "fixture.jacobi_halo_old_default_shape_at_512")
+    assert "20971520 B" in f.message and "exceeds" in f.message
+    assert "suggestion: block shape (8, 128)" in f.message
+
+
+def test_tiling_registry_production_sizes(full_report):
+    """The acceptance criterion: every registered Pallas kernel is
+    gated at 256^3- AND 512^3-per-device shapes, the Jacobi production
+    family (plane/wrap/wrapn/halo/halon) proves LEGAL planner-derived
+    shapes at 512^3, and the pinned-infeasible kernels are verdicts,
+    not silences (refused or flagged-as-expected, never unaudited)."""
+    report = full_report
+    tiling = [n for n in report.targets_checked
+              if n.startswith("analysis.tiling.")]
+    assert len(tiling) >= 28
+    for side in (256, 512):
+        assert sum(1 for n in tiling if n.endswith(f"[{side}]")) >= 14
+    for kernel in ("ops.pallas_stencil.jacobi7_pallas",
+                   "ops.pallas_stencil.jacobi7_wrap_pallas",
+                   "ops.pallas_stencil.jacobi7_wrapn_pallas[n=2]",
+                   "ops.pallas_halo.jacobi7_halo_pallas",
+                   "ops.pallas_halo.jacobi7_halon_pallas[n=2]"):
+        m = report.metrics[f"tiling:analysis.tiling.{kernel}[512]"]
+        assert m["verdict"] == "legal", (kernel, m)
+    # the pinned-infeasible kernels record WHY (binding constraint or
+    # expected findings), proving the audit has teeth at these sizes
+    for kernel in ("ops.pallas_halo.mhd_substep_halo_pallas",
+                   "ops.pallas_mhd.mhd_substep_wrap_pallas"):
+        m = report.metrics[f"tiling:analysis.tiling.{kernel}[512]"]
+        assert m["verdict"] in ("refused-at-build", "refused-at-trace",
+                                "flagged-as-expected"), (kernel, m)
+
+
 def test_vmem_fixture_flagged():
     report = run_targets(load_targets(FIXTURES / "bad_vmem.py"))
     assert not report.ok
@@ -462,7 +507,8 @@ def test_cli_only_accepts_target_globs(tmp_path):
                                      "bad_transfer.py",
                                      "bad_recompile.py",
                                      "bad_migration.py",
-                                     "bad_attribution.py"])
+                                     "bad_attribution.py",
+                                     "bad_tiling.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""
