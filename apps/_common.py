@@ -268,6 +268,16 @@ def emit_bench_artifacts(args, payload, source: str):
             if payload.get("tiling_plan"):
                 rec["config"].setdefault("tiling_plan",
                                          payload["tiling_plan"])
+            # link-class provenance: the per-(axis, link_class) byte
+            # SHARES of the modeled traffic matrix, stamped AFTER the
+            # fingerprint is fixed — records group the same with or
+            # without it (trajectories never fork), future records
+            # just carry which fabric tier their bytes rode
+            if payload.get("link_classes"):
+                rec["config"].setdefault(
+                    "link_classes",
+                    {k: round(v["share"], 6)
+                     for k, v in payload["link_classes"].items()})
             append_record(ledger, rec)
         for s in skipped:
             print(f"{source}: ledger skip: {s}", file=sys.stderr)

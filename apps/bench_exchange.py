@@ -113,6 +113,7 @@ def main() -> None:
         return n, dt, sps, j
 
     results = []
+    link_classes = None  # baseline depth's classified link map
     for s in depths:
         dd = DistributedDomain(gx, gy, gz)
         dd.set_mesh_shape(mesh_shape)
@@ -155,6 +156,31 @@ def main() -> None:
               f"(jacobi blocked loop) rounds/step={1.0 / s:.3f} "
               f"amortized={dd.exchange_bytes_amortized_per_step():.0f}"
               f"B/step (model)", file=sys.stderr)
+
+        if s == depths[0]:
+            # link observatory: classify the baseline configuration's
+            # modeled traffic matrix against the deployed device order
+            # and pair it with the measured per-exchange seconds —
+            # per-link B/step + achieved/fitted-peak utilization (the
+            # ROADMAP item 3 placement signal, live on every bench)
+            from stencil_tpu.observatory.linkmap import \
+                link_attribution_for
+            link = link_attribution_for(dd)
+            if link is not None:
+                # ONE derived block feeds all three surfaces (the
+                # JSON payload, the metrics gauges, the ledger
+                # stamp): utilization = the link's B/s during the
+                # measured exchange round over its fitted peak
+                total = sum(link["bytes_per_step"].values()) or 1.0
+                link_classes = {
+                    f"{axis}/{klass}": {
+                        "bytes_per_step": b,
+                        "share": b / total,
+                        "utilization": (b * s / tm)
+                        / link["peak_bytes_per_s"].get(axis, 1e30),
+                    }
+                    for (axis, klass), b
+                    in sorted(link["bytes_per_step"].items())}
 
     autotune_cmp = None
     if args.autotune:
@@ -304,6 +330,12 @@ def main() -> None:
             comparison["autotune"] = autotune_cmp
         if fused_cmp is not None:
             comparison["fused"] = fused_cmp
+        if link_classes is not None:
+            # per-(axis, link_class) byte shares + utilization — the
+            # SAME derived block lands in this JSON, the metrics
+            # snapshot below, and (as config.link_classes provenance)
+            # the ledger record
+            comparison["link_classes"] = link_classes
         # one payload, two artifacts: the legacy JSON plus the
         # observatory ledger records derived from it (same converter
         # the backfill CLI runs on the committed BENCH_*.json history)
@@ -349,6 +381,24 @@ def main() -> None:
                         mode="fused", check_every=ck)
             g_fused.set(fused_cmp["stepwise_steps_per_s"],
                         mode="stepwise", check_every=ck)
+        if link_classes is not None:
+            # the link observatory's two gauges, set from the SAME
+            # derived block the JSON pins (CI asserts exact equality
+            # between the two surfaces)
+            from stencil_tpu.observatory.linkmap import (
+                METRIC_LINK_BYTES_PER_STEP, METRIC_LINK_UTILIZATION)
+            g_lb = reg.gauge(METRIC_LINK_BYTES_PER_STEP,
+                             "modeled wire B/step per mesh axis and "
+                             "link class (observatory/linkmap.py)")
+            g_lu = reg.gauge(METRIC_LINK_UTILIZATION,
+                             "achieved/fitted-peak utilization per "
+                             "mesh axis and link class")
+            for key, row in link_classes.items():
+                axis, klass = key.split("/")
+                g_lb.set(row["bytes_per_step"], axis=axis,
+                         link_class=klass)
+                g_lu.set(row["utilization"], axis=axis,
+                         link_class=klass)
         reg.write_snapshot(args.metrics_json)
         print(f"bench_exchange: metrics snapshot -> "
               f"{args.metrics_json}", file=sys.stderr)

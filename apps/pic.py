@@ -160,6 +160,23 @@ def main() -> None:
         "overflow": p.overflow_total(),
         "total_charge": p.total_charge(),
     }
+    # link-class provenance: the full PIC wire bill (accumulate
+    # adjoint + exchange + migration ring) classified per (axis,
+    # link_class) — rides the ledger record as config.link_classes
+    from stencil_tpu.models.pic import PARTICLE_FIELDS, RADIUS
+    from stencil_tpu.observatory.linkmap import classify, pic_traffic
+    from stencil_tpu.geometry import Radius
+    from stencil_tpu.parallel.mesh import mesh_dim
+    counts = mesh_dim(p.dd.mesh)
+    local = p.dd.local_size
+    tm = pic_traffic((local.z, local.y, local.x),
+                     Radius.constant(RADIUS), counts,
+                     p._dtype.itemsize, len(PARTICLE_FIELDS), p.budget)
+    if tm.edges:
+        summary = classify(tm).to_record()
+        rec["link_classes"] = {
+            k: {"bytes_per_step": v["bytes"], "share": v["share"]}
+            for k, v in summary["links"].items()}
     emit_bench_artifacts(args, rec, "pic")
     if args.metrics_json:
         # one number, two artifacts: the SAME figures as the JSON

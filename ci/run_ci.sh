@@ -24,13 +24,14 @@ cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
 echo "== 1/12 lint (stencil-lint + ruff; tier=$TIER) =="
-# stencil-lint: all ten static checkers — halo-radius footprint, DMA
-# discipline, ppermute sanity, HLO collective-permute-only lowering,
-# analytic-vs-HLO byte cross-check, the Pallas VMEM/tiling audit, the
-# dataflow trio (donation aliasing, host-transfer hygiene,
-# recompile-hazard fingerprints), and the prescriptive block-shape
-# tiling gate (every Pallas kernel at 256^3/512^3-per-device shapes
-# against the PHYSICAL VMEM budget — trace-only, no TPU)
+# stencil-lint: all eleven static checkers — halo-radius footprint,
+# DMA discipline, ppermute sanity, HLO collective-permute-only
+# lowering, analytic-vs-HLO byte cross-check, the Pallas VMEM/tiling
+# audit, the dataflow trio (donation aliasing, host-transfer hygiene,
+# recompile-hazard fingerprints), the prescriptive block-shape tiling
+# gate (every Pallas kernel at 256^3/512^3-per-device shapes against
+# the PHYSICAL VMEM budget — trace-only, no TPU), and the link
+# observatory's traffic-matrix-vs-HLO exactness gate
 # (python -m stencil_tpu.analysis, see README "Static analysis").
 # The hlo/costmodel byte checks capability-gate themselves on the
 # image's JAX (StableHLO lowering support is probed; Pallas targets
@@ -58,6 +59,16 @@ python -m stencil_tpu.analysis --plan-tiling 'analysis.tiling.*' \
   --json stencil_tiling_plans.json > /dev/null
 if [ -n "${CI_ARTIFACT_DIR:-}" ] && [ -f stencil_tiling_plans.json ]; then
   cp stencil_tiling_plans.json "$CI_ARTIFACT_DIR/"
+fi
+# the link observatory artifact: the modeled per-link traffic matrix
+# (whose per-method totals the linkmap checker just pinned HLO-exactly
+# above) plus the placement-quality report — QAP placement cost must
+# not lose to trivial placement on any registered mesh (ROADMAP item
+# 3's gate, exit nonzero on failure)
+python -m stencil_tpu.observatory linkmap --placement-report \
+  --json stencil_linkmap.json > /dev/null
+if [ -n "${CI_ARTIFACT_DIR:-}" ] && [ -f stencil_linkmap.json ]; then
+  cp stencil_linkmap.json "$CI_ARTIFACT_DIR/"
 fi
 # registry-count ratchet: audit coverage may only grow. A refactor
 # that drops targets (deregisters an entry point, deletes a checker
@@ -193,6 +204,21 @@ for mode, key in (("fused", "fused_steps_per_s"),
     got = snapshot_value(snap, "stencil_bench_fused_steps_per_s",
                          mode=mode, check_every=ck)
     assert got == fz[key], (mode, got, fz[key])
+# link observatory parity: the two per-link gauges record the SAME
+# figures the JSON's link_classes block pins — and the classes must
+# actually partition the traffic (shares sum to 1)
+lc = d.get("link_classes")
+assert lc, "bench payload carries no link_classes block"
+assert abs(sum(v["share"] for v in lc.values()) - 1.0) < 1e-9, lc
+for key, v in lc.items():
+    axis, klass = key.split("/")
+    got = snapshot_value(snap, "stencil_link_bytes_per_step",
+                         axis=axis, link_class=klass)
+    assert got == v["bytes_per_step"], (key, got, v)
+    got = snapshot_value(snap, "stencil_link_utilization_ratio",
+                         axis=axis, link_class=klass)
+    assert got == v["utilization"], (key, got, v)
+    assert 0 < v["utilization"] < 1, (key, v)
 print(f"bench smoke OK: rounds/step x{1/rounds['4']:.0f} fewer, "
       f"steps/s ratio {speed['4']:.2f}, tuned/default "
       f"x{at['tuned_over_default']:.2f} "
@@ -324,6 +350,16 @@ PIC_METRICS="$(mktemp -t pic_metrics.XXXXXX.json)"
         --fake-cpu 8 --deposition ngp --f64 \
         --json-out "$PIC_BENCH" --metrics-json "$PIC_METRICS" \
         > /dev/null
+  # second fingerprint-identical measured run: gives the observatory
+  # ledger a genuine same-(fingerprint, bench) TRAJECTORY (two
+  # records, one group) so stage 9's gate actually compares something
+  # — its --min-groups floor pins that this never silently regresses
+  # to a vacuous 0-group pass
+  STENCIL_BENCH_LEDGER="$OBS_LEDGER" \
+  python pic.py --x 8 --y 8 --z 8 --particles 64 --iters 4 --batch 2 \
+        --fake-cpu 8 --deposition ngp --f64 \
+        --json-out "$PIC_BENCH.2" > /dev/null
+  rm -f "$PIC_BENCH.2"
   python pic.py --x 8 --y 8 --z 8 --particles 64 --iters 6 --fake-cpu 8 \
         --resilient --ckpt-dir "$PIC_CKPT" --ckpt-every 2 \
         --check-every 1 --chaos-particle-loss 3 \
@@ -378,7 +414,19 @@ echo "== 9/12 observatory: bench ledger validate/gate + backfill =="
 # legacy BENCH_*.json history (validated + diffed) the way the
 # committed bench/ledger.jsonl was seeded.
 python -m stencil_tpu.observatory validate "$OBS_LEDGER"
-python -m stencil_tpu.observatory gate "$OBS_LEDGER" --threshold 0.5
+# --min-groups 1: the smoke runs above MUST have produced at least one
+# comparable (fingerprint, bench) group — an empty/group-less ledger
+# exits 0 with a "no measured trajectory" note in dev, but in CI that
+# would be a vacuous pass (benches stopped appending), so the
+# committed coverage floor turns it into a loud failure; the verdict
+# JSON (groups_checked stamped) is archived with the stage artifacts
+OBS_GATE_JSON="$(mktemp -t obs_gate.XXXXXX.json)"
+# threshold 0.8: back-to-back 8^3 smoke runs on a shared CI box are
+# noisy (compile/thread scheduling) — the gate exists to catch the
+# order-of-magnitude class of regression, which the synthetic 10x
+# check below proves it does at this threshold
+python -m stencil_tpu.observatory gate "$OBS_LEDGER" --threshold 0.8 \
+  --min-groups 1 --json "$OBS_GATE_JSON"
 OBS_BAD="$(mktemp -t obs_bad.XXXXXX.jsonl)"
 cp "$OBS_LEDGER" "$OBS_BAD"
 OBS_LEDGER="$OBS_LEDGER" OBS_BAD="$OBS_BAD" python - <<'EOF'
@@ -393,7 +441,7 @@ rec["created"] += 1.0
 with open(os.environ["OBS_BAD"], "a") as f:
     f.write(json.dumps(rec) + "\n")
 EOF
-if python -m stencil_tpu.observatory gate "$OBS_BAD" --threshold 0.5; then
+if python -m stencil_tpu.observatory gate "$OBS_BAD" --threshold 0.8; then
   echo "observatory gate FAILED to catch the synthetic regression"
   exit 1
 else
@@ -406,17 +454,27 @@ python -m stencil_tpu.observatory backfill --out "$OBS_LEGACY" \
   BENCH_r05.json
 python -m stencil_tpu.observatory validate "$OBS_LEGACY"
 # the live smoke records and their backfilled ancestors share one
-# converter, so the bench_exchange trajectory diffs across them
-python -m stencil_tpu.observatory diff "$OBS_LEGACY" \
-  --bench bench_exchange
+# converter, so the bench_exchange trajectory diffs across them. A
+# group-less ledger now exits 0 with a note, so grep for an actual
+# metric row — a converter regression that forked the trajectory
+# groups must fail HERE, not print a polite note and pass
+OBS_DIFF_OUT="$(python -m stencil_tpu.observatory diff "$OBS_LEGACY" \
+  --bench bench_exchange)"
+echo "$OBS_DIFF_OUT"
+if ! grep -q "steps_per_s" <<< "$OBS_DIFF_OUT"; then
+  echo "observatory diff found no comparable bench_exchange" \
+       "trajectory — the backfill converter forked the groups"
+  exit 1
+fi
 # the committed seed ledger stays in sync with the backfill converter
 python -m stencil_tpu.observatory validate bench/ledger.jsonl
 if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
   mkdir -p "$CI_ARTIFACT_DIR"
   cp "$OBS_LEDGER" "$CI_ARTIFACT_DIR/bench_ledger.jsonl"
   cp "$OBS_LEGACY" "$CI_ARTIFACT_DIR/bench_ledger_legacy.jsonl"
+  cp "$OBS_GATE_JSON" "$CI_ARTIFACT_DIR/bench_ledger_gate.json"
 fi
-rm -f "$OBS_LEDGER" "$OBS_BAD" "$OBS_LEGACY"
+rm -f "$OBS_LEDGER" "$OBS_BAD" "$OBS_LEGACY" "$OBS_GATE_JSON"
 
 echo "== 10/12 service smoke: concurrent multi-tenant ensemble campaigns =="
 # the campaign service (stencil_tpu/serving) on the fake CPU mesh:

@@ -1,7 +1,7 @@
 """stencil-lint / stencil-audit: static invariant checking for the
 stencil framework.
 
-Ten checkers prove, WITHOUT executing anything (jaxpr tracing plus
+Eleven checkers prove, WITHOUT executing anything (jaxpr tracing plus
 lower-only StableHLO inspection and alias-map parsing of compiled —
 never dispatched — programs; seconds on any CPU box, no TPU, no
 interpreter), the invariants the whole framework hangs on:
@@ -40,7 +40,13 @@ interpreter), the invariants the whole framework hangs on:
   targets gate every kernel at 256^3/512^3-per-device shapes against
   the PHYSICAL VMEM budget (raised ``vmem_limit_bytes`` deliberately
   distrusted — the SNIPPETS.md 512^3 Mosaic allocation failure,
-  reproduced and closed).
+  reproduced and closed);
+* ``linkmap`` (:mod:`stencil_tpu.observatory.linkmap`) — the link
+  observatory's modeled per-(src, dst) traffic matrix sums EXACTLY to
+  the HLO-extracted wire bytes for every registered exchange method
+  (slab/packed at every plan depth, the all-gather control, particle
+  migration, the PIC accumulate adjoint) — the matrix the placement
+  QAP consumes and the wire bill the HLO proves are one object.
 
 Run ``python -m stencil_tpu.analysis`` (exit nonzero on findings,
 ``--json`` for the CI artifact, ``--only``/``--list`` to select
@@ -71,9 +77,15 @@ from .tiling import (TilingInfeasibleError, TilingPlan, TilingSpec,
                      TilingTarget, check_tiling, plan_blocks,
                      snap_blocks)
 from .vmem import VmemSpec, VmemTarget, check_vmem
+# checker 11 lives with the link observatory it verifies (the modeled
+# per-link traffic matrix, stencil_tpu/observatory/linkmap.py) — only
+# the registration is here
+from ..observatory.linkmap import (LinkmapSpec, LinkmapTarget,
+                                   check_linkmap)
 
 CHECKERS = ("footprint", "dma", "collectives", "hlo", "costmodel",
-            "vmem", "donation", "transfer", "recompile", "tiling")
+            "vmem", "donation", "transfer", "recompile", "tiling",
+            "linkmap")
 
 CHECKER_DOC = {
     "footprint": "26-direction access footprint vs declared Radius",
@@ -86,6 +98,7 @@ CHECKER_DOC = {
     "transfer": "no host-callback/infeed/outfeed escape in hot paths",
     "recompile": "dispatch-stable abstract fingerprints (no retrace)",
     "tiling": "prescriptive VMEM block-shape planner at 256^3/512^3",
+    "linkmap": "per-link traffic matrix sums exactly to HLO bytes",
 }
 
 __all__ = [
@@ -93,13 +106,14 @@ __all__ = [
     "CollectiveSpec", "CollectiveTarget", "CostModelSpec",
     "CostModelTarget", "DonationSpec", "DonationTarget", "HloSpec",
     "HloTarget", "PallasKernelSpec", "PallasKernelTarget",
+    "LinkmapSpec", "LinkmapTarget",
     "RecompileGuardError", "RecompileSpec", "RecompileTarget",
     "SingleCompileGuard", "StencilOpSpec", "StencilOpTarget",
     "TransferSpec", "TransferTarget", "VmemSpec", "VmemTarget",
     "alias_param_ids", "assert_single_compile", "check_collectives",
     "check_costmodel", "check_donation", "check_hlo",
-    "check_pallas_kernels", "check_recompile", "check_stencil_op",
-    "check_tiling", "check_transfer", "check_vmem",
+    "check_linkmap", "check_pallas_kernels", "check_recompile",
+    "check_stencil_op", "check_tiling", "check_transfer", "check_vmem",
     "hot_loop_transfer_guard", "plan_blocks", "run_targets",
     "snap_blocks",
 ]
@@ -115,6 +129,7 @@ _DISPATCH = {
     "transfer": check_transfer,
     "recompile": check_recompile,
     "tiling": check_tiling,
+    "linkmap": check_linkmap,
 }
 
 

@@ -44,8 +44,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="stencil-lint: static halo-radius / DMA-discipline "
                     "/ collective-permutation / HLO-lowering / "
                     "cost-model / VMEM / donation / host-transfer / "
-                    "recompile / prescriptive-tiling checks "
-                    "(no execution)")
+                    "recompile / prescriptive-tiling / link-traffic "
+                    "checks (no execution)")
     parser.add_argument("fixtures", nargs="*",
                         help="fixture module paths (files defining "
                              "TARGETS) to check instead of the shipped "

@@ -32,10 +32,11 @@ from .hlo import HloSpec, HloTarget
 from .recompile import RecompileSpec, RecompileTarget
 from .transfer import TransferSpec, TransferTarget
 from .vmem import VmemSpec, VmemTarget
+from ..observatory.linkmap import LinkmapSpec, LinkmapTarget
 
 Target = Union[StencilOpTarget, PallasKernelTarget, CollectiveTarget,
                HloTarget, CostModelTarget, VmemTarget, DonationTarget,
-               TransferTarget, RecompileTarget]
+               TransferTarget, RecompileTarget, LinkmapTarget]
 
 
 def _f32(shape):
@@ -993,6 +994,93 @@ def _attribution_pic_hlo() -> HloSpec:
 
 
 # ---------------------------------------------------------------------------
+# link-observatory targets: the modeled per-(src, dst) traffic matrix
+# (observatory/linkmap.py) must sum EXACTLY to the HLO-extracted wire
+# bytes for every registered exchange method — slab/packed at every
+# plan depth, the all-gather control, particle migration, and the full
+# fused PIC step (whose bill includes the halo-accumulate adjoint).
+# Each builder pairs a collective spec already under the hlo/cost
+# gates with the linkmap twin of its byte expectation, so the matrix
+# the placement QAP consumes and the wire bill the HLO proves are one
+# object. tests/fixtures/lint/bad_linkmap.py (a matrix that drops the
+# corner bytes riding the fat axis slabs — the classic 6-neighbor-only
+# bug) is the negative control.
+
+
+def _linkmap_exchange_spec(radius_kind: str) -> LinkmapSpec:
+    from ..geometry import Dim3
+    from ..observatory.linkmap import sweep_traffic
+
+    cs = _exchange_spec(radius_kind)
+    traffic = sweep_traffic(_exchange_shard_shape(),
+                            _exchange_radius(radius_kind),
+                            Dim3(*_EXCHANGE_MESH), (4,))
+    return LinkmapSpec(fn=cs.fn, args=cs.args, traffic=traffic)
+
+
+def _linkmap_packed_uneven_spec() -> LinkmapSpec:
+    from ..geometry import Dim3, Radius
+    from ..observatory.linkmap import sweep_traffic
+
+    cs = _exchange_packed_uneven_spec()
+    # capacity shard (10,10,10); f32 + bf16 pack in separate groups —
+    # launches differ, payload does not (same convention as the cost
+    # target)
+    traffic = sweep_traffic((10, 10, 10), Radius.constant(1),
+                            Dim3(2, 2, 2), (4, 2))
+    return LinkmapSpec(fn=cs.fn, args=cs.args, traffic=traffic)
+
+
+def _linkmap_plan_spec(method_name: str, s: int) -> LinkmapSpec:
+    from ..geometry import Dim3, Radius
+    from ..observatory.linkmap import method_traffic
+
+    cs = _plan_exchange_spec(method_name, s)
+    traffic = method_traffic(
+        method_name, (_PLAN_INTERIOR,) * 3, Radius.constant(1),
+        Dim3(*_EXCHANGE_MESH), (4,), steps=s)
+    return LinkmapSpec(fn=cs.fn, args=cs.args, traffic=traffic)
+
+
+def _linkmap_allgather_spec() -> LinkmapSpec:
+    from ..geometry import Dim3, Radius
+    from ..observatory.linkmap import allgather_traffic
+
+    cs = _exchange_allgather_spec()
+    traffic = allgather_traffic((8, 8, 8), Radius.constant(1),
+                                Dim3(2, 2, 2), (4,))
+    return LinkmapSpec(fn=cs.fn, args=cs.args, traffic=traffic)
+
+
+def _linkmap_migrate_spec() -> LinkmapSpec:
+    from ..geometry import Dim3
+    from ..observatory.linkmap import migration_traffic
+
+    cs = _migrate_spec()
+    traffic = migration_traffic(Dim3(*_MIGRATE_MESH),
+                                len(_MIGRATE_FIELDS), _MIGRATE_BUDGET,
+                                4)
+    return LinkmapSpec(fn=cs.fn, args=cs.args, traffic=traffic,
+                       count_kinds=("collective_permute",))
+
+
+def _linkmap_pic_spec() -> LinkmapSpec:
+    from ..geometry import Dim3, Radius
+    from ..models.pic import PARTICLE_FIELDS, RADIUS
+    from ..observatory.linkmap import pic_traffic
+
+    eng = _pic_engine()
+    fn, args = _pic_step_entry()
+    local = eng.dd.local_size
+    traffic = pic_traffic((local.z, local.y, local.x),
+                          Radius.constant(RADIUS),
+                          Dim3(*_EXCHANGE_MESH), 4,
+                          len(PARTICLE_FIELDS), _PIC_BUDGET)
+    return LinkmapSpec(fn=fn, args=args, traffic=traffic,
+                       count_kinds=("collective_permute",))
+
+
+# ---------------------------------------------------------------------------
 # particle-migration / PIC targets: the DYNAMIC communication pattern.
 # The fixed-capacity migration ring must lower to collective-permute
 # only with its static budget x record-rows wire bill matching the
@@ -1867,6 +1955,30 @@ def default_targets() -> List[Target]:
                   _attribution_pic_hlo),
         TransferTarget("observatory.attribution.pic_step[transfer]",
                        lambda: _transfer_spec(_attributed_pic_entry)),
+    ]
+    # link observatory: the modeled per-link traffic matrix sums
+    # EXACTLY to the HLO-extracted wire bytes for every registered
+    # method — slab/packed x s, the all-gather control, migration, and
+    # the PIC step's accumulate adjoint (see the block comment above)
+    targets += [
+        LinkmapTarget("observatory.linkmap.exchange[r1]",
+                      lambda: _linkmap_exchange_spec("r1")),
+        LinkmapTarget("observatory.linkmap.exchange[r3]",
+                      lambda: _linkmap_exchange_spec("r3")),
+        LinkmapTarget("observatory.linkmap.exchange[asym]",
+                      lambda: _linkmap_exchange_spec("asym")),
+        LinkmapTarget("observatory.linkmap.packed[uneven]",
+                      _linkmap_packed_uneven_spec),
+        LinkmapTarget("observatory.linkmap.plan[PpermuteSlab,s=2]",
+                      lambda: _linkmap_plan_spec("PpermuteSlab", 2)),
+        LinkmapTarget("observatory.linkmap.plan[PpermutePacked,s=4]",
+                      lambda: _linkmap_plan_spec("PpermutePacked", 4)),
+        LinkmapTarget("observatory.linkmap.allgather",
+                      _linkmap_allgather_spec),
+        LinkmapTarget("observatory.linkmap.migrate",
+                      _linkmap_migrate_spec),
+        LinkmapTarget("observatory.linkmap.pic_step",
+                      _linkmap_pic_spec),
     ]
     # the particle-migration ring and the fused PIC step: the dynamic
     # communication pattern under the same gates as the static sweep —

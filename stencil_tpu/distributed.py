@@ -177,7 +177,7 @@ class DistributedDomain:
     def autotune(self, timer=None, use_cache: bool = True,
                  force: bool = False, cache_path=None,
                  max_measurements: int = 4, depths=None,
-                 overlap_options=(False,)):
+                 overlap_options=(False,), topology_path=None):
         """Measure the live mesh and adopt the fastest exchange plan
         (the measured per-pair transport routing of the reference,
         src/stencil.cu:371-458, as a whole-program decision). Runs the
@@ -192,6 +192,10 @@ class DistributedDomain:
         ``timer``: injectable measurement backend (tests/CI use the
         deterministic ``tuning.FakeTimer``; default is the real
         ``tuning.MeshTimer`` over this domain's mesh shape).
+        ``topology_path`` (or ``$STENCIL_TOPOLOGY_CACHE``) arms the
+        measured topology fingerprint: per-axis link calibrations are
+        measured once per fabric and consumed ever after
+        (``observatory/linkmap.py``).
         Returns the adopted :class:`stencil_tpu.tuning.Plan`."""
         assert self.mesh is None, "autotune() before realize()"
         assert self._names, "add_data at least one quantity first"
@@ -201,7 +205,8 @@ class DistributedDomain:
             cache_path=cache_path,
             depths=DEFAULT_DEPTHS if depths is None else depths,
             overlap_options=overlap_options,
-            max_measurements=max_measurements)
+            max_measurements=max_measurements,
+            topology_path=topology_path)
         self.apply_plan(plan)
         return plan
 

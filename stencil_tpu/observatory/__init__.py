@@ -33,9 +33,21 @@ systematic measured-vs-modeled validation), in three coupled pieces:
 
 * **Flight recorder** (:mod:`.recorder`) — a bounded black box
   (recent events via :class:`~stencil_tpu.telemetry.RingSink`, recent
-  spans, a metrics snapshot, health/probe history) dumped atomically
-  on health trip, degradation, SIGTERM, and unhandled dispatch error;
+  spans, a metrics snapshot, health/probe history, the classified
+  linkmap snapshot) dumped atomically on health trip, degradation,
+  SIGTERM, and unhandled dispatch error;
   ``observatory replay <dump>`` renders the incident timeline.
+
+* **Link observatory** (:mod:`.linkmap`) — the per-link signal: a
+  modeled (src, dst) traffic matrix whose totals the
+  ``observatory.linkmap.*`` registry targets pin HLO-exactly per
+  method, classified into self/ici-hop-k/dcn link classes against
+  the deployed device order
+  (``stencil_link_bytes_per_step{axis,link_class}`` /
+  ``stencil_link_utilization_ratio``), a measured per-axis topology
+  fingerprint the tuner consumes instead of its two global
+  alpha-betas, and ``observatory linkmap --placement-report`` — the
+  QAP-vs-trivial placement-quality gate over every registered mesh.
 """
 
 from .attribution import (METRIC_ACHIEVED_BYTES_PER_S,
@@ -47,10 +59,27 @@ from .ledger import (LEDGER_SCHEMA_VERSION, append_record,
                      backfill_records, config_fingerprint, diff_records,
                      gate_regressions, make_record, payload_records,
                      read_ledger, validate_record)
+from .linkmap import (METRIC_LINK_BYTES_PER_STEP,
+                      METRIC_LINK_UTILIZATION, LinkmapSpec,
+                      LinkmapSummary, LinkmapTarget, TrafficMatrix,
+                      allgather_traffic, check_linkmap, classify,
+                      link_attribution_for, load_topology,
+                      measure_topology, method_traffic,
+                      migration_traffic, pic_traffic, placement_report,
+                      save_topology, sweep_traffic,
+                      topology_fingerprint,
+                      topology_fingerprint_inputs)
 from .recorder import (ENV_FLIGHT_DIR, FLIGHT_SCHEMA_VERSION,
                        FlightRecorder, render_timeline, validate_dump)
 
 __all__ = [
+    "METRIC_LINK_BYTES_PER_STEP", "METRIC_LINK_UTILIZATION",
+    "LinkmapSpec", "LinkmapSummary", "LinkmapTarget", "TrafficMatrix",
+    "allgather_traffic", "check_linkmap", "classify",
+    "link_attribution_for", "load_topology", "measure_topology",
+    "method_traffic", "migration_traffic", "pic_traffic",
+    "placement_report", "save_topology", "sweep_traffic",
+    "topology_fingerprint", "topology_fingerprint_inputs",
     "PerfAttributor", "model_step_seconds_for",
     "make_drift_invalidator",
     "METRIC_MODEL_ERROR_RATIO", "METRIC_ACHIEVED_BYTES_PER_S",

@@ -17,7 +17,16 @@ Subcommands (all artifact-facing — none touch accelerators):
   steps/s may not drop more than ``--threshold`` below the best
   earlier one; nonzero exit on any regression. ``--include-legacy``
   widens the gate to backfilled history (off by default — legacy
-  snapshots come from other sessions/machines).
+  snapshots come from other sessions/machines). An empty or group-less
+  ledger passes with an explicit "no measured trajectory" note (never
+  a silent vacuous OK); ``--json`` stamps ``groups_checked`` into the
+  artifact and ``--min-groups N`` fails the run when coverage
+  regresses below the committed floor.
+* ``linkmap``        — the link observatory (observatory/linkmap.py):
+  render the modeled per-(src, dst) traffic matrix, its link-class /
+  direction-class shares, and (``--placement-report``) the QAP
+  placement-quality gate over every registered mesh — nonzero exit
+  when QAP placement would lose to trivial placement anywhere.
 * ``replay DUMP``    — render a flight-recorder dump's merged incident
   timeline (events + probes + spans).
 """
@@ -65,6 +74,85 @@ def _print_diff(diff: dict) -> None:
         print(f"  {name:<34} {row['a']!r:>16} -> {row['b']!r:>16}{tail}")
 
 
+def _parse_dim3(text: str, what: str):
+    toks = [int(t) for t in text.replace("x", ",").split(",") if t]
+    if len(toks) != 3 or any(v < 1 for v in toks):
+        raise SystemExit(f"--{what} wants three positive integers, "
+                         f"got {text!r}")
+    return tuple(toks)
+
+
+def _cmd_linkmap(args) -> int:
+    """The ``linkmap`` subcommand: modeled traffic matrix + link-class
+    summary, and the placement-quality QAP gate (artifact-facing —
+    pure geometry/placement math, no accelerators touched)."""
+    from ..geometry import Dim3, Radius
+    from .linkmap import (REGISTERED_MESHES, classify, method_traffic,
+                          placement_report, render_heatmap,
+                          render_summary)
+
+    counts = Dim3(*_parse_dim3(args.mesh, "mesh"))
+    grid = (_parse_dim3(args.grid, "grid") if args.grid
+            else tuple(8 * c for c in counts))
+    if any(g % c for g, c in zip(grid, counts)):
+        # this capacity-shard model cannot represent +-1 uneven
+        # shards; a silently floor-divided grid would make the
+        # rendered artifact misstate the stated configuration
+        raise SystemExit(f"--grid {grid} is not divisible by --mesh "
+                         f"{tuple(counts)}; pick a divisible grid")
+    shard = tuple(g // c for g, c in zip(grid, counts))
+    radius = Radius.constant(args.radius)
+    elem_sizes = (4,) * max(int(args.fields), 1)
+    dcn_axis = ({"x": 0, "y": 1, "z": 2}[args.dcn_axis]
+                if args.dcn_axis else None)
+    s = max(int(args.exchange_every), 1)
+    tm = method_traffic(args.method, (shard[2], shard[1], shard[0]),
+                        radius, counts, elem_sizes, steps=s)
+    summary = classify(tm, dcn_axis=dcn_axis,
+                       n_slices=int(args.n_slices),
+                       rounds_per_step=1.0 / s)
+    print(f"linkmap: {args.method}[s={s}] on mesh "
+          f"{counts.x}x{counts.y}x{counts.z}, grid {grid}, radius "
+          f"{args.radius}, {args.fields} f32 field(s)")
+    print(render_heatmap(tm))
+    print(render_summary(summary))
+
+    payload = {"schema": 1, "kind": "linkmap",
+               "method": args.method, "exchange_every": s,
+               "mesh": list(counts), "grid": list(grid),
+               "radius": int(args.radius), "fields": int(args.fields),
+               "matrix": tm.matrix().tolist(),
+               "summary": summary.to_record()}
+    rc = 0
+    if args.placement_report:
+        report = placement_report(REGISTERED_MESHES, radius=radius,
+                                  elem_sizes=elem_sizes)
+        payload["placement_report"] = report
+        for row in report["meshes"]:
+            verdict = "OK " if row["ok"] else "FAIL"
+            print(f"  {verdict} placement {row['name']:<10} "
+                  f"qap/trivial x{row['qap_over_trivial']:.3f} "
+                  f"(trivial {row['trivial_cost']:.3e}, qap "
+                  f"{row['qap_cost']:.3e}"
+                  + (f", dcn {row['dcn_axis']}x{row['n_slices']}"
+                     if row["dcn_axis"] else "") + ")")
+        if report["ok"]:
+            print(f"observatory: placement gate OK — QAP placement "
+                  f"cost <= trivial on all {len(report['meshes'])} "
+                  f"registered meshes")
+        else:
+            bad = [r["name"] for r in report["meshes"] if not r["ok"]]
+            print(f"observatory: placement gate FAILED on {bad} — "
+                  f"QAP placement would move MORE modeled bytes than "
+                  f"trivial device order")
+            rc = 1
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"observatory: linkmap artifact -> {args.json}")
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m stencil_tpu.observatory",
@@ -102,6 +190,46 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="gate only this bench id")
     p_gate.add_argument("--include-legacy", action="store_true",
                         help="also gate provenance=legacy records")
+    p_gate.add_argument("--json", default=None, metavar="PATH",
+                        help="write the gate verdict (records, "
+                             "groups_checked, failures) as a JSON "
+                             "artifact")
+    p_gate.add_argument("--min-groups", type=int, default=0,
+                        metavar="N",
+                        help="fail when fewer than N comparable "
+                             "(fingerprint, bench) groups were "
+                             "actually gated — the committed coverage "
+                             "floor that makes a vacuous pass loud")
+
+    p_lm = sub.add_parser("linkmap",
+                          help="render the modeled per-link traffic "
+                               "matrix / placement-quality report")
+    p_lm.add_argument("--mesh", default="2,2,2", metavar="X,Y,Z",
+                      help="shard lattice (device counts per axis; "
+                           "default 2,2,2)")
+    p_lm.add_argument("--grid", default=None, metavar="X,Y,Z",
+                      help="global grid (default 8 cells per shard "
+                           "per axis)")
+    p_lm.add_argument("--radius", type=int, default=1)
+    p_lm.add_argument("--fields", type=int, default=1,
+                      help="f32 quantities riding the exchange")
+    p_lm.add_argument("--method", default="PpermuteSlab",
+                      choices=("PpermuteSlab", "PpermutePacked",
+                               "AllGather"))
+    p_lm.add_argument("--exchange-every", type=int, default=1,
+                      metavar="S", help="temporal-blocking depth")
+    p_lm.add_argument("--dcn-axis", default=None,
+                      choices=("x", "y", "z"),
+                      help="slice-blocked axis (classifies its "
+                           "slice-crossing edges as dcn)")
+    p_lm.add_argument("--n-slices", type=int, default=1)
+    p_lm.add_argument("--placement-report", action="store_true",
+                      help="score QAP vs trivial placement over every "
+                           "registered mesh; nonzero exit when QAP "
+                           "placement would lose anywhere")
+    p_lm.add_argument("--json", default=None, metavar="PATH",
+                      help="write the linkmap / placement report as a "
+                           "JSON artifact")
 
     p_rep = sub.add_parser("replay", help="render a flight dump's "
                                           "incident timeline")
@@ -175,20 +303,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                       if k[1] == args.bench}
         pairs = [g for g in groups.values() if len(g) >= 2]
         if not pairs:
-            print("observatory: no (fingerprint, bench) group has two "
-                  "records to diff", file=sys.stderr)
-            return 2
+            # an empty/group-less ledger is not an error — but it must
+            # be LOUD that nothing was compared, never a silent pass
+            print(f"observatory: no measured trajectory to diff in "
+                  f"{args.a} ({len(recs_a)} record(s), "
+                  f"{len(groups)} group(s), none with two records)")
+            return 0
         # the group whose newest record is newest overall
         group = max(pairs, key=lambda g: g[-1].get("created", 0.0))
         _print_diff(diff_records(group[-2], group[-1]))
         return 0
 
     if args.cmd == "gate":
-        from .ledger import (PROVENANCES, gate_regressions, read_ledger,
+        from .ledger import (PROVENANCES, gate_groups_checked,
+                             gate_regressions, read_ledger,
                              validate_ledger)
         try:
             records = read_ledger(args.ledger)
         except (OSError, ValueError) as e:
+            # an EMPTY ledger is "no measured trajectory"; a MISSING
+            # or unreadable path is a usage error — exiting 0 there
+            # would be the vacuous-pass-on-typo this command exists
+            # to make loud
             print(f"observatory: cannot load {args.ledger}: {e}",
                   file=sys.stderr)
             return 2
@@ -203,15 +339,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         failures = gate_regressions(records,
                                     threshold=args.threshold,
                                     provenances=prov, bench=args.bench)
+        groups_checked = gate_groups_checked(records, provenances=prov,
+                                             bench=args.bench)
+        if args.json:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                json.dump({"schema": 1, "kind": "ledger_gate",
+                           "ledger": args.ledger,
+                           "records": len(records),
+                           "groups_checked": groups_checked,
+                           "min_groups": args.min_groups,
+                           "threshold": args.threshold,
+                           "failures": failures}, fh, indent=1)
         for f in failures:
             print(f"  REGRESSION  {f}")
         if failures:
             print(f"observatory: gate FAILED "
-                  f"({len(failures)} regression(s))")
+                  f"({len(failures)} regression(s), "
+                  f"{groups_checked} group(s) checked)")
             return 1
+        if groups_checked < args.min_groups:
+            print(f"observatory: gate FAILED — only {groups_checked} "
+                  f"comparable group(s) gated, below the committed "
+                  f"floor of {args.min_groups} (coverage regressed: "
+                  f"benches stopped appending, or the ledger path is "
+                  f"wrong)")
+            return 1
+        if groups_checked == 0:
+            # exit 0, but LOUDLY distinguishable from a healthy gate:
+            # nothing was compared, so nothing was proven
+            print(f"observatory: gate OK — no measured trajectory to "
+                  f"gate ({len(records)} record(s), 0 comparable "
+                  f"groups; the gate proved nothing)")
+            return 0
         print(f"observatory: gate OK ({len(records)} record(s), "
+              f"{groups_checked} group(s) checked, "
               f"threshold {100 * args.threshold:.0f}%)")
         return 0
+
+    if args.cmd == "linkmap":
+        return _cmd_linkmap(args)
 
     # replay
     from .recorder import render_timeline, validate_dump

@@ -119,6 +119,8 @@ class PerfAttributor:
                  on_drift: Optional[Callable[[Dict], None]] = None,
                  fingerprint: Optional[str] = None,
                  registry=None,
+                 link_bytes_per_step: Optional[Dict] = None,
+                 link_peak_bytes_per_s: Optional[Dict] = None,
                  clock: Callable[[], float] = time.perf_counter) -> None:
         if float(tolerance) <= 0:
             raise ValueError(f"tolerance must be > 0, got {tolerance}")
@@ -158,6 +160,29 @@ class PerfAttributor:
             METRIC_MODELED_BYTES_PER_S,
             "wire B/s the calibrated cost model promises for the "
             "active plan")
+        # per-link attribution (observatory/linkmap.py): the modeled
+        # traffic matrix classified per (mesh axis, link class) plus
+        # the per-axis fitted peak rate from the topology fingerprint
+        # / tuned plan — the signal ROADMAP item 3's placement work
+        # optimizes against
+        self.link_bytes_per_step: Dict = dict(link_bytes_per_step or {})
+        self.link_peak_bytes_per_s: Dict = dict(link_peak_bytes_per_s
+                                                or {})
+        from .linkmap import (METRIC_LINK_BYTES_PER_STEP,
+                              METRIC_LINK_UTILIZATION)
+        self._g_link_bytes = registry.gauge(
+            METRIC_LINK_BYTES_PER_STEP,
+            "modeled wire B/step per mesh axis and link class (self / "
+            "ici-hop<k> / dcn) — the traffic matrix the "
+            "observatory.linkmap.* registry targets pin HLO-exactly, "
+            "classified against the deployed device order")
+        self._g_link_util = registry.gauge(
+            METRIC_LINK_UTILIZATION,
+            "achieved/fitted-peak wire utilization per mesh axis and "
+            "link class: the link's modeled B/step over the measured "
+            "step seconds, against the topology fingerprint's (or "
+            "tuned plan's) fitted beta for that axis; 0 = not yet "
+            "observed / reset after a re-tune")
         self.last_ratio: Optional[float] = None
         self._baseline: Optional[float] = None
         self._streak = 0
@@ -220,6 +245,7 @@ class PerfAttributor:
             self._g_modeled.set(
                 self.model_bytes_per_step / self.model_step_seconds,
                 **labels)
+        self._export_links(measured)
         if self._warmup > 0:
             self._warmup -= 1  # compile-contaminated: export, don't
             return None        # calibrate or count toward drift
@@ -259,6 +285,24 @@ class PerfAttributor:
             self._on_drift(dict(attrs))
         return attrs
 
+    def _export_links(self, measured_step_seconds: float,
+                      clear: bool = False) -> None:
+        """Per-link gauges for one observation: the modeled B/step of
+        every (axis, link_class) pair, and — when the axis has a
+        fitted peak — the utilization that measured step implies.
+        ``clear`` zeroes both (a re-tuned plan supersedes the old
+        link map)."""
+        for (axis, klass), nbytes in self.link_bytes_per_step.items():
+            labels = {"axis": str(axis), "link_class": str(klass)}
+            self._g_link_bytes.set(0.0 if clear else float(nbytes),
+                                   **labels)
+            peak = self.link_peak_bytes_per_s.get(str(axis))
+            if clear:
+                self._g_link_util.set(0.0, **labels)
+            elif peak and measured_step_seconds > 0.0:
+                achieved = float(nbytes) / measured_step_seconds
+                self._g_link_util.set(achieved / float(peak), **labels)
+
     def reset(self, model_step_seconds: Optional[float] = None,
               fingerprint: Optional[str] = None) -> None:
         """A re-tuned (or rebuilt) plan supersedes everything observed
@@ -271,6 +315,7 @@ class PerfAttributor:
         if fingerprint is not None:
             self.fingerprint = fingerprint
         self._g_ratio.set(0.0, **self.labels())
+        self._export_links(0.0, clear=True)
         self.last_ratio = None
         self._baseline = None
         self._streak = 0

@@ -230,6 +230,21 @@ def gate_regressions(records: Sequence[Dict], threshold: float = 0.2,
     return failures
 
 
+def gate_groups_checked(records: Sequence[Dict],
+                        provenances: Sequence[str] = ("measured",),
+                        bench: Optional[str] = None) -> int:
+    """How many (fingerprint, bench) groups the gate actually
+    COMPARED (>= 2 eligible records). The gate's coverage figure: a
+    healthy gate and a vacuous one both exit 0, but only this number
+    tells them apart — the CLI stamps it into the ``--json`` artifact
+    and ``--min-groups`` ratchets it."""
+    eligible = [r for r in records
+                if r.get("provenance") in tuple(provenances)
+                and (bench is None or r.get("bench") == bench)]
+    return sum(1 for g in group_records(eligible).values()
+               if len(g) >= 2)
+
+
 # ---------------------------------------------------------------------------
 # legacy backfill: the five committed BENCH_*.json shapes -> records
 

@@ -74,6 +74,7 @@ class FlightRecorder:
         self._clock = clock
         self._lock = threading.Lock()
         self._n_dumps = 0
+        self._linkmap: Optional[Dict] = None
 
     # -- the telemetry sink protocol ------------------------------------
     def emit(self, record: Dict) -> None:
@@ -88,6 +89,15 @@ class FlightRecorder:
         rec.setdefault("recorded", float(self._clock()))
         with self._lock:
             self._probes.append(rec)
+
+    def set_linkmap(self, summary: Optional[Dict]) -> None:
+        """Attach the link observatory's classified traffic snapshot
+        (``linkmap.LinkmapSummary.to_record()``): incident dumps then
+        show per-(axis, link_class) modeled wire shares next to the
+        events — which fabric tier the dying campaign was leaning
+        on."""
+        with self._lock:
+            self._linkmap = dict(summary) if summary else None
 
     # -- capture --------------------------------------------------------
     def snapshot(self, reason: str, **attrs) -> Dict:
@@ -108,6 +118,7 @@ class FlightRecorder:
                 })
         with self._lock:
             probes = [dict(p) for p in self._probes]
+            linkmap = dict(self._linkmap) if self._linkmap else None
         return {
             "schema": FLIGHT_SCHEMA_VERSION,
             "kind": "flight_recorder",
@@ -121,6 +132,7 @@ class FlightRecorder:
             "spans": spans,
             "metrics": (self._registry.snapshot()
                         if self._registry is not None else None),
+            "linkmap": linkmap,
         }
 
     def dump(self, directory: Union[str, Path], reason: str,
@@ -216,6 +228,10 @@ def validate_dump(payload) -> List[str]:
     if metrics is not None and (not isinstance(metrics, dict)
                                 or "metrics" not in metrics):
         problems.append("'metrics' present but not a metrics snapshot")
+    linkmap = payload.get("linkmap")
+    if linkmap is not None and (not isinstance(linkmap, dict)
+                                or "links" not in linkmap):
+        problems.append("'linkmap' present but not a linkmap summary")
     return problems
 
 

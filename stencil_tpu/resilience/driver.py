@@ -308,6 +308,10 @@ class _ResilientRun:
                                          registry=reg,
                                          tracer=self._tracer)
             self.report.add_sink(self.flight)
+            # the attributor (built above) classified the link map
+            # before the recorder existed — arm the black box with it
+            self.flight.set_linkmap(getattr(self, "_link_summary",
+                                            None))
 
     def _make_sentinel(self, dd,
                        rebase_step: Optional[int] = None,
@@ -367,6 +371,16 @@ class _ResilientRun:
             nbytes = (self._step_metrics.bytes_per_step
                       if getattr(self, "_step_metrics", None) is not None
                       else 0.0)
+        # per-link attribution (observatory/linkmap.py): the modeled
+        # traffic matrix classified against the deployed device order,
+        # exported as stencil_link_bytes_per_step /
+        # stencil_link_utilization_ratio next to the error ratio; the
+        # flight recorder carries the same snapshot in incident dumps
+        from ..observatory.linkmap import link_attribution_for
+        link = link_attribution_for(self.dd)
+        self._link_summary = link["summary"] if link else None
+        if getattr(self, "flight", None) is not None:
+            self.flight.set_linkmap(self._link_summary)
         return PerfAttributor(
             entry=self._perf_entry, method=cfg.method.name,
             exchange_every=cfg.exchange_every,
@@ -377,6 +391,10 @@ class _ResilientRun:
             emit=self.report.log,
             on_drift=(self._on_perf_drift if p.retune_on_drift
                       else None),
+            link_bytes_per_step=(link["bytes_per_step"] if link
+                                 else None),
+            link_peak_bytes_per_s=(link["peak_bytes_per_s"] if link
+                                   else None),
             fingerprint=(plan.fingerprint if plan is not None else None))
 
     def _on_perf_drift(self, attrs: Dict) -> None:

@@ -403,6 +403,13 @@ class CampaignService:
             nbytes = float(eng.dd.exchange_bytes_amortized_per_step())
         except Exception:  # noqa: BLE001 - no byte model: B/s gauges off
             nbytes = 0.0
+        # per-link attribution (observatory/linkmap.py): link-class
+        # gauges in THIS service's registry; the service flight
+        # recorder snapshots the same classified traffic matrix
+        from ..observatory.linkmap import link_attribution_for
+        link = link_attribution_for(eng.dd)
+        if link and self.flight is not None:
+            self.flight.set_linkmap(link["summary"])
         return PerfAttributor(
             entry="service", method=pick_method(eng.dd.methods).name,
             exchange_every=int(eng.dd.exchange_every),
@@ -412,6 +419,10 @@ class CampaignService:
             emit=self._log, registry=self.metrics,
             on_drift=(self._on_perf_drift if self._retune_on_drift
                       else None),
+            link_bytes_per_step=(link["bytes_per_step"] if link
+                                 else None),
+            link_peak_bytes_per_s=(link["peak_bytes_per_s"] if link
+                                   else None),
             fingerprint=(plan.fingerprint if plan is not None
                          else None))
 

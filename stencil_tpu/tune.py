@@ -62,6 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fake-timer", action="store_true",
                     help="deterministic analytic measurements (no "
                          "hardware timing; exercises the full search)")
+    ap.add_argument("--topology", default="", metavar="PATH",
+                    help="measured topology-fingerprint artifact "
+                         "(observatory/linkmap.py): per-axis link "
+                         "calibrations are measured once per fabric "
+                         "and consumed by every later tune instead of "
+                         "the two global pingpong fits (default: "
+                         "$STENCIL_TOPOLOGY_CACHE when set)")
     ap.add_argument("--json", default="", metavar="PATH",
                     help="write the tuned plan record as JSON")
     ap.add_argument("--fake-cpu", type=int, default=0, metavar="N",
@@ -94,7 +101,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                        force=args.force,
                        cache_path=args.cache or None,
                        max_measurements=args.max_measure,
-                       depths=tuple(_parse_ints(args.depths)))
+                       depths=tuple(_parse_ints(args.depths)),
+                       topology_path=args.topology or None)
     print(autotune_report(plan))
     if args.json:
         with open(args.json, "w") as f:

@@ -73,7 +73,7 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
                  depths: Sequence[int] = DEFAULT_DEPTHS,
                  overlap_options: Sequence[bool] = (False,),
                  max_measurements: int = 4,
-                 runnable=None) -> Plan:
+                 runnable=None, topology: "Dict | None" = None) -> Plan:
     """The core search (timer injected — deterministic under
     :class:`FakeTimer`): cache lookup, alpha-beta calibration,
     model-ranked pruning, measurement of the survivors, plan store.
@@ -82,6 +82,13 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
     calibration pingpongs are counted separately in
     ``Plan.measurements``); the calibrated cost model decides WHICH
     candidates are worth those runs.
+
+    ``topology``: a measured topology-fingerprint record
+    (``observatory.linkmap.measure_topology`` / ``load_topology``) —
+    its per-link (per mesh axis + DCN) alpha-beta coefficients are
+    consumed INSTEAD of pingponging the two global link classes, so a
+    machine fingerprinted once never pays calibration again and the
+    plan records the full per-axis fabric.
     """
     fp = fingerprint(inputs)
     if read_cache:
@@ -96,14 +103,21 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
     counted = CountingTimer(timer)
 
     # --- fit: measured alpha-beta replaces the assumed constants, per
-    # link class: the ICI always; the DCN when the mesh has a
-    # slice-blocked axis (timer.has_dcn). The exchange is three
-    # SEQUENTIAL axis sweeps, so for ranking the two classes combine
-    # as the bottleneck link (max latency, min bandwidth) — the
-    # conservative price of a sweep that must cross both fabrics.
-    links = {"ici": calibrate_link(counted.pingpong)}
-    if getattr(counted, "has_dcn", False):
-        links["dcn"] = calibrate_link(counted.pingpong_dcn)
+    # link class. With a topology fingerprint the per-link (per-axis +
+    # DCN) coefficients come from the persisted artifact — zero
+    # pingpongs here; otherwise the classic two classes are measured:
+    # the ICI always, the DCN when the mesh has a slice-blocked axis
+    # (timer.has_dcn). The exchange is three SEQUENTIAL axis sweeps,
+    # so for ranking the classes combine as the bottleneck link (max
+    # latency, min bandwidth) — the conservative price of a sweep that
+    # must cross every fabric tier.
+    if topology is not None:
+        from ..observatory.linkmap import topology_coefficients
+        links = topology_coefficients(topology)
+    else:
+        links = {"ici": calibrate_link(counted.pingpong)}
+        if getattr(counted, "has_dcn", False):
+            links["dcn"] = calibrate_link(counted.pingpong_dcn)
     coeffs = LinkCoefficients(
         alpha_s=max(c.alpha_s for c in links.values()),
         beta_bytes_per_s=min(c.beta_bytes_per_s
@@ -216,15 +230,28 @@ def autotune_domain(dd, timer=None, use_cache: bool = True,
                     force: bool = False, cache_path=None,
                     depths: Sequence[int] = DEFAULT_DEPTHS,
                     overlap_options: Sequence[bool] = (False,),
-                    max_measurements: int = 4) -> Plan:
+                    max_measurements: int = 4,
+                    topology_path=None) -> Plan:
     """Autotune a configured ``DistributedDomain`` (called by
     ``DistributedDomain.autotune()`` — use that). Chooses the partition
     the orchestrator will use, builds the real :class:`MeshTimer` over
     a throwaway mesh of that shape (unless a timer is injected), and
-    runs the search. Does NOT apply the plan; the domain does."""
+    runs the search. Does NOT apply the plan; the domain does.
+
+    ``topology_path`` (or ``$STENCIL_TOPOLOGY_CACHE``) arms the
+    measured topology fingerprint: a stored per-axis link calibration
+    for this fabric is consumed instead of the two global pingpong
+    fits; a miss measures the per-axis sweeps once and persists them
+    (atomic, fingerprint-keyed) for every later campaign on the same
+    machine."""
+    import os as _os
+
     dim = dd._choose_partition_dim()
     geom = geometry_from_domain(dd, dim)
     inputs = inputs_from_domain(dd, dim)
+    if topology_path is None and _os.environ.get(
+            "STENCIL_TOPOLOGY_CACHE"):
+        topology_path = _os.environ["STENCIL_TOPOLOGY_CACHE"]
     if timer is None:
         from ..parallel.mesh import make_mesh
         from ..geometry import Dim3
@@ -243,8 +270,43 @@ def autotune_domain(dd, timer=None, use_cache: bool = True,
                           nonperiodic=geom.nonperiodic,
                           dcn_axis=(dd.dcn_axis if dd.n_slices > 1
                                     else None))
+    topology = None
+    if topology_path:
+        from ..observatory.linkmap import (load_topology,
+                                           measure_topology,
+                                           topology_fingerprint,
+                                           topology_fingerprint_inputs,
+                                           save_topology)
+        topo_inputs = topology_fingerprint_inputs(
+            platform=inputs["platform"],
+            device_count=inputs["device_count"],
+            mesh_shape=inputs["mesh_shape"],
+            n_slices=inputs["n_slices"])
+        topology = load_topology(topology_fingerprint(topo_inputs),
+                                 topology_path)
+        if topology is None and hasattr(timer, "pingpong_axis"):
+            topology = measure_topology(
+                timer, inputs["mesh_shape"], topo_inputs,
+                dcn_axis=(dd.dcn_axis if dd.n_slices > 1 else None))
+            if not topology["links"]:
+                # a mesh with no multi-device axis has no links to
+                # fingerprint — fall back to the classic calibration
+                # (which degenerates gracefully) instead of persisting
+                # an empty record
+                topology = None
+            else:
+                save_topology(topology, topology_path)
+                LOG_INFO(f"autotune: measured topology fingerprint "
+                         f"{topology['fingerprint'][:12]}... "
+                         f"({len(topology['links'])} links) -> "
+                         f"{topology_path}")
+        elif topology is not None:
+            LOG_INFO(f"autotune: topology fingerprint hit "
+                     f"{topology['fingerprint'][:12]}... (per-axis "
+                     f"links replace the pingpong calibration)")
     return run_autotune(geom, inputs, timer,
                         read_cache=use_cache and not force,
                         write_cache=use_cache, cache_path=cache_path,
                         depths=depths, overlap_options=overlap_options,
-                        max_measurements=max_measurements)
+                        max_measurements=max_measurements,
+                        topology=topology)

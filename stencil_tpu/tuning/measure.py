@@ -42,12 +42,18 @@ class FakeTimer:
     def __init__(self, coeffs: Optional[LinkCoefficients] = None,
                  scale: Optional[Dict[str, float]] = None,
                  overlap_factor: float = 1.0,
-                 dcn_coeffs: Optional[LinkCoefficients] = None) -> None:
+                 dcn_coeffs: Optional[LinkCoefficients] = None,
+                 axis_coeffs: Optional[
+                     Dict[str, LinkCoefficients]] = None) -> None:
         self.coeffs = coeffs if coeffs is not None else LinkCoefficients(
             alpha_s=50e-6, beta_bytes_per_s=1e10)
         self.scale = dict(scale or {})
         self.overlap_factor = float(overlap_factor)
         self.dcn_coeffs = dcn_coeffs
+        #: per-mesh-axis coefficients for the topology-fingerprint
+        #: protocol (pingpong_axis); axes not listed fall back to the
+        #: global coeffs — the anisotropic-fabric test hook
+        self.axis_coeffs = dict(axis_coeffs or {})
 
     @property
     def has_dcn(self) -> bool:
@@ -59,6 +65,12 @@ class FakeTimer:
     def pingpong_dcn(self, nbytes: int) -> float:
         assert self.dcn_coeffs is not None, "no DCN link configured"
         return self.dcn_coeffs.seconds(1, nbytes)
+
+    def pingpong_axis(self, name: str, nbytes: int) -> float:
+        """Seconds per ring shift along ONE named mesh axis — the
+        per-link sample source of the topology fingerprint
+        (``observatory.linkmap.measure_topology``)."""
+        return self.axis_coeffs.get(name, self.coeffs).seconds(1, nbytes)
 
     def exchange_round(self, cand: Candidate, geom: TuneGeometry
                        ) -> float:
@@ -109,6 +121,12 @@ class MeshTimer:
         link class's alpha-beta samples."""
         assert self.dcn_axis is not None, "no DCN axis configured"
         return self._ring_shift_seconds("xyz"[self.dcn_axis], nbytes)
+
+    def pingpong_axis(self, name: str, nbytes: int) -> float:
+        """Seconds per ring shift along ONE named mesh axis (the
+        topology-fingerprint sample source): each fabric axis gets its
+        own alpha-beta fit instead of sharing the largest axis's."""
+        return self._ring_shift_seconds(name, nbytes)
 
     def _ring_shift_seconds(self, name: str, nbytes: int) -> float:
         import jax
@@ -193,6 +211,10 @@ class CountingTimer:
     def pingpong_dcn(self, nbytes: int) -> float:
         self.calls += 1
         return self._timer.pingpong_dcn(nbytes)
+
+    def pingpong_axis(self, name: str, nbytes: int) -> float:
+        self.calls += 1
+        return self._timer.pingpong_axis(name, nbytes)
 
     def exchange_round(self, cand: Candidate, geom: TuneGeometry
                        ) -> float:

@@ -1,0 +1,53 @@
+"""Negative control for the link observatory: a traffic matrix that
+drops corner messages — the classic 6-neighbor-only bug.
+
+The sequential-sweep exchange forwards edge/corner halos inside its
+fat axis slabs (each axis message's cross-section spans the OTHER
+axes' pads), so a per-link traffic model that prices only the
+face-interior cross-sections — the naive "6 neighbors, 6 face slabs"
+picture — under-counts exactly the edge+corner bytes. The linkmap
+checker must flag the mismatch against the HLO-extracted bytes with a
+nonzero CLI exit, naming the zero-corner-share smell.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.observatory.linkmap import (LinkmapSpec, LinkmapTarget,
+                                             sweep_traffic)
+
+_MESH = (2, 2, 2)
+_GLOBAL = (28, 28, 28)
+
+
+def _six_neighbor_only_spec() -> LinkmapSpec:
+    from stencil_tpu.parallel.exchange import exchange_shard
+    from stencil_tpu.parallel.mesh import make_mesh, mesh_dim
+
+    n = _MESH[0] * _MESH[1] * _MESH[2]
+    mesh = make_mesh(_MESH, jax.devices()[:n])
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+
+    def shard(p):
+        return exchange_shard(p, radius, counts)
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    arg = jax.ShapeDtypeStruct(_GLOBAL, jax.numpy.float32)
+    # the bug: cross-sections priced on the INTERIOR dims only — the
+    # "6 neighbors, 6 bare face slabs" picture, which forgets that the
+    # real slabs are PADDED and forward the edge/corner halos of the
+    # other axes. Every edge/corner byte the HLO moves goes missing.
+    interior = tuple(g // m - 2 * radius.face(0, 1)
+                     for g, m in zip(_GLOBAL, _MESH))
+    traffic = sweep_traffic(interior, radius, Dim3(*_MESH), (4,),
+                            pads_included=False)
+    return LinkmapSpec(fn=sm, args=(arg,), traffic=traffic)
+
+
+TARGETS = [
+    LinkmapTarget("fixture.linkmap_drops_corner_messages",
+                  _six_neighbor_only_spec),
+]

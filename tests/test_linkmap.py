@@ -1,0 +1,598 @@
+"""Link observatory (stencil_tpu/observatory/linkmap.py): the modeled
+traffic matrix against the existing byte counters, link/direction
+classification, the measured topology fingerprint and its tuner
+consumption, the per-link attribution gauges, the placement-quality
+QAP gate, and the observatory CLI surfaces."""
+
+import json
+
+import numpy as np
+import pytest
+
+from stencil_tpu.analysis.costmodel import (LinkCoefficients,
+                                            migration_wire_bytes_per_shard)
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.observatory.linkmap import (REGISTERED_MESHES,
+                                             TrafficMatrix,
+                                             allgather_traffic, classify,
+                                             link_attribution_for,
+                                             link_class_of,
+                                             load_topology,
+                                             measure_topology,
+                                             mesh_distance_matrix,
+                                             method_traffic,
+                                             migration_traffic,
+                                             pic_traffic,
+                                             placement_quality,
+                                             placement_report,
+                                             render_heatmap,
+                                             render_summary,
+                                             save_topology, shard_slice,
+                                             sweep_traffic,
+                                             topology_coefficients,
+                                             topology_fingerprint,
+                                             topology_fingerprint_inputs,
+                                             validate_topology)
+from stencil_tpu.observatory.__main__ import main as observatory_cli
+from stencil_tpu.parallel.exchange import exchanged_bytes_per_sweep
+from stencil_tpu.tuning import FakeTimer, TuneGeometry, run_autotune
+from stencil_tpu.tuning.plan import fingerprint_inputs
+
+
+def _sweep_total(padded, radius, counts, elem):
+    return sum(exchanged_bytes_per_sweep(padded, radius, counts,
+                                         elem).values())
+
+
+# ----------------------------------------------------------------------
+# the modeled traffic matrix vs the existing byte counters
+# ----------------------------------------------------------------------
+class TestTrafficMatrix:
+    @pytest.mark.parametrize("r", [1, 2, 3])
+    def test_sweep_rows_match_exchange_counter(self, r):
+        radius = Radius.constant(r)
+        counts = Dim3(2, 2, 2)
+        padded = (8 + 2 * r, 8 + 2 * r, 8 + 2 * r)
+        tm = sweep_traffic(padded, radius, counts, (4,))
+        assert tm.uniform_per_shard() == _sweep_total(padded, radius,
+                                                      counts, 4)
+        # whole-matrix total = n_shards x per-shard
+        assert tm.total() == 8 * tm.uniform_per_shard()
+        w = tm.matrix()
+        assert np.all(np.diag(w) == 0)
+
+    def test_asymmetric_radius_and_flat_axis(self):
+        radius = Radius.constant(0)
+        radius.set_dir((1, 0, 0), 2)
+        radius.set_dir((-1, 0, 0), 1)
+        radius.set_dir((0, 1, 0), 1)
+        counts = Dim3(2, 2, 1)  # z flat: no z traffic ever
+        padded = (8, 11, 11)
+        tm = sweep_traffic(padded, radius, counts, (4,))
+        assert tm.uniform_per_shard() == _sweep_total(padded, radius,
+                                                      counts, 4)
+        assert tm.axis_bytes()["z"] == 0
+
+    def test_multi_quantity_elem_sizes(self):
+        radius = Radius.constant(1)
+        counts = Dim3(2, 2, 2)
+        padded = (10, 10, 10)
+        tm = sweep_traffic(padded, radius, counts, (4, 2))
+        want = (_sweep_total(padded, radius, counts, 4)
+                + _sweep_total(padded, radius, counts, 2))
+        assert tm.uniform_per_shard() == want
+
+    def test_direction_class_decomposition_sums_exactly(self):
+        radius = Radius.constant(2)
+        counts = Dim3(2, 2, 2)
+        tm = sweep_traffic((12, 12, 12), radius, counts, (4,))
+        cls = tm.direction_class_bytes()
+        assert sum(cls.values()) == tm.total()
+        assert cls["corner"] > 0 and cls["edge"] > 0
+
+    def test_face_only_slabs_have_no_edge_corner_share(self):
+        tm = allgather_traffic((8, 8, 8), Radius.constant(1),
+                               Dim3(2, 2, 2), (4,))
+        cls = tm.direction_class_bytes()
+        assert cls["edge"] == 0 and cls["corner"] == 0
+        assert tm.uniform_per_shard() == _sweep_total(
+            (8, 8, 8), Radius.constant(1), Dim3(2, 2, 2), 4)
+
+    def test_migration_matches_costmodel(self):
+        counts = Dim3(2, 2, 1)
+        tm = migration_traffic(counts, 5, 8, 4)
+        assert tm.uniform_per_shard() == migration_wire_bytes_per_shard(
+            5, 8, counts, 4)
+        assert tm.axis_bytes()["z"] == 0  # flat axis: local copy
+
+    def test_method_traffic_deepens_like_the_cost_model(self):
+        from stencil_tpu.analysis.costmodel import exchange_round_model
+
+        geom = ((8, 8, 8), Radius.constant(1), Dim3(2, 2, 2))
+        for s in (1, 2, 4):
+            tm = method_traffic("PpermuteSlab", geom[0], geom[1],
+                                geom[2], (4,), steps=s)
+            _, nbytes = exchange_round_model("PpermuteSlab", geom[0],
+                                             geom[1], geom[2], (4,), s)
+            assert tm.uniform_per_shard() == nbytes
+
+    def test_pic_traffic_is_adjoint_plus_exchange_plus_migration(self):
+        counts = Dim3(2, 2, 2)
+        radius = Radius.constant(2)
+        tm = pic_traffic((8, 8, 8), radius, counts, 4, 7, 8)
+        sweep = _sweep_total((12, 12, 12), radius, counts, 4)
+        mig = migration_wire_bytes_per_shard(7, 8, counts, 4)
+        assert tm.uniform_per_shard() == 2 * sweep + mig
+
+    def test_merge_accumulates(self):
+        counts = Dim3(2, 1, 1)
+        a = migration_traffic(counts, 1, 1, 4)
+        b = migration_traffic(counts, 1, 1, 4)
+        assert a.merge(b).total() == 2 * a.total()
+
+    def test_renderers_smoke(self):
+        tm = sweep_traffic((10, 10, 10), Radius.constant(1),
+                           Dim3(2, 2, 1), (4,))
+        art = render_heatmap(tm)
+        assert "traffic matrix" in art and "|" in art
+        txt = render_summary(classify(tm))
+        assert "link classes" in txt and "direction classes" in txt
+
+
+# ----------------------------------------------------------------------
+# link classification
+# ----------------------------------------------------------------------
+class TestClassification:
+    def test_neighbors_are_one_hop_including_the_wrap_link(self):
+        counts = Dim3(4, 1, 1)
+        tm = sweep_traffic((10, 10, 10), Radius.constant(1), counts,
+                           (4,))
+        summary = classify(tm)
+        # every edge (the 3->0 wrap included) is one torus hop
+        assert set(summary.link_bytes) == {("x", "ici-hop1")}
+
+    def test_dcn_axis_classifies_slice_crossing_edges(self):
+        counts = Dim3(2, 2, 2)
+        tm = sweep_traffic((10, 10, 10), Radius.constant(1), counts,
+                           (4,))
+        summary = classify(tm, dcn_axis=2, n_slices=2)
+        # the z axis crosses slices (2 shards over 2 slices): ALL its
+        # traffic is dcn; x/y stay on the intra-slice ici
+        assert ("z", "dcn") in summary.link_bytes
+        assert ("z", "ici-hop1") not in summary.link_bytes
+        assert ("x", "ici-hop1") in summary.link_bytes
+        ici = sum(b for (a, c), b in summary.link_bytes.items()
+                  if c != "dcn")
+        assert ici + summary.link_bytes[("z", "dcn")] \
+            == summary.total_bytes
+
+    def test_shard_slice_blocks_along_axis(self):
+        counts = Dim3(1, 1, 4)
+        assert [shard_slice(i, counts, 2, 2) for i in range(4)] \
+            == [0, 0, 1, 1]
+
+    def test_link_class_of_self(self):
+        counts = Dim3(2, 1, 1)
+        dist = mesh_distance_matrix(counts)
+        assert link_class_of(0, 0, dist, counts) == "self"
+        assert link_class_of(0, 1, dist, counts) == "ici-hop1"
+
+    def test_rounds_per_step_scales_bytes(self):
+        tm = sweep_traffic((12, 12, 12), Radius.constant(2),
+                           Dim3(2, 1, 1), (4,))
+        s2 = classify(tm, rounds_per_step=0.5)
+        s1 = classify(tm)
+        for k in s1.link_bytes:
+            assert s2.link_bytes_per_step()[k] \
+                == s1.link_bytes_per_step()[k] / 2
+
+    def test_summary_record_shares_sum_to_one(self):
+        tm = sweep_traffic((10, 10, 10), Radius.constant(1),
+                           Dim3(2, 2, 2), (4,))
+        rec = classify(tm).to_record()
+        assert sum(v["share"] for v in rec["links"].values()) \
+            == pytest.approx(1.0)
+        assert sum(v["share"]
+                   for v in rec["direction_classes"].values()) \
+            == pytest.approx(1.0)
+
+
+# ----------------------------------------------------------------------
+# the measured topology fingerprint
+# ----------------------------------------------------------------------
+class TestTopologyFingerprint:
+    def _timer(self):
+        return FakeTimer(axis_coeffs={
+            "x": LinkCoefficients(alpha_s=1e-5, beta_bytes_per_s=4e10),
+            "y": LinkCoefficients(alpha_s=2e-5, beta_bytes_per_s=2e10),
+            "z": LinkCoefficients(alpha_s=8e-5, beta_bytes_per_s=5e9),
+        })
+
+    def _inputs(self):
+        return topology_fingerprint_inputs("cpu", 8, (2, 2, 2), 1)
+
+    def test_measure_recovers_per_axis_coefficients_exactly(self):
+        rec = measure_topology(self._timer(), (2, 2, 2),
+                               self._inputs(), dcn_axis=2)
+        assert validate_topology(rec) == []
+        links = topology_coefficients(rec)
+        # the linear alpha-beta fit recovers the fake fabric exactly
+        assert links["x"].alpha_s == pytest.approx(1e-5)
+        assert links["y"].beta_bytes_per_s == pytest.approx(2e10)
+        assert links["z"].alpha_s == pytest.approx(8e-5)
+        # the slice-blocked axis doubles as the dcn link class
+        assert links["dcn"].alpha_s == links["z"].alpha_s
+        # raw samples ride the record for hardware-free refits
+        assert len(rec["links"]["x"]["samples"]) == 3
+
+    def test_flat_axes_are_not_fingerprinted(self):
+        rec = measure_topology(self._timer(), (1, 2, 1),
+                               topology_fingerprint_inputs(
+                                   "cpu", 2, (1, 2, 1), 1))
+        assert set(rec["links"]) == {"y"}
+
+    def test_save_load_roundtrip_fingerprint_keyed(self, tmp_path):
+        path = tmp_path / "topology.json"
+        rec = measure_topology(self._timer(), (2, 2, 2), self._inputs())
+        save_topology(rec, path)
+        back = load_topology(rec["fingerprint"], path)
+        assert back == rec
+        # a different fabric's fingerprint misses
+        other = topology_fingerprint(
+            topology_fingerprint_inputs("tpu", 16, (4, 2, 2), 2))
+        assert load_topology(other, path) is None
+        # two fabrics coexist in one artifact
+        rec2 = measure_topology(
+            self._timer(), (4, 2, 1),
+            topology_fingerprint_inputs("cpu", 8, (4, 2, 1), 1))
+        save_topology(rec2, path)
+        assert load_topology(rec["fingerprint"], path) == rec
+        assert load_topology(rec2["fingerprint"], path) == rec2
+
+    def test_corrupt_artifact_is_a_miss_not_fatal(self, tmp_path):
+        path = tmp_path / "topology.json"
+        path.write_text("{torn")
+        assert load_topology("ab" * 16, path) is None
+        # and save_topology rewrites over the corpse
+        rec = measure_topology(self._timer(), (2, 2, 2), self._inputs())
+        save_topology(rec, path)
+        assert load_topology(rec["fingerprint"], path) == rec
+
+    def test_concurrent_writers_drop_no_fingerprints(self, tmp_path):
+        """Two tenants fingerprinting different fabrics concurrently:
+        both records must land (the read-merge-write runs under the
+        plan cache's writer lock — an unlocked publish would let the
+        last rename win and silently drop the other measurement)."""
+        import threading
+
+        path = tmp_path / "topology.json"
+        recs = [measure_topology(
+            self._timer(), (2, 2, 2),
+            topology_fingerprint_inputs("cpu", 8, (2, 2, 2), i + 1))
+            for i in range(6)]
+        threads = [threading.Thread(target=save_topology,
+                                    args=(r, path)) for r in recs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for r in recs:
+            assert load_topology(r["fingerprint"], path) == r
+
+    def test_save_rejects_invalid_record(self, tmp_path):
+        with pytest.raises(ValueError, match="invalid topology"):
+            save_topology({"schema": 99}, tmp_path / "t.json")
+
+    def test_tuner_consumes_fingerprint_instead_of_pingpong(
+            self, tmp_path):
+        """run_autotune(topology=...) performs ZERO pingpong
+        calibrations — the artifact's per-axis links replace the two
+        global alpha-betas, and the plan records them."""
+        calls = {"pingpong": 0, "axis": 0}
+
+        class SpyTimer(FakeTimer):
+            def pingpong(self, nbytes):
+                calls["pingpong"] += 1
+                return super().pingpong(nbytes)
+
+            def pingpong_axis(self, name, nbytes):
+                calls["axis"] += 1
+                return super().pingpong_axis(name, nbytes)
+
+        rec = measure_topology(self._timer(), (2, 2, 2), self._inputs())
+        geom = TuneGeometry(shard_interior_zyx=(8, 8, 8),
+                            min_interior_zyx=(8, 8, 8),
+                            radius=Radius.constant(1),
+                            counts=Dim3(2, 2, 2), elem_sizes=(4,))
+        inputs = fingerprint_inputs("cpu", 8, (2, 2, 2), (16, 16, 16),
+                                    Radius.constant(1), {"q": "float32"},
+                                    "PERIODIC")
+        plan = run_autotune(geom, inputs, SpyTimer(),
+                            read_cache=False, write_cache=False,
+                            topology=rec)
+        assert calls["pingpong"] == 0 and calls["axis"] == 0
+        assert set(plan.coefficients) == {"x", "y", "z"}
+        assert plan.coefficients["z"]["alpha_s"] \
+            == pytest.approx(8e-5)
+        # ranking priced at the bottleneck link (z: slowest)
+        slab1 = plan.costs["PpermuteSlab[s=1]"]["predicted_s"]
+        from stencil_tpu.analysis.costmodel import \
+            configured_step_seconds
+        want = configured_step_seconds(
+            "PpermuteSlab", (8, 8, 8), Radius.constant(1),
+            Dim3(2, 2, 2), (4,), 1,
+            LinkCoefficients(alpha_s=8e-5, beta_bytes_per_s=5e9))
+        assert slab1 == pytest.approx(want)
+
+    def test_autotune_domain_measures_then_reuses(self, tmp_path,
+                                                  monkeypatch):
+        """autotune_domain(topology_path=...): the first tune measures
+        the per-axis sweeps and persists the artifact; a fingerprint-
+        identical second tune consumes it with zero axis pingpongs."""
+        import numpy as np
+
+        from stencil_tpu.distributed import DistributedDomain
+        from stencil_tpu.tuning import autotune_domain
+
+        calls = {"axis": 0}
+
+        class SpyTimer(FakeTimer):
+            def pingpong_axis(self, name, nbytes):
+                calls["axis"] += 1
+                return super().pingpong_axis(name, nbytes)
+
+        topo = tmp_path / "topology.json"
+        cache = tmp_path / "plans.json"
+
+        def domain():
+            dd = DistributedDomain(16, 16, 16)
+            dd.set_mesh_shape((2, 2, 2))
+            dd.set_radius(1)
+            dd.add_data("q", np.float32)
+            return dd
+
+        plan1 = autotune_domain(domain(), timer=SpyTimer(),
+                                cache_path=cache, topology_path=topo)
+        assert calls["axis"] == 3 * 3  # 3 sizes x 3 active axes
+        assert topo.exists()
+        assert set(plan1.coefficients) == {"x", "y", "z"}
+        # second process: plan-cache hit aside (force re-tune), the
+        # topology artifact supplies the links — no more axis sweeps
+        plan2 = autotune_domain(domain(), timer=SpyTimer(),
+                                cache_path=cache, topology_path=topo,
+                                force=True)
+        assert calls["axis"] == 3 * 3
+        assert plan2.coefficients == plan1.coefficients
+
+
+# ----------------------------------------------------------------------
+# per-link attribution (gauges + domain adapter)
+# ----------------------------------------------------------------------
+class TestLinkAttribution:
+    def test_attributor_exports_link_gauges(self):
+        from stencil_tpu.observatory import (
+            METRIC_LINK_BYTES_PER_STEP, METRIC_LINK_UTILIZATION,
+            PerfAttributor)
+        from stencil_tpu.telemetry import MetricsRegistry
+
+        reg = MetricsRegistry()
+        att = PerfAttributor(
+            "test", "PpermuteSlab", 1, model_step_seconds=1e-3,
+            model_bytes_per_step=3000.0, registry=reg,
+            link_bytes_per_step={("x", "ici-hop1"): 2000.0,
+                                 ("z", "dcn"): 1000.0},
+            link_peak_bytes_per_s={"x": 4e6, "z": 1e6})
+        att.observe(1, 1e-3)  # measured == modeled
+        b = reg.get(METRIC_LINK_BYTES_PER_STEP)
+        u = reg.get(METRIC_LINK_UTILIZATION)
+        assert b.value(axis="x", link_class="ici-hop1") == 2000.0
+        assert b.value(axis="z", link_class="dcn") == 1000.0
+        # 2000 B / 1e-3 s = 2e6 B/s over a 4e6 peak = 0.5
+        assert u.value(axis="x", link_class="ici-hop1") \
+            == pytest.approx(0.5)
+        assert u.value(axis="z", link_class="dcn") \
+            == pytest.approx(1.0)
+        att.reset()
+        assert b.value(axis="x", link_class="ici-hop1") == 0.0
+        assert u.value(axis="z", link_class="dcn") == 0.0
+
+    def test_link_attribution_for_realized_domain(self):
+        import numpy as np
+
+        from stencil_tpu.distributed import DistributedDomain
+
+        dd = DistributedDomain(16, 16, 16)
+        dd.set_mesh_shape((2, 2, 2))
+        dd.set_radius(1)
+        dd.add_data("q", np.float32)
+        dd.realize()
+        link = link_attribution_for(dd)
+        assert link is not None
+        total = sum(link["bytes_per_step"].values())
+        # whole-mesh B/step — the same scope as the attributor's
+        # model_bytes_per_step (exchange_bytes_amortized_per_step)
+        assert total == pytest.approx(
+            dd.exchange_bytes_amortized_per_step())
+        assert set(link["peak_bytes_per_s"]) == {"x", "y", "z"}
+        assert link["summary"]["links"]
+
+    def test_link_attribution_unsharded_domain_is_none(self):
+        import jax
+        import numpy as np
+
+        from stencil_tpu.distributed import DistributedDomain
+
+        dd = DistributedDomain(8, 8, 8, devices=jax.devices()[:1])
+        dd.set_mesh_shape((1, 1, 1))
+        dd.set_radius(1)
+        dd.add_data("q", np.float32)
+        dd.realize()
+        assert link_attribution_for(dd) is None
+
+    def test_resilient_driver_exports_link_gauges(self, tmp_path):
+        """The driver wiring end-to-end: a resilient run on a sharded
+        domain exports nonzero per-link bytes and utilization through
+        the process registry, and clears nothing it did not own."""
+        import numpy as np
+
+        from stencil_tpu.models.jacobi import Jacobi3D
+        from stencil_tpu.observatory import (
+            METRIC_LINK_BYTES_PER_STEP, METRIC_LINK_UTILIZATION)
+        from stencil_tpu.resilience import ResiliencePolicy
+        from stencil_tpu.telemetry import get_registry
+
+        j = Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2),
+                     dtype=np.float32, kernel="xla")
+        j.init()
+        j.run_resilient(4, policy=ResiliencePolicy(check_every=2),
+                        ckpt_dir=str(tmp_path / "ckpt"))
+        reg = get_registry()
+        b = reg.get(METRIC_LINK_BYTES_PER_STEP)
+        u = reg.get(METRIC_LINK_UTILIZATION)
+        got = b.value(axis="x", link_class="ici-hop1")
+        assert got > 0
+        assert u.value(axis="x", link_class="ici-hop1") > 0
+        # the modeled per-link total matches the domain's whole-mesh
+        # amortized byte model — one byte source, three surfaces
+        total = sum(b.value(axis=a, link_class="ici-hop1")
+                    for a in ("x", "y", "z"))
+        assert total == pytest.approx(
+            j.dd.exchange_bytes_amortized_per_step())
+
+    def test_flight_recorder_carries_linkmap(self, tmp_path):
+        from stencil_tpu.observatory import FlightRecorder, validate_dump
+
+        tm = sweep_traffic((10, 10, 10), Radius.constant(1),
+                           Dim3(2, 2, 2), (4,))
+        rec = FlightRecorder(run_id="lmtest")
+        rec.set_linkmap(classify(tm).to_record())
+        path = rec.dump(tmp_path, "unit_test")
+        payload = json.loads(open(path).read())
+        assert validate_dump(payload) == []
+        assert payload["linkmap"]["links"]
+        # a bogus linkmap payload is flagged by the validator
+        payload["linkmap"] = {"nope": 1}
+        assert any("linkmap" in p for p in validate_dump(payload))
+
+
+# ----------------------------------------------------------------------
+# placement-quality scoring
+# ----------------------------------------------------------------------
+class TestPlacementQuality:
+    def test_registered_meshes_all_gate(self):
+        report = placement_report()
+        assert report["ok"] is True
+        assert len(report["meshes"]) == len(REGISTERED_MESHES)
+        for row in report["meshes"]:
+            assert row["qap_cost"] <= row["trivial_cost"] * (1 + 1e-12)
+            assert sorted(row["assignment"]) \
+                == list(range(row["subdomains"]))
+
+    def test_qap_beats_trivial_on_a_scrambled_fabric(self):
+        """On a fabric whose fast links do NOT follow the lattice
+        order, the QAP must strictly beat trivial placement — the
+        signal the reference's NodeAware strategy exists for."""
+
+        class Dev:
+            def __init__(self, coords):
+                self.coords = coords
+
+        counts = Dim3(2, 2, 1)
+        # devices enumerated in an order that scrambles the torus
+        devs = [Dev((0, 0, 0)), Dev((1, 1, 0)), Dev((1, 0, 0)),
+                Dev((0, 1, 0))]
+        row = placement_quality(counts, Radius.constant(1), (4,),
+                                devices=devs)
+        assert row["ok"]
+        assert row["qap_cost"] < row["trivial_cost"]
+
+    def test_dcn_mesh_distance_adds_slice_penalty(self):
+        counts = Dim3(1, 1, 4)
+        flat = mesh_distance_matrix(counts)
+        tiered = mesh_distance_matrix(counts, dcn_axis=2, n_slices=2)
+        # shards 1-2 straddle the slice boundary: penalized
+        assert tiered[1, 2] > flat[1, 2]
+        assert tiered[0, 1] == flat[0, 1]
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+class TestCli:
+    def test_linkmap_renders_and_writes_json(self, tmp_path, capsys):
+        out = tmp_path / "linkmap.json"
+        rc = observatory_cli(["linkmap", "--mesh", "2,2,2",
+                              "--json", str(out)])
+        assert rc == 0
+        text = capsys.readouterr().out
+        assert "traffic matrix" in text and "link classes" in text
+        data = json.loads(out.read_text())
+        assert data["kind"] == "linkmap"
+        assert np.asarray(data["matrix"]).shape == (8, 8)
+
+    def test_linkmap_placement_report_gates(self, tmp_path, capsys):
+        out = tmp_path / "linkmap.json"
+        rc = observatory_cli(["linkmap", "--placement-report",
+                              "--json", str(out)])
+        assert rc == 0
+        assert "placement gate OK" in capsys.readouterr().out
+        data = json.loads(out.read_text())
+        assert data["placement_report"]["ok"] is True
+
+    def test_linkmap_placement_report_fails_loudly(self, monkeypatch,
+                                                   capsys):
+        """A (hypothetical) QAP solver that returns a WORSE placement
+        than trivial must fail the gate with nonzero exit."""
+        import stencil_tpu.observatory.linkmap as lm
+
+        real = lm.placement_quality
+
+        def sabotaged(*a, **kw):
+            row = real(*a, **kw)
+            row["qap_cost"] = row["trivial_cost"] * 2 + 1
+            row["ok"] = False
+            return row
+
+        monkeypatch.setattr(lm, "placement_quality", sabotaged)
+        rc = observatory_cli(["linkmap", "--placement-report"])
+        assert rc == 1
+        assert "placement gate FAILED" in capsys.readouterr().out
+
+    def test_gate_empty_ledger_notes_no_trajectory(self, tmp_path,
+                                                   capsys):
+        led = tmp_path / "empty.jsonl"
+        led.write_text("")
+        out = tmp_path / "gate.json"
+        rc = observatory_cli(["gate", str(led), "--json", str(out)])
+        assert rc == 0
+        assert "no measured trajectory" in capsys.readouterr().out
+        data = json.loads(out.read_text())
+        assert data["groups_checked"] == 0 and data["records"] == 0
+
+    def test_gate_min_groups_floor_fails_vacuous_pass(self, tmp_path):
+        led = tmp_path / "empty.jsonl"
+        led.write_text("")
+        assert observatory_cli(["gate", str(led),
+                                "--min-groups", "1"]) == 1
+        # a healthy ledger with one comparable group satisfies floor 1
+        from stencil_tpu.observatory.ledger import (append_record,
+                                                    make_record)
+        led2 = tmp_path / "ok.jsonl"
+        for sps in (10.0, 11.0):
+            append_record(led2, make_record(
+                "bench", {"k": 1}, {"steps_per_s": sps}))
+        out = tmp_path / "gate.json"
+        assert observatory_cli(["gate", str(led2), "--min-groups", "1",
+                                "--json", str(out)]) == 0
+        assert json.loads(out.read_text())["groups_checked"] == 1
+
+    def test_diff_groupless_ledger_notes_no_trajectory(self, tmp_path,
+                                                       capsys):
+        from stencil_tpu.observatory.ledger import (append_record,
+                                                    make_record)
+        led = tmp_path / "single.jsonl"
+        append_record(led, make_record("bench", {"k": 1},
+                                       {"steps_per_s": 10.0}))
+        rc = observatory_cli(["diff", str(led)])
+        assert rc == 0
+        assert "no measured trajectory" in capsys.readouterr().out

@@ -47,10 +47,10 @@ def test_shipped_registry_is_clean(full_report):
     assert floor >= 105  # the PR 9 acceptance criterion itself
     assert len(report.targets_checked) >= floor
     assert report.ok
-    # all ten checkers actually ran (and were timed)
+    # all eleven checkers actually ran (and were timed)
     assert set(report.checker_seconds) == {
         "footprint", "dma", "collectives", "hlo", "costmodel", "vmem",
-        "donation", "transfer", "recompile", "tiling"}
+        "donation", "transfer", "recompile", "tiling", "linkmap"}
 
 
 def test_checker_filter():
@@ -365,6 +365,49 @@ def test_tiling_registry_production_sizes(full_report):
                                 "flagged-as-expected"), (kernel, m)
 
 
+def test_linkmap_fixture_flagged():
+    """The 6-neighbor-only traffic matrix (corner messages dropped)
+    must under-sum against the HLO-extracted bytes and be flagged
+    with the zero-corner-share hint."""
+    from stencil_tpu.analysis.hlo import lowering_supported
+
+    if not lowering_supported():
+        pytest.skip("no StableHLO lowering in this JAX/backend")
+    report = run_targets(load_targets(FIXTURES / "bad_linkmap.py"))
+    assert not report.ok
+    (f,) = report.errors
+    assert f.checker == "linkmap"
+    assert f.target == "fixture.linkmap_drops_corner_messages"
+    assert "B unattributed" in f.message
+    assert "6-neighbor-only" in f.message
+
+
+def test_linkmap_registry_pins_exact_hlo_bytes(full_report):
+    """The acceptance criterion: every observatory.linkmap.* target's
+    modeled traffic matrix sums EXACTLY to the HLO-extracted wire
+    bytes — slab/packed x s, the all-gather control, migration, and
+    the PIC step (accumulate adjoint included)."""
+    from stencil_tpu.analysis.hlo import lowering_supported
+
+    if not lowering_supported():
+        pytest.skip("no StableHLO lowering in this JAX/backend")
+    report = full_report
+    keys = [k for k in report.metrics if k.startswith("linkmap:")]
+    assert len(keys) >= 9
+    for key in keys:
+        m = report.metrics[key]
+        assert m["matrix_bytes_per_shard"] > 0, key
+        assert (m["observed_bytes_per_shard"]
+                == m["matrix_bytes_per_shard"]), (key, m)
+    for name in ("observatory.linkmap.exchange[r1]",
+                 "observatory.linkmap.plan[PpermuteSlab,s=2]",
+                 "observatory.linkmap.plan[PpermutePacked,s=4]",
+                 "observatory.linkmap.allgather",
+                 "observatory.linkmap.migrate",
+                 "observatory.linkmap.pic_step"):
+        assert f"linkmap:{name}" in report.metrics, name
+
+
 def test_vmem_fixture_flagged():
     report = run_targets(load_targets(FIXTURES / "bad_vmem.py"))
     assert not report.ok
@@ -508,7 +551,8 @@ def test_cli_only_accepts_target_globs(tmp_path):
                                      "bad_recompile.py",
                                      "bad_migration.py",
                                      "bad_attribution.py",
-                                     "bad_tiling.py"])
+                                     "bad_tiling.py",
+                                     "bad_linkmap.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""
@@ -516,7 +560,8 @@ def test_cli_nonzero_on_every_fixture(fixture):
 
     if fixture in ("bad_hlo.py", "bad_plan.py", "bad_probe.py",
                    "bad_probe_metrics.py", "bad_megastep.py",
-                   "bad_donation.py", "bad_migration.py"):
+                   "bad_donation.py", "bad_migration.py",
+                   "bad_linkmap.py"):
         from stencil_tpu.analysis.hlo import lowering_supported
 
         if not lowering_supported():

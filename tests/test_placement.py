@@ -1,0 +1,156 @@
+"""Seed placement-module tests the link observatory activates:
+``placement.comm_bytes_matrix`` against the ``partition.
+halo_byte_model`` oracle (even + uneven partitions),
+``torus_distance_matrix`` invariants, and ``qap.solve_catch``'s clean
+fallback when the native solver library is unavailable."""
+
+import numpy as np
+import pytest
+
+import stencil_tpu.qap as qap
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.partition import RankPartition, halo_byte_model
+from stencil_tpu.placement import (Placement, PlacementStrategy,
+                                   comm_bytes_matrix, iter_messages,
+                                   make_placement,
+                                   torus_distance_matrix)
+from stencil_tpu.topology import Boundary, Topology
+
+
+class _Dev:
+    def __init__(self, coords):
+        self.coords = coords
+
+
+class TestCommBytesMatrix:
+    """The QAP's ``w`` matrix vs the reference's per-message byte
+    model — two independent routes to the same 26-direction halo
+    arithmetic."""
+
+    def _oracle_total(self, part, radius, elem_sizes):
+        return sum(halo_byte_model(part, radius, es)["total"]
+                   for es in elem_sizes)
+
+    def test_even_partition_matches_halo_byte_model(self):
+        part = RankPartition.from_dim((16, 16, 16), (2, 2, 2))
+        radius = Radius.constant(1)
+        w = comm_bytes_matrix(part, radius, (4,))
+        assert w.shape == (8, 8)
+        assert np.all(np.diag(w) == 0)
+        assert w.sum() == self._oracle_total(part, radius, (4,))
+
+    def test_uneven_partition_matches_halo_byte_model(self):
+        # 21 is not divisible by 2: +-1-remainder subdomains, so the
+        # matrix rows are NOT uniform — but the total must still equal
+        # the oracle's sum over the ACTUAL subdomain sizes
+        part = RankPartition.from_dim((21, 21, 16), (2, 2, 2))
+        radius = Radius.constant(2)
+        w = comm_bytes_matrix(part, radius, (4, 8))
+        assert w.sum() == self._oracle_total(part, radius, (4, 8))
+        # remainder subdomains send different byte counts
+        assert len(set(w.sum(axis=1).tolist())) > 1
+
+    def test_asymmetric_radius_directionality(self):
+        # radius only toward +x: subdomains send only to their -x
+        # neighbor (the message toward d fills the neighbor's -d halo)
+        part = RankPartition.from_dim((8, 8, 8), (2, 1, 1))
+        radius = Radius.constant(0)
+        radius.set_dir((1, 0, 0), 1)
+        msgs = list(iter_messages(part, radius, (4,)))
+        assert msgs, "one face pair must exchange"
+        assert all(d == Dim3(-1, 0, 0) for _, _, d, _ in msgs)
+
+    def test_nonperiodic_topology_drops_boundary_messages(self):
+        part = RankPartition.from_dim((16, 16, 16), (2, 2, 2))
+        radius = Radius.constant(1)
+        periodic = comm_bytes_matrix(part, radius, (4,))
+        walls = comm_bytes_matrix(
+            part, radius, (4,),
+            topo=Topology(part.dim(), Boundary.NONE))
+        assert walls.sum() < periodic.sum()
+        assert np.all(walls <= periodic)
+
+
+class TestTorusDistanceMatrix:
+    def test_symmetry_and_zero_diagonal(self):
+        devs = [_Dev((x, y, z)) for z in range(2) for y in range(2)
+                for x in range(2)]
+        d = torus_distance_matrix(devs)
+        assert d.shape == (8, 8)
+        assert np.all(np.diag(d) == 0)
+        assert np.array_equal(d, d.T)
+        # L1 hop counts over coords
+        assert d[0, 1] == 1 and d[0, 7] == 3
+
+    def test_uniform_fallback_without_coords(self):
+        d = torus_distance_matrix([object() for _ in range(4)])
+        assert np.all(np.diag(d) == 0)
+        assert np.all(d[~np.eye(4, dtype=bool)] == 1)
+
+
+class TestQapFallback:
+    def _wd(self):
+        part = RankPartition.from_dim((16, 16, 16), (2, 2, 2))
+        w = comm_bytes_matrix(part, Radius.constant(1), (4,))
+        devs = [_Dev((x, y, z)) for z in range(2) for y in range(2)
+                for x in range(2)]
+        return w, torus_distance_matrix(devs)
+
+    def test_solve_catch_pure_python_when_native_unavailable(
+            self, monkeypatch):
+        """The native library being unbuildable must degrade to the
+        pure-Python hill climb, not fail — same bijection contract,
+        cost no worse than identity."""
+        monkeypatch.setattr(qap, "_get_lib", lambda: None)
+        w, d = self._wd()
+        f, c = qap.solve_catch(w, d)
+        assert sorted(f) == list(range(8))  # a true bijection
+        assert c == pytest.approx(qap.cost(w, d, f))
+        assert c <= qap.cost(w, d, list(range(8))) + 1e-9
+
+    def test_native_available_reports_false_after_failed_build(
+            self, monkeypatch):
+        monkeypatch.setattr(qap, "_get_lib", lambda: None)
+        assert qap.native_available() is False
+
+    def test_fallback_matches_native_on_pinned_case(self, monkeypatch):
+        """The reference's P9 case: the pure-Python climb must find a
+        placement at least as good as identity and agree with cost()
+        whether or not the native solver exists."""
+        bw = np.array([[900, 75, 64, 64], [75, 900, 64, 64],
+                       [64, 64, 900, 75], [64, 64, 75, 900.0]])
+        comm = np.array([[7, 5, 10, 1], [5, 7, 1, 10],
+                         [10, 1, 7, 5], [1, 10, 5, 7.0]])
+        dist = qap.make_reciprocal(bw)
+        native = qap.solve_catch(comm, dist)
+        monkeypatch.setattr(qap, "_get_lib", lambda: None)
+        pure = qap.solve_catch(comm, dist)
+        assert pure[1] == pytest.approx(
+            qap.cost(comm, dist, list(pure[0])))
+        assert pure[1] <= qap.cost(comm, dist, [0, 1, 2, 3]) + 1e-9
+        # both solvers land on equally-good assignments here
+        assert pure[1] == pytest.approx(native[1])
+
+
+class TestMakePlacement:
+    class _IdDev:
+        def __init__(self, i):
+            self.id = i
+
+    def test_node_aware_on_uniform_fabric_is_torus_sort(self):
+        part = RankPartition.from_dim((16, 16, 16), (2, 2, 2))
+        devs = [self._IdDev(i) for i in range(8)]  # no coords: uniform
+        p = make_placement(PlacementStrategy.NodeAware, part, devs,
+                           Radius.constant(1), (4,))
+        assert isinstance(p, Placement)
+        assert sorted(p.assignment) == list(range(8))
+
+    def test_random_placement_is_seeded_permutation(self):
+        part = RankPartition.from_dim((16, 16, 16), (2, 2, 2))
+        devs = [object() for _ in range(8)]
+        p1 = make_placement(PlacementStrategy.IntraNodeRandom, part,
+                            devs, Radius.constant(1), (4,), seed=7)
+        p2 = make_placement(PlacementStrategy.IntraNodeRandom, part,
+                            devs, Radius.constant(1), (4,), seed=7)
+        assert p1.assignment == p2.assignment
+        assert sorted(p1.assignment) == list(range(8))
