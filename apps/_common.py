@@ -210,6 +210,53 @@ def sampled_steps_per_s(one, block, samples: int, batch: int,
     return stats, batch / stats.trimean()
 
 
+def megastep_race(make_engine, make_sentinel, fields_fn, k: int,
+                  n: int, probe_every: int = 1):
+    """The ONE fused-vs-stepwise megastep race protocol (shared by
+    bench_exchange's three legs and pic.py's smoke race): the stepwise
+    side pays one step + one health-probe dispatch per iteration, the
+    fused side ONE megastep per ``k`` steps with the probe trace
+    in-graph — same problem, same health coverage, only the
+    host/device boundary moves. Engines expose ``step()`` /
+    ``make_segment(k, probe_every)`` / ``block()``; compile + warm
+    happen outside both timed windows. Returns
+    ``(stepwise_steps_per_s, fused_steps_per_s, fused_over_stepwise)``."""
+    eng = make_engine()
+    sent = make_sentinel(eng)
+    eng.step()     # compile + warm outside the timed window
+    sent.probe(fields_fn(eng), 0)
+    sent.poll(block=True)
+    eng.block()
+    t0 = time.perf_counter()
+    for i in range(n):
+        eng.step()
+        sent.probe(fields_fn(eng), i + 1)
+        sent.poll()
+    sent.poll(block=True)
+    eng.block()
+    step_dt = time.perf_counter() - t0
+
+    engf = make_engine()
+    fsent = make_sentinel(engf)
+    seg = engf.make_segment(k, probe_every=probe_every)
+    tr = seg.run(0)    # compile + warm
+    fsent.observe_segment(tr.array, tr.abs_steps)
+    fsent.poll(block=True)
+    fsent.reset()
+    engf.block()
+    t0 = time.perf_counter()
+    done = 0
+    while done < n:
+        tr = seg.run(done)
+        done += k
+        fsent.observe_segment(tr.array, tr.abs_steps)
+        fsent.poll()
+    fsent.poll(block=True)
+    engf.block()
+    fused_dt = time.perf_counter() - t0
+    return n / step_dt, n / fused_dt, step_dt / fused_dt
+
+
 def add_bench_record_flags(p: argparse.ArgumentParser) -> None:
     """``--ledger``: where ``--json-out`` runs ALSO append their
     versioned observatory bench record (the append-only perf

@@ -39,8 +39,12 @@ def main() -> None:
                     help="megastep execution: advance --check-every "
                          "iterations per dispatch as ONE fused program "
                          "with the health probe trace in-graph "
-                         "(parallel/megastep.py; XLA path only — fast "
-                         "paths fall back to the classic loop)")
+                         "(parallel/megastep.py; XLA and temporal "
+                         "paths fuse — the temporal path chunks whole "
+                         "lcm(3, s)-period groups with the w carry "
+                         "donated; the interior-resident Pallas fast "
+                         "paths decline loudly and keep the classic "
+                         "loop)")
     ap.add_argument("--check-every", type=int, default=4,
                     help="megastep segment length (iterations per "
                          "fused dispatch) for --fuse-segments")
@@ -113,11 +117,14 @@ def main() -> None:
     segment = None
     if args.fuse_segments:
         segment = m.make_segment(max(args.check_every, 1))
-        if segment is None:
+        if not segment:
             import sys
-            print("# --fuse-segments: no fused-segment support on the "
-                  f"'{m.kernel_path}' path; using the classic loop",
-                  file=sys.stderr)
+            reason = getattr(segment, "reason", "no fused-segment "
+                             "support")
+            print("# --fuse-segments: declined on the "
+                  f"'{m.kernel_path}' path ({reason}); using the "
+                  "classic loop", file=sys.stderr)
+            segment = None
 
     def counted_step():
         nonlocal it, last_saved

@@ -21,7 +21,6 @@ stdout as the CSV artifact); the sweep commentary goes to stderr.
 
 import argparse
 import sys
-import time
 
 from _common import (add_bench_record_flags, add_device_flags,
                      add_method_flags, apply_device_flags, csv_line,
@@ -239,68 +238,77 @@ def main() -> None:
         # race runs the per-device smoke size on ONE device: that is
         # the dispatch-bound regime the megastep targets (on the
         # multi-threaded fake CPU mesh, in-program thread sync — which
-        # fusion cannot remove — swamps the dispatch signal).
-        from stencil_tpu.resilience.health import HealthSentinel
+        # fusion cannot remove — swamps the dispatch signal). Three
+        # legs, one per newly-fused carry contract: XLA Jacobi, the
+        # full PIC state (particle lanes + overflow column in-graph),
+        # and Astaroth's temporal path (w carry under lcm(3, s)
+        # grouping) — the trajectory for the latter two was empty
+        # before the segment compiler.
+        from _common import megastep_race
 
         k = max(args.check_every, 1)
         n = max(args.iters, k)
         n -= n % k
         dev1 = jax.devices()[:1]
 
-        js = Jacobi3D(args.x, args.y, args.z, mesh_shape=(1, 1, 1),
-                      devices=dev1, dtype=np.float32, kernel="xla",
-                      methods=methods_from_args(args))
-        js.init()
-        sentinel = HealthSentinel(js.dd)
-        js.step()          # compile + warm outside the timed window
-        sentinel.probe(js.dd.curr, 0)
-        sentinel.poll(block=True)
-        js.block()
-        t0 = time.perf_counter()
-        for i in range(n):
-            js.step()
-            sentinel.probe(js.dd.curr, i + 1)
-            sentinel.poll()
-        sentinel.poll(block=True)
-        js.block()
-        step_dt = time.perf_counter() - t0
+        from stencil_tpu.models.astaroth import Astaroth
+        from stencil_tpu.models.pic import Pic
+        from stencil_tpu.resilience.health import HealthSentinel
 
-        jf = Jacobi3D(args.x, args.y, args.z, mesh_shape=(1, 1, 1),
-                      devices=dev1, dtype=np.float32, kernel="xla",
-                      methods=methods_from_args(args))
-        jf.init()
-        fsent = HealthSentinel(jf.dd)
-        seg = jf.make_segment(k)
-        tr = seg.run(0)    # compile + warm
-        fsent.observe_segment(tr.array, tr.abs_steps)
-        fsent.poll(block=True)
-        fsent.reset()
-        jf.block()
-        t0 = time.perf_counter()
-        done = 0
-        while done < n:
-            tr = seg.run(done)
-            done += k
-            fsent.observe_segment(tr.array, tr.abs_steps)
-            fsent.poll()
-        fsent.poll(block=True)
-        jf.block()
-        fused_dt = time.perf_counter() - t0
+        def leg(name, make_engine, make_sentinel, fields_fn, **extra):
+            sps, fps, ratio = megastep_race(make_engine, make_sentinel,
+                                            fields_fn, k, n)
+            row = {"check_every": k, "steps": n,
+                   "stepwise_steps_per_s": sps,
+                   "fused_steps_per_s": fps,
+                   "fused_over_stepwise": ratio, **extra}
+            print(csv_line(f"bench_exchange_megastep_{name}", k, n,
+                           f"{sps:.3f}", f"{fps:.3f}",
+                           f"{ratio:.3f}"))
+            print(f"bench_exchange megastep[{name}]: fused[k={k}] "
+                  f"{fps:.3f} steps/s vs per-step dispatch "
+                  f"{sps:.3f} steps/s (x{ratio:.2f})",
+                  file=sys.stderr)
+            return row
 
-        fused_cmp = {
-            "check_every": k,
-            "steps": n,
-            "stepwise_steps_per_s": n / step_dt,
-            "fused_steps_per_s": n / fused_dt,
-            "fused_over_stepwise": step_dt / fused_dt,
-        }
+        def mk_jacobi():
+            j = Jacobi3D(args.x, args.y, args.z, mesh_shape=(1, 1, 1),
+                         devices=dev1, dtype=np.float32, kernel="xla",
+                         methods=methods_from_args(args))
+            j.init()
+            return j
+
+        def mk_pic():
+            # a dispatch-bound particle count: enough to exercise the
+            # full deposit/gather/migrate step, small enough that the
+            # host round-trip (not compute) sets stepwise steps/s
+            return Pic(args.x, args.y, args.z, 256,
+                       mesh_shape=(1, 1, 1), devices=dev1,
+                       dtype=np.float32, deposition="cic")
+
+        ast_s = 2
+
+        def mk_astaroth():
+            a = Astaroth(args.x, args.y, args.z, mesh_shape=(1, 1, 1),
+                         devices=dev1, dtype=np.float32, kernel="xla",
+                         exchange_every=ast_s)
+            a.init()
+            return a
+
+        fused_cmp = leg("jacobi", mk_jacobi,
+                        lambda e: HealthSentinel(e.dd),
+                        lambda e: e.dd.curr)
+        fused_cmp["pic"] = leg("pic", mk_pic,
+                               lambda e: e.make_sentinel(),
+                               lambda e: e.state)
+        fused_cmp["astaroth_temporal"] = leg(
+            "astaroth", mk_astaroth, lambda e: HealthSentinel(e.dd),
+            lambda e: e.dd.curr, exchange_every=ast_s)
+        # keep the legacy CSV row shape for dashboards parsing it
         print(csv_line("bench_exchange_megastep", k, n,
-                       f"{n / step_dt:.3f}", f"{n / fused_dt:.3f}",
-                       f"{step_dt / fused_dt:.3f}"))
-        print(f"bench_exchange megastep: fused[k={k}] "
-              f"{n / fused_dt:.3f} steps/s vs per-step dispatch "
-              f"{n / step_dt:.3f} steps/s "
-              f"(x{step_dt / fused_dt:.2f})", file=sys.stderr)
+                       f"{fused_cmp['stepwise_steps_per_s']:.3f}",
+                       f"{fused_cmp['fused_steps_per_s']:.3f}",
+                       f"{fused_cmp['fused_over_stepwise']:.3f}"))
 
     if args.json_out:
         base = results[0]

@@ -38,7 +38,8 @@ def _run_resilient(p, args) -> None:
     policy = ResiliencePolicy(check_every=args.check_every,
                               ckpt_every=args.ckpt_every,
                               max_retries=args.max_retries,
-                              base_delay=0.01)
+                              base_delay=0.01,
+                              fuse_segments=args.fuse_segments)
     report = p.run_resilient(args.iters, policy=policy,
                              ckpt_dir=args.ckpt_dir or None,
                              faults=plan)
@@ -69,6 +70,16 @@ def main() -> None:
                     help="migration record slots per direction "
                          "(0 = capacity/4)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fuse-segments",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="megastep execution (default on): the bench "
+                         "path races ONE fused dispatch per "
+                         "--fuse-check-every steps (probe trace "
+                         "in-graph) against the per-step "
+                         "dispatch+probe loop and records the ratio; "
+                         "--resilient runs the recovery driver fused")
+    ap.add_argument("--fuse-check-every", type=int, default=8,
+                    help="megastep segment length for the bench race")
     ap.add_argument("--json-out", default="",
                     help="write the bench record (BENCH_pr10 schema)")
     ap.add_argument("--metrics-json", default="", metavar="PATH",
@@ -177,6 +188,39 @@ def main() -> None:
         rec["link_classes"] = {
             k: {"bytes_per_step": v["bytes"], "share": v["share"]}
             for k, v in summary["links"].items()}
+    if args.fuse_segments:
+        # megastep race on ONE device at the per-device size (the one
+        # shared protocol — _common.megastep_race): stepwise = one
+        # step + one probe dispatch per iteration, fused = one
+        # megastep per k steps with the overflow-carrying probe trace
+        # in-graph. The record lands its own pic.megastep ledger
+        # trajectory (CI gates presence + positivity here and the
+        # trajectory via `observatory gate --min-groups`; the >= 1.5
+        # dispatch gate lives on the Jacobi leg — the fake-CPU mesh is
+        # not dispatch-bound for PIC's op-count-heavy step).
+        from _common import megastep_race
+
+        k = max(args.fuse_check_every, 1)
+        nr = max(args.iters, k)
+        nr -= nr % k
+        dev1 = jax.devices()[:1]
+
+        def mk():
+            return Pic(args.x, args.y, args.z, args.particles,
+                       mesh_shape=(1, 1, 1), devices=dev1,
+                       dtype=dtype, deposition=args.deposition,
+                       dt=args.dt, seed=args.seed)
+
+        sps, fps, ratio = megastep_race(
+            mk, lambda e: e.make_sentinel(), lambda e: e.state, k, nr)
+        rec["fused"] = {
+            "check_every": k, "steps": nr,
+            "stepwise_steps_per_s": sps,
+            "fused_steps_per_s": fps,
+            "fused_over_stepwise": ratio,
+        }
+        print(csv_line("pic-megastep", k, nr, f"{sps:.3f}",
+                       f"{fps:.3f}", f"{ratio:.3f}"))
     emit_bench_artifacts(args, rec, "pic")
     if args.metrics_json:
         # one number, two artifacts: the SAME figures as the JSON

@@ -1212,6 +1212,151 @@ def _pic_probe_hlo() -> HloSpec:
                    exact_counts={"all_reduce": 1})
 
 
+# ---------------------------------------------------------------------------
+# PIC megastep targets: the segment compiler's carry-contract proof.
+# A check_every=k fused PIC segment must lower to exactly k x the
+# step's 18 collective-permutes plus ONE probe all-reduce per declared
+# trace row and NOTHING else, with the exchange+migration bytes
+# exactly k x the per-step analytic model AND the probe rows carrying
+# the full contract column set (rho + 7 particle lanes + the overflow
+# column — tests/fixtures/lint/bad_segment_carry.py, a contract that
+# drops the overflow column, is the negative control).
+
+_PIC_SEG_ROWS = -(-_MEGASTEP_K // _MEGASTEP_PROBE_EVERY)
+#: probe-vector columns of the shipped PIC carry contract: rho + the
+#: 7 particle SoA lanes + the migration-overflow extra column
+_PIC_SEG_COLS = 9
+
+
+@functools.lru_cache(maxsize=None)
+def _pic_segment_entry():
+    from ..parallel.megastep import metric_base_vec
+
+    eng = _pic_engine()
+    seg = eng.make_segment(_MEGASTEP_K,
+                           probe_every=_MEGASTEP_PROBE_EVERY)
+    return seg.fn, (dict(eng.state),
+                    metric_base_vec(None, 0, mesh=eng.dd.mesh))
+
+
+def _pic_segment_hlo() -> HloSpec:
+    fn, args = _pic_segment_entry()
+    return HloSpec(fn=fn, args=args,
+                   allow=("collective_permute", "all_reduce"),
+                   exact_counts={
+                       "collective_permute": 18 * _MEGASTEP_K,
+                       "all_reduce": _PIC_SEG_ROWS})
+
+
+def _pic_segment_cost() -> CostModelSpec:
+    fn, args = _pic_segment_entry()
+    return CostModelSpec(fn=fn, args=args,
+                         expected_bytes_per_shard=(
+                             _MEGASTEP_K * _pic_step_bytes()),
+                         count_kinds=("collective_permute",))
+
+
+def _pic_segment_probe_cost() -> CostModelSpec:
+    """The probe side of the carry contract, byte-exact: every trace
+    row's single all-reduce moves the full (2, 9) f32 column set —
+    rho + 7 particle lanes + the overflow column. A contract that
+    drops a column (the bad_segment_carry fixture) shrinks the
+    all-reduce operand and fails this pin."""
+    fn, args = _pic_segment_entry()
+    return CostModelSpec(fn=fn, args=args,
+                         expected_bytes_per_shard=(
+                             _PIC_SEG_ROWS * 2 * _PIC_SEG_COLS * 4),
+                         count_kinds=("all_reduce",))
+
+
+# ---------------------------------------------------------------------------
+# Astaroth temporal megastep targets: the fused segment over
+# lcm(3, s)-period temporal groups must pay exactly the grouped deep
+# exchanges (w riding only where a group starts at alpha != 0) — the
+# segment's wire bill is k x the amortized deep-exchange model,
+# HLO-exact, with one probe all-reduce per declared trace row.
+
+_AST_SEG_S = 2
+_AST_SEG_K = 4
+
+
+@functools.lru_cache(maxsize=None)
+def _astaroth_temporal_engine():
+    import jax
+    import numpy as np
+
+    from ..models.astaroth import Astaroth
+    from ..parallel.methods import Method
+
+    a = Astaroth(8, 8, 16, mesh_shape=(1, 1, 2),
+                 devices=jax.devices()[:2], dtype=np.float32,
+                 kernel="xla", methods=Method.PpermuteSlab,
+                 exchange_every=_AST_SEG_S)
+    a._ensure_w()
+    return a
+
+
+@functools.lru_cache(maxsize=None)
+def _astaroth_segment_entry():
+    from ..parallel.megastep import metric_base_vec
+
+    a = _astaroth_temporal_engine()
+    seg = a.make_segment(_AST_SEG_K,
+                         probe_every=_MEGASTEP_PROBE_EVERY)
+    return seg.fn, ((dict(a.dd.curr), dict(a._w)),
+                    metric_base_vec(None, 0, mesh=a.dd.mesh))
+
+
+def _astaroth_segment_counts():
+    """(ppermutes, probe rows, expected bytes/shard) of the registered
+    temporal segment: per lcm(3, s)-period chunk the groups start at
+    RK substeps (g*s) % 3 — a group starting at alpha != 0 ships the
+    8 w accumulators in the SAME deep exchange (2x quantities, same
+    launches per quantity)."""
+    import math
+
+    from ..models.astaroth import FIELDS, RK3_ALPHA
+    from ..parallel.mesh import mesh_dim
+    from .costmodel import deep_exchange_bytes_per_shard
+
+    a = _astaroth_temporal_engine()
+    s = _AST_SEG_S
+    period = math.lcm(3, s)
+    counts = mesh_dim(a.dd.mesh)
+    local = a.dd.local_size
+    # one f32 quantity's depth-s deep exchange, per shard
+    deep1 = deep_exchange_bytes_per_shard(
+        (local.z, local.y, local.x), a.dd.radius, counts, 4, s)
+    # ppermutes per quantity per deep exchange: 2 per active mesh axis
+    active = sum(1 for ax in range(3) if counts[ax] > 1)
+    starts = [(g * s) % 3 for g in range(period // s)]
+    qs = [len(FIELDS) * (2 if RK3_ALPHA[st] != 0.0 else 1)
+          for st in starts]
+    n_chunks = _AST_SEG_K // (period // 3)
+    cp = n_chunks * sum(qs) * 2 * active
+    from ..parallel.megastep import probe_rel_steps
+    rows = len(probe_rel_steps([period // 3] * n_chunks,
+                               _MEGASTEP_PROBE_EVERY))
+    return cp, rows, n_chunks * sum(qs) * deep1
+
+
+def _astaroth_segment_hlo() -> HloSpec:
+    fn, args = _astaroth_segment_entry()
+    cp, rows, _ = _astaroth_segment_counts()
+    return HloSpec(fn=fn, args=args,
+                   allow=("collective_permute", "all_reduce"),
+                   exact_counts={"collective_permute": cp,
+                                 "all_reduce": rows})
+
+
+def _astaroth_segment_cost() -> CostModelSpec:
+    fn, args = _astaroth_segment_entry()
+    _, _, expected = _astaroth_segment_counts()
+    return CostModelSpec(fn=fn, args=args,
+                         expected_bytes_per_shard=expected,
+                         count_kinds=("collective_permute",))
+
+
 def _central_diff_spec(axis: int) -> StencilOpSpec:
     from ..geometry import Dim3, Radius
     from ..ops.stencil_kernels import central_diff
@@ -1404,6 +1549,8 @@ def _dataflow_targets() -> List[Target]:
         (f"serving.ensemble.set_lane[N={_ENSEMBLE_N},donation]",
          _ensemble_set_lane_entry, (0,)),
         ("models.pic.step[donation]", _pic_step_entry, (0,)),
+        (f"models.pic.segment[k={_MEGASTEP_K},donation]",
+         _pic_segment_entry, (0,)),
     ]
     for name, entry, donate in donation:
         targets.append(DonationTarget(
@@ -1994,6 +2141,26 @@ def default_targets() -> List[Target]:
         HloTarget("models.pic.step[hlo]", _pic_step_hlo),
         CostModelTarget("models.pic.step[cost]", _pic_step_cost),
         HloTarget("models.pic.probe[hlo]", _pic_probe_hlo),
+    ]
+    # the segment compiler's per-model carry contracts: a fused PIC
+    # segment bills exactly k x 18 collective-permutes + one probe
+    # all-reduce per trace row with HLO-exact bytes AND the full
+    # contract probe columns (overflow included, byte-pinned); the
+    # astaroth temporal segment pays exactly its lcm(3,s)-period
+    # grouped deep exchanges — k x the amortized deep-exchange model —
+    # with w riding only where a group starts at alpha != 0
+    targets += [
+        HloTarget(f"models.pic.segment[k={_MEGASTEP_K},hlo]",
+                  _pic_segment_hlo),
+        CostModelTarget(f"models.pic.segment[k={_MEGASTEP_K},cost]",
+                        _pic_segment_cost),
+        CostModelTarget(f"models.pic.segment[k={_MEGASTEP_K},probe]",
+                        _pic_segment_probe_cost),
+        HloTarget(f"models.astaroth.segment[temporal,s={_AST_SEG_S},"
+                  f"k={_AST_SEG_K},hlo]", _astaroth_segment_hlo),
+        CostModelTarget(
+            f"models.astaroth.segment[temporal,s={_AST_SEG_S},"
+            f"k={_AST_SEG_K},cost]", _astaroth_segment_cost),
     ]
     for axis, ax_name in enumerate("xyz"):
         targets.append(StencilOpTarget(

@@ -330,6 +330,7 @@ class Astaroth:
     # -- fused iteration ----------------------------------------------
     def _build_step(self) -> None:
         self._segment_builder = None
+        self._segment_decline = None
         dd = self.dd
         radius = dd.radius
         counts = mesh_dim(dd.mesh)
@@ -544,65 +545,66 @@ class Astaroth:
                              in_specs=(spec, spec, P()),
                              out_specs=(spec, spec), check_vma=False)
         self._iter_n = jax.jit(sm_n, donate_argnums=(0, 1))
-        self._set_segment_builder(shard_iter)
+        self._set_segment_builder(lambda fw, c: shard_iter(*fw))
 
-    def _set_segment_builder(self, shard_iter) -> None:
-        """Megastep factory for the XLA path: the RK accumulators ride
-        the fused segment as carry next to the fields, both donated
-        end-to-end; the in-graph probe reads the PADDED fields after
-        each full RK3 iteration."""
+    def _set_segment_builder(self, advance_iters, stride: int = 1
+                             ) -> None:
+        """Megastep factory: the RK accumulators ride the fused
+        segment as carry next to the fields, both donated end-to-end
+        (the ``(fields, w)`` pair IS the carry contract's state
+        pytree); the in-graph probe reads the PADDED fields after each
+        full RK3 iteration. ``advance_iters((fields, w), c)`` advances
+        ``c`` iterations per shard — ``c`` is the path's stride (one
+        whole ``lcm(3, s)``-period group block on the temporal path,
+        so every blocked group's RK phase stays static inside the
+        segment) or a depth-1 tail iteration."""
+        from ..parallel import megastep as ms
+
         dd = self.dd
-        cache: dict = {}
+        spec = P("z", "y", "x")
+        fields_spec = {q: spec for q in FIELDS}
 
-        def build(k: int, probe_every: int, metrics):
-            from ..parallel import megastep as ms
+        def state_fn():
+            self._ensure_w()
+            return (dict(self.dd.curr), dict(self._w))
 
-            chunks = ms.segment_chunks(k)
-            key = (k, probe_every,
-                   None if metrics is None
-                   else float(metrics.bytes_per_step))
-            fn = cache.get(key)
-            if fn is None:
-                spec = P("z", "y", "x")
-                fields_spec = {q: spec for q in FIELDS}
-                fn = ms.make_segment_fn(
-                    dd.mesh,
-                    lambda fw, c, i: shard_iter(*fw),
-                    lambda fw: {q: fw[0][q] for q in FIELDS},
-                    (fields_spec, fields_spec), chunks,
-                    probe_every=probe_every,
-                    metric_names=(metrics.names if metrics is not None
-                                  else ()),
-                    bytes_per_step=(metrics.bytes_per_step
-                                    if metrics is not None else 0.0))
-                cache[key] = fn
-            rel = ms.probe_rel_steps(chunks, probe_every)
+        def adopt(out):
+            out_f, out_w = out
+            self.dd.curr = dict(out_f)
+            self._w = dict(out_w)
 
-            def run(base_step: int):
-                self._ensure_w()
-                vec = ms.metric_base_vec(metrics, base_step,
-                                         mesh=dd.mesh)
-                (out_f, out_w), tr = fn(
-                    (dict(self.dd.curr), dict(self._w)), vec)
-                self.dd.curr = dict(out_f)
-                self._w = dict(out_w)
-                return ms.SegmentTrace(tr, rel, base_step)
+        self._segment_decline = None
+        self._segment_builder = ms.SegmentCompiler(
+            dd.mesh,
+            ms.CarryContract(
+                specs=(fields_spec, fields_spec),
+                probe_view=lambda fw: {q: fw[0][q] for q in FIELDS},
+                stride=stride),
+            lambda fw, c, i: advance_iters(fw, c), state_fn, adopt)
 
-            return ms.Segment(run, k, rel, fn=fn)
-
-        self._segment_builder = build
+    def _set_segment_decline(self, reason: str) -> None:
+        self._segment_builder = None
+        self._segment_decline = reason
 
     def make_segment(self, check_every: int, probe_every: int = 1,
                      metrics=None):
         """ONE compiled program advancing ``check_every`` RK3
         iterations with the health probe fused in-graph
         (``parallel/megastep.py``); the ``w`` accumulators travel as
-        segment carry. None on the Pallas fast paths and the temporal
-        path (their in-kernel/grouped loops are already fused) — the
-        resilient driver falls back to stepwise dispatch there."""
+        segment carry. The XLA path unrolls per iteration; the
+        temporal path chunks whole ``lcm(3, s)``-period groups (the w
+        carry's group-straddle phases stay static) plus depth-1
+        tails. The interior-resident Pallas fast paths return a falsy
+        reason-carrying ``SegmentDecline`` (their state lives outside
+        ``dd.curr`` in the extract/loop/insert program split) — the
+        resilient driver reports it and falls back to stepwise
+        dispatch there."""
         builder = getattr(self, "_segment_builder", None)
         if builder is None:
-            return None
+            from ..parallel.megastep import decline
+            reason = (getattr(self, "_segment_decline", None)
+                      or "no fused-segment builder for this path")
+            return decline("astaroth", self.kernel_path, reason)
         return builder(int(check_every), max(int(probe_every), 1),
                        metrics)
 
@@ -727,6 +729,26 @@ class Astaroth:
         self._iter_n = jax.jit(sm_n, donate_argnums=(0, 1))
         self._iter = lambda f, w: self._iter_n(f, w,
                                                jnp.asarray(1, jnp.int32))
+
+        iters_per_period = period // 3
+
+        def advance_iters(fw, c):
+            # one segment chunk, per shard: a whole lcm(3, s)-period
+            # block (every group's RK phase static — the SAME group
+            # sequence period_body runs, w shipping in the deep
+            # exchange exactly where alpha != 0), or one depth-1 tail
+            # iteration (3 per-substep groups)
+            f, w = fw
+            origin = shard_origin(local, rem)
+            if c == iters_per_period:
+                for g in range(period // s):
+                    f, w = group(f, w, origin, (g * s) % 3, s)
+            else:
+                for sub in range(3):
+                    f, w = group(f, w, origin, sub, 1)
+            return f, w
+
+        self._set_segment_builder(advance_iters, stride=iters_per_period)
 
     def _build_wrap_step(self) -> None:
         """Single-chip fused substeps on interior views (see
@@ -1029,6 +1051,15 @@ class Astaroth:
 
         self._iter_n = iteration_n
         self._iter = lambda f, w: iteration_n(f, w, jnp.asarray(1, jnp.int32))
+        # the interior-resident fast paths keep their state OUTSIDE
+        # dd.curr in a three-program extract/loop/insert split (fusing
+        # extract+loop+insert into one program measured an order of
+        # magnitude slower — see _build_wrap_step); a megastep over
+        # dd.curr would advance stale state, so the path declines
+        # loudly and the driver runs its already-fused loop stepwise
+        self._set_segment_decline(
+            "interior-resident extract/loop/insert split keeps state "
+            "outside dd.curr (one fused program measured ~10x slower)")
 
     def exchange_stats(self) -> dict:
         """Per-iteration exchange accounting for the BUILT compute path
@@ -1215,9 +1246,9 @@ class Astaroth:
             fields_fn=lambda: (self._inner if self._inner is not None
                                else self.dd.curr),
             pre_checkpoint=self.sync_domain,
-            make_segment=(self.make_segment
-                          if self._segment_builder is not None
-                          else None),
+            # always passed: paths with no builder return a
+            # reason-carrying decline the driver reports
+            make_segment=self.make_segment,
             perf_entry="astaroth")
 
 

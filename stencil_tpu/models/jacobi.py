@@ -289,45 +289,37 @@ class Jacobi3D:
                              ) -> None:
         """Register the fused-segment factory for the built compute
         path: ``shard_advance(p, steps)`` advances one shard's padded
-        field ``steps`` steps (``steps`` is a whole temporal group or a
-        depth-1 tail step). :meth:`make_segment` builds/caches the
-        jitted megastep programs from it."""
+        field ``steps`` steps (``steps`` is the path's stride — a
+        whole temporal group or a Pallas kernel's in-kernel multi-step
+        count — or a depth-1 tail step). The carry contract is one
+        padded field under ``P('z','y','x')``; :meth:`make_segment`
+        compiles/caches the megastep programs through the generic
+        segment compiler (``parallel/megastep.py``)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel import megastep as ms
+
         dd = self.dd
-        cache: dict = {}
 
-        def build(k: int, probe_every: int, metrics):
-            from jax.sharding import PartitionSpec as P
+        def adopt(out):
+            self.dd.curr["temp"] = out
 
-            from ..parallel import megastep as ms
+        self._segment_decline = None
+        self._segment_builder = ms.SegmentCompiler(
+            dd.mesh,
+            ms.CarryContract(specs=P("z", "y", "x"),
+                             probe_view=lambda p: {"temp": p},
+                             stride=stride),
+            lambda p, c, i: shard_advance(p, c),
+            lambda: self.dd.curr["temp"], adopt)
 
-            chunks = ms.segment_chunks(k, stride)
-            key = (k, probe_every,
-                   None if metrics is None
-                   else float(metrics.bytes_per_step))
-            fn = cache.get(key)
-            if fn is None:
-                fn = ms.make_segment_fn(
-                    dd.mesh,
-                    lambda p, c, i: shard_advance(p, c),
-                    lambda p: {"temp": p},
-                    P("z", "y", "x"), chunks, probe_every=probe_every,
-                    metric_names=(metrics.names if metrics is not None
-                                  else ()),
-                    bytes_per_step=(metrics.bytes_per_step
-                                    if metrics is not None else 0.0))
-                cache[key] = fn
-            rel = ms.probe_rel_steps(chunks, probe_every)
-
-            def run(base_step: int):
-                vec = ms.metric_base_vec(metrics, base_step,
-                                         mesh=dd.mesh)
-                out, tr = fn(self.dd.curr["temp"], vec)
-                self.dd.curr["temp"] = out
-                return ms.SegmentTrace(tr, rel, base_step)
-
-            return ms.Segment(run, k, rel, fn=fn)
-
-        self._segment_builder = build
+    def _set_segment_decline(self, reason: str) -> None:
+        """The built path cannot fuse: record why, so
+        :meth:`make_segment` returns a loud, reason-carrying
+        :class:`~stencil_tpu.parallel.megastep.SegmentDecline` instead
+        of a silent None."""
+        self._segment_builder = None
+        self._segment_decline = reason
 
     def make_segment(self, check_every: int, probe_every: int = 1,
                      metrics=None):
@@ -336,19 +328,27 @@ class Jacobi3D:
         steps (``parallel/megastep.py``): the resilient driver, the
         apps, and the bench dispatch one of these per health boundary
         instead of one jitted step per iteration. Field state is
-        donated end-to-end. Returns None on the interior-resident
-        Pallas fast paths (wrap/halo/overlap), which keep their own
-        fused in-kernel loops — the driver falls back to the stepwise
-        dispatch loop there."""
+        donated end-to-end. Every built compute path fuses — the XLA
+        and temporal paths unroll their shard bodies, the wrap/halo
+        Pallas paths chunk into their in-kernel multi-step launches —
+        except the in-kernel RDMA overlap path, which returns a falsy
+        reason-carrying ``SegmentDecline`` (its kernel owns device-side
+        send/recv semaphore state that must not be replayed inside one
+        unrolled program); the driver reports it and falls back to the
+        stepwise dispatch loop."""
         builder = getattr(self, "_segment_builder", None)
         if builder is None:
-            return None
+            from ..parallel.megastep import decline
+            reason = (getattr(self, "_segment_decline", None)
+                      or "no fused-segment builder for this path")
+            return decline("jacobi", self.kernel_path, reason)
         return builder(int(check_every), max(int(probe_every), 1),
                        metrics)
 
     # -- the fused step ------------------------------------------------
     def _build_step(self) -> None:
         self._segment_builder = None
+        self._segment_decline = None
         dd = self.dd
         radius = dd.radius
         counts = mesh_dim(dd.mesh)
@@ -589,7 +589,29 @@ class Jacobi3D:
         self._step = jax.jit(
             lambda p: steps(p, jnp.asarray(1, jnp.int32)), donate_argnums=0)
 
-    def _build_interior_resident_steps(self, make_body) -> None:
+        def shard_advance(p, c):
+            # one segment chunk: c == N runs the temporally-blocked
+            # multi-step kernel as ONE pallas launch; c == 1 tail steps
+            # run the single-step kernel. Interior is sliced out and
+            # written back per chunk (the probe reads the padded state)
+            inner = lax.slice(p, (lo.z, lo.y, lo.x),
+                              (lo.z + local.z, lo.y + local.y,
+                               lo.x + local.x))
+            if pair_ok and c == N:
+                inner = jacobi7_wrapn_pallas(inner, hot, cold, sph_r,
+                                             steps=N)
+            else:
+                for _ in range(c):
+                    inner = jacobi7_wrap_pallas(inner, hot, cold, sph_r)
+            return lax.dynamic_update_slice(p, inner, (lo.z, lo.y, lo.x))
+
+        self._set_segment_builder(shard_advance,
+                                  stride=N if pair_ok else 1)
+
+    def _build_interior_resident_steps(self, make_body,
+                                       segment_decline: Optional[str]
+                                       = None,
+                                       segment_stride: int = 1) -> None:
         """Shared scaffolding for the interior-resident multi-device
         builders: slice the unpadded interior out of the padded shard,
         fori_loop the per-iteration body from ``make_body(org)``, write
@@ -632,6 +654,36 @@ class Jacobi3D:
         self._step_n = jax.jit(sm, donate_argnums=0)
         self._step = jax.jit(
             lambda p: sm(p, jnp.asarray(1, jnp.int32)), donate_argnums=0)
+
+        if segment_decline is not None:
+            self._set_segment_decline(segment_decline)
+            return
+
+        def shard_advance(p, c):
+            # one segment chunk, per shard: c == group_n is ONE
+            # temporally-blocked kernel launch (its slab exchange
+            # inside), c == 1 a single-step tail — the same bodies the
+            # fused run loop iterates, with the interior written back
+            # per chunk so the in-graph probe reads current state
+            ox, oy, oz = shard_origin(local, rem)
+            org = jnp.stack([oz, oy, ox]).astype(jnp.int32)
+            inner = lax.slice(p, (lo.z, lo.y, lo.x),
+                              (lo.z + local.z, lo.y + local.y,
+                               lo.x + local.x))
+            made = make_body(org)
+            if isinstance(made, tuple):
+                body, group_body, gn = made
+                if c == gn:
+                    inner = group_body(inner)
+                else:
+                    for _ in range(c):
+                        inner = body(inner)
+            else:
+                for _ in range(c):
+                    inner = made(inner)
+            return lax.dynamic_update_slice(p, inner, (lo.z, lo.y, lo.x))
+
+        self._set_segment_builder(shard_advance, stride=segment_stride)
 
     def _build_halo_step(self) -> None:
         """Multi-device fused steps: interior-resident shards, thin slab
@@ -724,7 +776,8 @@ class Jacobi3D:
 
             return body, pair_body, N
 
-        self._build_interior_resident_steps(make_body)
+        self._build_interior_resident_steps(
+            make_body, segment_stride=N if pair_ok else 1)
 
     def _build_overlap_step(self) -> None:
         """Overlapped multi-device fused steps: ONE Pallas kernel per
@@ -747,7 +800,16 @@ class Jacobi3D:
         # radius-1 slab exchange (ops/pallas_overlap.py phase 2)
         self._slab_exchange_cfg = dict(rz=1, ry=1, radius_rows=1,
                                        y_z_extended=False, per_iter_div=1)
-        self._build_interior_resident_steps(make_body)
+        # the ONE named fused-segment decline: the overlap kernel owns
+        # device-side RDMA send/recv semaphore state per launch;
+        # unrolling k launches into one program would interleave those
+        # barriers across iterations — it keeps its own fused loop and
+        # the driver runs it stepwise, reported loudly
+        self._build_interior_resident_steps(
+            make_body,
+            segment_decline="in-kernel RDMA overlap: the kernel owns "
+                            "per-launch send/recv semaphore state the "
+                            "segment unroll must not replay")
 
     def exchange_stats(self) -> dict:
         """Per-iteration exchange accounting for the BUILT compute
@@ -869,10 +931,11 @@ class Jacobi3D:
                              ckpt_dir=ckpt_dir, faults=faults,
                              rebuild=rebuild,
                              fields_fn=lambda: self.dd.curr,
-                             make_segment=(
-                                 self.make_segment
-                                 if self._segment_builder is not None
-                                 else None),
+                             # always passed: a path with no builder
+                             # returns a reason-carrying decline the
+                             # driver reports (never a silent stepwise
+                             # fallback)
+                             make_segment=self.make_segment,
                              perf_entry="jacobi")
 
 

@@ -362,6 +362,8 @@ class Pic:
         sm = jax.shard_map(shard_step, mesh=dd.mesh, in_specs=(specs,),
                            out_specs=specs, check_vma=False)
         self._step = jax.jit(sm, donate_argnums=0)
+        self._shard_step = shard_step
+        self._state_specs = specs
 
         def shard_steps(st, n):
             return lax.fori_loop(0, n, lambda _, s: shard_step(s), st)
@@ -370,6 +372,7 @@ class Pic:
                              in_specs=(specs, P()), out_specs=specs,
                              check_vma=False)
         self._step_n = jax.jit(sm_n, donate_argnums=0)
+        self._build_segment_builder()
         # the per-axis displacement bound the +-1 ring can host, for
         # the CFL note in diagnostics; the in-graph guard above DROPS
         # and COUNTS violators (overflow), never corrupts
@@ -379,6 +382,55 @@ class Pic:
     def _adopt(self, out) -> None:
         self.state = dict(out)
         self.dd.curr["rho"] = self.state["rho"]
+
+    # -- megastep: whole campaign segments as one program ---------------
+    def segment_contract(self):
+        """The PIC carry contract (``parallel/megastep.py``): the
+        fused segment carries the FULL live state — the padded rho
+        plus every particle SoA lane, the validity mask, and the
+        in-graph overflow column — donated end-to-end, and its probe
+        rows reduce rho + all 7 particle lanes with the cumulative
+        migration-overflow counter riding the same one all-reduce as
+        an extra column (the exact column layout
+        :meth:`make_sentinel`'s ``extra_names`` decode). The negative
+        control ``tests/fixtures/lint/bad_segment_carry.py`` is this
+        contract with the overflow column DROPPED, proven flagged."""
+        from ..parallel.megastep import CarryContract
+
+        names = ["rho"] + list(PARTICLE_FIELDS)
+        return CarryContract(
+            specs=dict(self._state_specs),
+            probe_view=lambda st: {q: st[q] for q in names},
+            probe_extra=lambda st: {
+                "migration_overflow": st["overflow"][0]})
+
+    def _build_segment_builder(self) -> None:
+        from ..parallel.megastep import SegmentCompiler
+
+        self._segment_builder = SegmentCompiler(
+            self.dd.mesh, self.segment_contract(),
+            lambda st, c, i: self._shard_step(st),
+            lambda: dict(self.state), self._adopt,
+            # PIC's sentinel decodes its OWN in-graph overflow column;
+            # telemetry StepMetrics columns would shift the decode
+            # layout, so the builder pins the probe rows to the
+            # contract's columns regardless of the metrics argument
+            use_metrics=False)
+
+    def make_segment(self, check_every: int, probe_every: int = 1,
+                     metrics=None):
+        """ONE compiled program advancing ``check_every`` PIC steps —
+        deposit + accumulate + exchange + gather + push + migrate,
+        unrolled ``check_every`` times — with the health probe trace
+        (rho + particle lanes + the overflow column) fused in-graph
+        every ``probe_every`` steps, the whole state dict donated.
+        The ``models.pic.segment[k=4,*]`` registry targets pin one
+        segment to exactly ``k x 18`` collective-permutes plus one
+        probe all-reduce per trace row, bytes HLO-exact. ``metrics``
+        is accepted for driver-interface compatibility and ignored
+        (see :meth:`segment_contract`)."""
+        return self._segment_builder(int(check_every),
+                                     max(int(probe_every), 1), metrics)
 
     def step(self) -> None:
         """One PIC step: deposit + accumulate + exchange + gather +
@@ -543,6 +595,11 @@ class Pic:
             ckpt_dir=ckpt_dir, faults=faults,
             extra_fn=self._particle_extras, on_restore=on_restore,
             fields_fn=lambda: self.state,
+            # megastep mode (default): one fused dispatch per health
+            # boundary, the overflow column riding the in-graph trace;
+            # chaos recovery is BITWISE vs the stepwise loop
+            # (tests/test_pic.py pins it)
+            make_segment=self.make_segment,
             sentinel_factory=lambda dd: self.make_sentinel(),
             model_step_seconds=self.perf_model_step_seconds(),
             model_bytes_per_step=self.perf_model_bytes_per_step(),

@@ -295,6 +295,25 @@ def payload_records(payload: Dict, source: str,
                         fused.get("stepwise_steps_per_s"),
                     "fused_over_stepwise":
                         fused.get("fused_over_stepwise")})
+            # the newly fused carry contracts' race legs (PIC, the
+            # astaroth temporal path) land their OWN trajectories —
+            # these paths had no measured history before the segment
+            # compiler
+            for leg in ("pic", "astaroth_temporal"):
+                sub = fused.get(leg)
+                if not sub:
+                    continue
+                cfg = {**base_cfg,
+                       "check_every": sub.get("check_every",
+                                              fused.get("check_every"))}
+                if "exchange_every" in sub:
+                    cfg["exchange_every"] = sub["exchange_every"]
+                legacy(f"bench_exchange.megastep.{leg}", cfg,
+                       {HEADLINE_METRIC: sub["fused_steps_per_s"],
+                        "stepwise_steps_per_s":
+                            sub.get("stepwise_steps_per_s"),
+                        "fused_over_stepwise":
+                            sub.get("fused_over_stepwise")})
         at = payload.get("autotune")
         if at:
             plan = at.get("plan") or {}
@@ -319,6 +338,18 @@ def payload_records(payload: Dict, source: str,
                 "migration_bytes_per_shard":
                     payload.get("migration_bytes_per_shard"),
                 "overflow": payload.get("overflow")})
+        fused = payload.get("fused")
+        if fused:
+            # the pic smoke's fused/stepwise megastep race (its own
+            # trajectory, gated in CI next to megastep_ratio.json)
+            legacy("pic.megastep",
+                   {**dict(payload.get("config") or {}),
+                    "check_every": fused.get("check_every")},
+                   {HEADLINE_METRIC: fused["fused_steps_per_s"],
+                    "stepwise_steps_per_s":
+                        fused.get("stepwise_steps_per_s"),
+                    "fused_over_stepwise":
+                        fused.get("fused_over_stepwise")})
         return records, skipped
 
     if "parsed" in payload:  # the graft-harness BENCH_r0*.json shape

@@ -7,7 +7,22 @@ this boundary — PIConGPU (arXiv:1606.02862) moves control into the
 device program, POLAR-PIC (arXiv:2604.19337) co-designs the step loop
 with its communication. The megastep is that restructuring for this
 library: a ``check_every``-sized segment of the campaign fuses into a
-single XLA program that
+single XLA program.
+
+This module is a SEGMENT COMPILER, not a Jacobi-shaped unroller: a
+model targets it by declaring a :class:`CarryContract` — the carried
+state pytree and its PartitionSpecs, the donation set, the probe
+extraction, extra in-graph probe columns, and the stride one
+``advance`` call moves (a temporal group, or a Pallas kernel's
+in-kernel step count) — and registering a :class:`SegmentCompiler`.
+PIC's particle lanes + in-graph overflow column, Astaroth's ``w``
+accumulators under ``lcm(3, s)``-period temporal grouping, and the
+Jacobi wrap/halo kernels' multi-step launches all compile to one
+donated program per health boundary through this one interface. A
+path that cannot fuse returns a :class:`SegmentDecline` (falsy, with
+the reason) via :func:`decline` — never a silent ``None``.
+
+Every fused segment
 
 * advances the state ``check_every`` steps through the SAME per-shard
   step bodies the stepwise loops use (bitwise-identical evolution);
@@ -43,7 +58,7 @@ multiple dispatches.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 #: segments longer than this are cut into multiple dispatches by the
 #: consumers (compile time of the unrolled body grows with k)
@@ -56,6 +71,84 @@ def _metric_names() -> Tuple[str, ...]:
     imported lazily to keep this package import-light."""
     from ..telemetry.probe import STEP_METRIC_NAMES
     return STEP_METRIC_NAMES
+
+
+@dataclasses.dataclass(frozen=True)
+class CarryContract:
+    """A model's declaration of what its fused segment carries.
+
+    The segment compiler (:class:`SegmentCompiler` /
+    :func:`make_segment_fn`) is model-agnostic: everything
+    model-specific about a fused segment — which pytree is the loop
+    state, how it shards, what the in-graph probe reads, and which
+    extra in-graph columns ride the probe's single all-reduce — lives
+    in this contract, TEMPI-style (arXiv:2012.14363: a canonical
+    interface the transport compiles against, instead of one bespoke
+    code path per workload).
+
+    * ``specs`` — PartitionSpec pytree matching the carried state
+      (PIC: the padded rho plus every particle lane; Astaroth: the
+      ``(fields, w)`` accumulator pair; Jacobi: one padded field);
+    * ``probe_view(state) -> {name: array}`` — the quantities the
+      in-graph health probe reduces (one row per probe point, ONE
+      all-reduce per row);
+    * ``probe_extra(state) -> {name: scalar}`` — extra IN-GRAPH probe
+      columns riding that same all-reduce (PIC's cumulative
+      migration-overflow counter; order must match the sentinel's
+      ``extra_names``);
+    * ``stride`` — steps one ``advance(state, c, idx)`` call moves
+      when ``c`` equals it: a temporal group (``lcm(3, s)/3``
+      iterations for Astaroth's RK grouping), or a Pallas kernel's
+      in-kernel multi-step count (wrap/halo run ``steps`` inside one
+      ``pallas_call``, so a chunk is one kernel launch, not an
+      unroll). Chunks of 1 are the depth-1 tail;
+    * ``donate`` — donate the state pytree end-to-end (default; the
+      audit registry proves the alias map).
+    """
+
+    specs: Any
+    probe_view: Callable[[Any], Dict[str, Any]]
+    probe_extra: Optional[Callable[[Any], Dict[str, Any]]] = None
+    stride: int = 1
+    donate: bool = True
+
+
+class SegmentDecline:
+    """A falsy ``make_segment`` result that says WHY no fused segment
+    exists for the built path — silent ``None`` returns made stepwise
+    fallbacks invisible to operators. The driver logs it, records
+    ``fused: false`` + the reason in the :class:`~stencil_tpu.
+    resilience.driver.ResilienceReport`, and exports the
+    ``stencil_run_fused_dispatch_total{fused}`` counter."""
+
+    def __init__(self, model: str, path: str, reason: str) -> None:
+        self.model = str(model)
+        self.path = str(path)
+        self.reason = str(reason)
+
+    def __bool__(self) -> bool:
+        return False
+
+    def __repr__(self) -> str:
+        return (f"SegmentDecline({self.model}[{self.path}]: "
+                f"{self.reason})")
+
+
+_DECLINES_WARNED: set = set()
+
+
+def decline(model: str, path: str, reason: str) -> SegmentDecline:
+    """Record a fused-segment decline LOUDLY: warn once per
+    (model, path, reason) and return the falsy, reason-carrying
+    :class:`SegmentDecline` for the caller to hand back."""
+    from ..utils.logging import LOG_WARN
+
+    key = (model, path, reason)
+    if key not in _DECLINES_WARNED:
+        _DECLINES_WARNED.add(key)
+        LOG_WARN(f"{model}[{path}] declines megastep fusion: {reason} "
+                 f"— campaigns on this path run stepwise")
+    return SegmentDecline(model, path, reason)
 
 
 def segment_chunks(k: int, stride: int = 1) -> List[int]:
@@ -90,7 +183,8 @@ def health_probe(probe_view: Callable[[Any], dict],
                  base_vec=None,
                  metric_names: Sequence[str] = (),
                  bytes_per_step: float = 0.0,
-                 axis_names: Sequence[str] = ("z", "y", "x")):
+                 axis_names: Sequence[str] = ("z", "y", "x"),
+                 probe_extra: Optional[Callable[[Any], dict]] = None):
     """The standard in-graph probe for :func:`fused_segment_shard`:
     one :func:`~stencil_tpu.resilience.health.probe_shard` reduction
     over ``probe_view(state)`` (ONE small all-reduce per row), with
@@ -98,7 +192,12 @@ def health_probe(probe_view: Callable[[Any], dict],
     ``base_vec = [base_substeps, base_wire_bytes]`` — row ``done``
     carries ``base + done`` substeps and ``base + done *
     bytes_per_step`` wire bytes, the exact cumulative contract of
-    ``telemetry/probe.py`` without any host round-trip."""
+    ``telemetry/probe.py`` without any host round-trip.
+
+    ``probe_extra(state) -> {name: scalar}`` appends model-owned
+    IN-GRAPH columns (a :class:`CarryContract`'s extra probe columns —
+    PIC's cumulative migration-overflow counter) on the same single
+    all-reduce, after any metric columns."""
     metric_names = tuple(metric_names)
     known = _metric_names()
     for m in metric_names:
@@ -114,6 +213,9 @@ def health_probe(probe_view: Callable[[Any], dict],
                     "wire_bytes": base_vec[1]
                     + float(done) * float(bytes_per_step)}
             extra = {m: vals[m] for m in metric_names}
+        if probe_extra is not None:
+            extra = dict(extra or {})
+            extra.update(probe_extra(state))
         return probe_shard(probe_view(state), axis_names, extra=extra)
 
     return probe
@@ -206,11 +308,15 @@ def metric_base_vec(metrics, base_step: int, mesh=None):
 def make_segment_fn(mesh, advance, probe_view, state_specs,
                     chunks: Sequence[int], probe_every: int = 1,
                     metric_names: Sequence[str] = (),
-                    bytes_per_step: float = 0.0):
+                    bytes_per_step: float = 0.0,
+                    probe_extra: Optional[Callable] = None,
+                    donate: bool = True):
     """Build the jitted fused-segment program: ``fn(state, base_vec) ->
     (state, trace)`` over ``mesh``, with the state pytree DONATED
     end-to-end and the trace replicated. ``advance(state, steps, idx)``
-    and ``probe_view(state) -> {name: padded array}`` run per shard."""
+    and ``probe_view(state) -> {name: padded array}`` run per shard;
+    ``probe_extra(state) -> {name: scalar}`` appends model-owned
+    in-graph probe columns (see :class:`CarryContract`)."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -219,14 +325,97 @@ def make_segment_fn(mesh, advance, probe_view, state_specs,
     def shard_seg(state, vec):
         probe = health_probe(probe_view, base_vec=vec,
                              metric_names=metric_names,
-                             bytes_per_step=bytes_per_step)
+                             bytes_per_step=bytes_per_step,
+                             probe_extra=probe_extra)
         return fused_segment_shard(state, advance, probe, chunks,
                                    probe_every)
 
     sm = jax.shard_map(shard_seg, mesh=mesh,
                        in_specs=(state_specs, P()),
                        out_specs=(state_specs, P()), check_vma=False)
-    return jax.jit(sm, donate_argnums=0)
+    return jax.jit(sm, donate_argnums=0 if donate else ())
+
+
+def make_carry_segment_fn(mesh, contract: CarryContract, advance,
+                          chunks: Sequence[int], probe_every: int = 1,
+                          metric_names: Sequence[str] = (),
+                          bytes_per_step: float = 0.0):
+    """:func:`make_segment_fn` driven by a :class:`CarryContract` —
+    the entry every model-specific segment builder compiles through,
+    so the state pytree, its PartitionSpecs, the donation set, and the
+    probe extraction all come from ONE declared object."""
+    return make_segment_fn(mesh, advance, contract.probe_view,
+                           contract.specs, chunks,
+                           probe_every=probe_every,
+                           metric_names=metric_names,
+                           bytes_per_step=bytes_per_step,
+                           probe_extra=contract.probe_extra,
+                           donate=contract.donate)
+
+
+class SegmentCompiler:
+    """The per-model fused-segment factory: bind a
+    :class:`CarryContract` plus the model's per-shard ``advance`` and
+    its host-side state plumbing ONCE, then every
+    ``(check_every, probe_every, metrics)`` request compiles (and
+    caches) one donated program through the same machinery —
+    ``models/pic.py``, ``models/astaroth.py``, ``models/jacobi.py``
+    and the generic ``DistributedDomain.make_segment`` all register
+    one of these instead of hand-rolling the jit/cache/trace wiring.
+
+    ``advance(state, c, idx)`` runs per shard and moves ``c`` steps
+    (``c`` is the contract's ``stride`` for a whole group/in-kernel
+    chunk, 1 for a tail step). ``state_fn()`` fetches the live carry
+    pytree (its buffers are donated); ``adopt(out)`` installs the
+    result back into the owning engine. ``use_metrics=False`` drops
+    the telemetry metric columns from the probe rows (models whose
+    sentinel decodes its OWN in-graph columns — PIC's overflow — keep
+    their column layout stable regardless of the metrics argument)."""
+
+    def __init__(self, mesh, contract: CarryContract, advance,
+                 state_fn: Callable[[], Any],
+                 adopt: Callable[[Any], None],
+                 use_metrics: bool = True) -> None:
+        self.mesh = mesh
+        self.contract = contract
+        self._advance = advance
+        self._state_fn = state_fn
+        self._adopt = adopt
+        self._use_metrics = bool(use_metrics)
+        self._cache: Dict = {}
+
+    def __call__(self, check_every: int, probe_every: int = 1,
+                 metrics=None) -> Segment:
+        k = int(check_every)
+        if k < 1:
+            raise ValueError(f"check_every must be >= 1, got "
+                             f"{check_every}")
+        probe_every = max(int(probe_every), 1)
+        if not self._use_metrics:
+            metrics = None
+        chunks = segment_chunks(k, self.contract.stride)
+        key = (k, probe_every,
+               None if metrics is None
+               else float(metrics.bytes_per_step))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = make_carry_segment_fn(
+                self.mesh, self.contract, self._advance, chunks,
+                probe_every=probe_every,
+                metric_names=(metrics.names if metrics is not None
+                              else ()),
+                bytes_per_step=(metrics.bytes_per_step
+                                if metrics is not None else 0.0))
+            self._cache[key] = fn
+        rel = probe_rel_steps(chunks, probe_every)
+
+        def run(base_step: int) -> SegmentTrace:
+            vec = metric_base_vec(metrics, base_step, mesh=self.mesh)
+            out, trace = fn(self._state_fn(), vec)
+            self._adopt(out)
+            return SegmentTrace(trace, rel, base_step)
+
+        return Segment(run, k, rel, fn=fn)
 
 
 def make_domain_segment(dd, shard_step, check_every: int,
@@ -239,36 +428,24 @@ def make_domain_segment(dd, shard_step, check_every: int,
     domain, keyed by the step fn and the segment geometry."""
     from jax.sharding import PartitionSpec as P
 
-    k = int(check_every)
-    if k < 1:
-        raise ValueError(f"check_every must be >= 1, got {check_every}")
-    probe_every = max(int(probe_every), 1)
     names = list(dd._names)
-    cache = getattr(dd, "_segment_cache", None)
+    cache = getattr(dd, "_segment_compilers", None)
     if cache is None:
         cache = {}
-        dd._segment_cache = cache
-    key = (shard_step, k, probe_every,
-           None if metrics is None else float(metrics.bytes_per_step))
-    fn = cache.get(key)
-    chunks = segment_chunks(k)
-    if fn is None:
-        spec = {q: P("z", "y", "x") for q in names}
-        fn = make_segment_fn(
-            dd.mesh,
+        dd._segment_compilers = cache
+    compiler = cache.get(shard_step)
+    if compiler is None:
+        contract = CarryContract(
+            specs={q: P("z", "y", "x") for q in names},
+            probe_view=lambda fields: {q: fields[q] for q in names})
+
+        def adopt(out):
+            dd.curr = dict(out)
+
+        compiler = SegmentCompiler(
+            dd.mesh, contract,
             lambda fields, c, i: shard_step(fields),
-            lambda fields: {q: fields[q] for q in names},
-            spec, chunks, probe_every=probe_every,
-            metric_names=(metrics.names if metrics is not None else ()),
-            bytes_per_step=(metrics.bytes_per_step
-                            if metrics is not None else 0.0))
-        cache[key] = fn
-    rel = probe_rel_steps(chunks, probe_every)
-
-    def run(base_step: int) -> SegmentTrace:
-        vec = metric_base_vec(metrics, base_step, mesh=dd.mesh)
-        out, trace = fn(dict(dd.curr), vec)
-        dd.curr = dict(out)
-        return SegmentTrace(trace, rel, base_step)
-
-    return Segment(run, k, rel, fn=fn)
+            lambda: dict(dd.curr), adopt)
+        cache[shard_step] = compiler
+    return compiler(check_every, probe_every=probe_every,
+                    metrics=metrics)

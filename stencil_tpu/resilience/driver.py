@@ -155,6 +155,12 @@ class ResilienceReport:
     resumed_from: Optional[int] = None
     final_config: str = ""
     run_id: str = ""
+    #: did the campaign run fused (megastep) dispatches? False when
+    #: fusion was off by policy, the engine provided no segment
+    #: factory, or the built path declined — ``fused_decline_reason``
+    #: then says WHY (silent stepwise fallbacks used to be invisible)
+    fused: bool = False
+    fused_decline_reason: str = ""
     events: List[Dict] = dataclasses.field(default_factory=list)
 
     def __post_init__(self) -> None:
@@ -272,6 +278,14 @@ class _ResilientRun:
             "amortized exchange B/step (source=model: the analytic "
             "model the HLO cross-check pins; source=probe: harvested "
             "from the in-graph probe counters)")
+        self._m_fused_dispatch = reg.counter(
+            "stencil_run_fused_dispatch_total",
+            "compiled-program dispatches by the resilient run loops, "
+            "labeled fused=true (one megastep per count, covering k "
+            "steps) or fused=false (one stepwise step dispatch per "
+            "count) — a fleet reads the false series to see which "
+            "campaigns still run stepwise and the fused_decline "
+            "events to learn why")
         # seed the unlabeled counters so the exported surface carries
         # an explicit 0 baseline from birth (prometheus_client
         # semantics); "== 0" assertions then test a series that exists
@@ -279,6 +293,8 @@ class _ResilientRun:
                   self._m_save_retries, self._m_checkpoints,
                   self._m_degradations):
             c.inc(0)
+        for fused in ("true", "false"):
+            self._m_fused_dispatch.inc(0, fused=fused)
         # performance observatory: model-vs-measured attribution of
         # every dispatch (observatory/attribution.py) and the bounded
         # flight recorder (observatory/recorder.py). The attributed
@@ -286,6 +302,17 @@ class _ResilientRun:
         # wall clock; the observatory.attribution.* registry targets
         # pin the HLO identity
         self._perf_entry = perf_entry or "resilience"
+        # the fused/stepwise verdict in the report: campaigns that run
+        # stepwise must say so (and why) instead of silently falling
+        # back — ResilienceReport.fused + fused_decline_reason + the
+        # fused_decline event (a declining make_segment adds its own
+        # reason at the first dispatch attempt)
+        self.report.fused = self._fused
+        if not self._fused:
+            self._note_fused_decline(
+                "fuse_segments disabled by policy"
+                if make_segment is not None else
+                "engine provides no fused-segment factory")
         self._model_step_seconds = model_step_seconds
         self._model_bytes_per_step = model_bytes_per_step
         self.attributor = (self._make_attributor()
@@ -637,6 +664,11 @@ class _ResilientRun:
                         LOG_WARN("rebuild() returned no segment "
                                  "factory; continuing stepwise")
                         self._fused = False
+                        # the fallback is a reported fact, not a
+                        # silence: fused: false + reason + event
+                        self._note_fused_decline(
+                            "rebuild() returned no segment factory "
+                            "after degradation")
             except (NotImplementedError, ValueError) as e:
                 self.report.log("degrade_rung_infeasible",
                                 config=cfg.key(),
@@ -681,6 +713,18 @@ class _ResilientRun:
             f"available")
 
     # -- megastep segmentation ------------------------------------------
+    def _note_fused_decline(self, reason: str, model: str = "",
+                            path: str = "") -> None:
+        """Make a stepwise fallback VISIBLE: the report says
+        ``fused: false`` with the reason, the event log carries a
+        ``fused_decline`` record, and the fleet counter's
+        ``fused=false`` series accumulates the stepwise dispatches."""
+        self.report.fused = False
+        self.report.fused_decline_reason = reason
+        self.report.log("fused_decline",
+                        model=model or self._perf_entry,
+                        path=path, reason=reason)
+
     def _next_seg_len(self) -> int:
         """Steps until the next host boundary: campaign end, the
         check_every health boundary, a checkpoint boundary, a scheduled
@@ -707,10 +751,19 @@ class _ResilientRun:
         k = self._next_seg_len()
         seg = self.make_segment(k, self.policy.probe_every,
                                 self._step_metrics)
-        if seg is None:
-            LOG_WARN("engine has no fused-segment support for this "
-                     "configuration; continuing with the stepwise "
-                     "dispatch loop")
+        if not seg:
+            # a SegmentDecline (or legacy None): record the fallback
+            # with its reason — fused: false in the report, a
+            # fused_decline event, and the fused=false counter series
+            reason = getattr(seg, "reason",
+                             "engine has no fused-segment support for "
+                             "this configuration")
+            self._note_fused_decline(
+                reason, model=getattr(seg, "model", ""),
+                path=getattr(seg, "path", ""))
+            LOG_WARN(f"no fused-segment support for this configuration "
+                     f"({reason}); continuing with the stepwise "
+                     f"dispatch loop")
             self._fused = False
             return False
         base = self.step
@@ -737,6 +790,7 @@ class _ResilientRun:
         self.step += k
         self.report.steps = self.step
         self._m_steps.inc(k)
+        self._m_fused_dispatch.inc(fused="true")
         self.sentinel.observe_segment(trace.array, trace.abs_steps)
         return True
 
@@ -851,6 +905,7 @@ class _ResilientRun:
                     self.step += 1
                     self.report.steps = self.step
                     self._m_steps.inc()
+                    self._m_fused_dispatch.inc(fused="false")
                     if att is not None \
                             and self.step % policy.check_every == 0:
                         # boundary-amortized: the accumulated step

@@ -267,6 +267,12 @@ class CampaignService:
         self._m_snapshots = m.counter(
             "stencil_service_snapshots_total",
             "streaming snapshots enqueued")
+        self._m_fused_dispatch = m.counter(
+            "stencil_run_fused_dispatch_total",
+            "compiled-program dispatches by the batch loop, labeled "
+            "fused=true (one megastep covering k member steps) or "
+            "fused=false (one stepwise run dispatch) — the fleet "
+            "signal for campaigns still running stepwise")
         # unlabeled counters export an explicit 0 sample from birth
         # (prometheus_client semantics): the warm-path gates assert
         # recompiles/tuner-measurements == 0 against a series that
@@ -278,6 +284,8 @@ class CampaignService:
                   self._m_tuner, self._m_steps, self._m_checkpoints,
                   self._m_snapshots):
             c.inc(0)
+        for fused in ("true", "false"):
+            self._m_fused_dispatch.inc(0, fused=fused)
 
     # ------------------------------------------------------------------
     # telemetry surfaces
@@ -670,7 +678,16 @@ class CampaignService:
             plan_provenance=(eng.dd.plan_provenance),
             measurements=(plan.measurements if plan is not None
                           and plan.provenance == "tuned" else 0),
+            fused=self._fuse,
             tenants=[e.request.tenant for e in batch])
+        if not self._fuse:
+            # the stepwise fallback is a fleet-visible fact, not a
+            # silent mode: mirrored from the resilient driver's
+            # fused_decline event + stencil_run_fused_dispatch_total
+            self._log("fused_decline", fingerprint=fp,
+                      model="service", path="ensemble",
+                      reason="fuse_segments disabled by service "
+                             "configuration")
         lanes = [
             _Lane(entry=e, index=k,
                   ckpt_dir=str(self.namespace(e.request.tenant,
@@ -778,6 +795,8 @@ class CampaignService:
                 else:
                     with timed:
                         eng.run(seg)
+            self._m_fused_dispatch.inc(
+                fused="true" if self._fuse else "false")
             n_active = 0
             for lane in lanes:
                 if lane.active:

@@ -1,0 +1,54 @@
+"""Negative control for the segment compiler's carry contract: a PIC
+fused segment whose contract DROPS the migration-overflow column.
+
+The carry contract (``parallel/megastep.CarryContract``) is the whole
+point of the segment compiler: the model declares what the fused
+probe rows carry, and the sentinel decodes exactly those columns.
+The broken contract here probes rho + the particle lanes but forgets
+``probe_extra`` — migration overflow silently VANISHES from the
+in-graph trace, so a fleet fusing this segment would never see
+capacity-exceeded particle drops (the overflow counter still
+accumulates in the carry, but no probe row reports it). The
+``models.pic.segment[k=4,probe]``-style byte pin must flag it: each
+trace row's single all-reduce now moves (2, 8) f32 instead of the
+contract's (2, 9) — 128 B/row against the declared 144 B/row bill.
+"""
+
+import dataclasses
+
+from stencil_tpu.analysis.costmodel import CostModelSpec, CostModelTarget
+from stencil_tpu.models.pic import Pic
+from stencil_tpu.parallel.megastep import (SegmentCompiler,
+                                           metric_base_vec)
+
+K = 4
+PROBE_EVERY = 2
+#: the SHIPPED contract's probe bill: rho + 7 particle lanes + the
+#: overflow column = (2, 9) f32 per row, 2 rows for k=4/probe_every=2
+ROWS = -(-K // PROBE_EVERY)
+CONTRACT_COLS = 9
+
+
+def _bad_segment_spec() -> CostModelSpec:
+    eng = Pic(16, 16, 16, 64, mesh_shape=(2, 2, 2), capacity=32,
+              budget=8)
+    # the bug: the carry contract loses its probe_extra — the overflow
+    # column is dropped from every trace row
+    contract = dataclasses.replace(eng.segment_contract(),
+                                   probe_extra=None)
+    builder = SegmentCompiler(
+        eng.dd.mesh, contract, lambda st, c, i: eng._shard_step(st),
+        lambda: dict(eng.state), eng._adopt, use_metrics=False)
+    seg = builder(K, probe_every=PROBE_EVERY)
+    return CostModelSpec(
+        fn=seg.fn,
+        args=(dict(eng.state),
+              metric_base_vec(None, 0, mesh=eng.dd.mesh)),
+        expected_bytes_per_shard=ROWS * 2 * CONTRACT_COLS * 4,
+        count_kinds=("all_reduce",))
+
+
+TARGETS = [
+    CostModelTarget("fixture.pic.segment_carry_drops_overflow[probe]",
+                    _bad_segment_spec),
+]

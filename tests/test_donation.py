@@ -122,8 +122,8 @@ def test_domain_megastep_donates_every_field():
         return {q: exchange_shard(p, radius, counts)
                 for q, p in fields.items()}
 
-    dd.make_segment(shard_step, check_every=2)
-    (fn,) = dd._segment_cache.values()
+    seg = dd.make_segment(shard_step, check_every=2)
+    fn = seg.fn
     vec = metric_base_vec(None, 0, mesh=dd.mesh)
     ids = compiled_alias_ids(fn, (dict(dd.curr), vec))
     assert {0, 1} <= ids, f"expected both fields donated, got {ids}"

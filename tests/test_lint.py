@@ -106,6 +106,7 @@ def test_hlo_registry_collective_permute_only():
               or "models.pic.probe" in key
               or "telemetry." in key
               or "parallel.megastep" in key
+              or ".segment[" in key
               or "observatory.attribution" in key):
             # (the observatory's attributed segment IS the megastep
             # program — identical HLO is the whole point — so it
@@ -382,6 +383,23 @@ def test_linkmap_fixture_flagged():
     assert "6-neighbor-only" in f.message
 
 
+def test_segment_carry_fixture_flagged():
+    """A PIC fused segment whose carry contract DROPS the overflow
+    probe column (tests/fixtures/lint/bad_segment_carry.py): every
+    trace row's all-reduce shrinks from the contract's (2, 9) to
+    (2, 8) f32, so the byte pin must flag the missing column."""
+    from stencil_tpu.analysis.hlo import lowering_supported
+
+    if not lowering_supported():
+        pytest.skip("no StableHLO lowering in this JAX/backend")
+    report = run_targets(load_targets(FIXTURES / "bad_segment_carry.py"))
+    assert not report.ok
+    (f,) = report.errors
+    assert f.checker == "costmodel"
+    assert "128 B/shard" in f.message
+    assert "144 B/shard" in f.message
+
+
 def test_linkmap_registry_pins_exact_hlo_bytes(full_report):
     """The acceptance criterion: every observatory.linkmap.* target's
     modeled traffic matrix sums EXACTLY to the HLO-extracted wire
@@ -552,7 +570,8 @@ def test_cli_only_accepts_target_globs(tmp_path):
                                      "bad_migration.py",
                                      "bad_attribution.py",
                                      "bad_tiling.py",
-                                     "bad_linkmap.py"])
+                                     "bad_linkmap.py",
+                                     "bad_segment_carry.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""
@@ -561,7 +580,7 @@ def test_cli_nonzero_on_every_fixture(fixture):
     if fixture in ("bad_hlo.py", "bad_plan.py", "bad_probe.py",
                    "bad_probe_metrics.py", "bad_megastep.py",
                    "bad_donation.py", "bad_migration.py",
-                   "bad_linkmap.py"):
+                   "bad_linkmap.py", "bad_segment_carry.py"):
         from stencil_tpu.analysis.hlo import lowering_supported
 
         if not lowering_supported():

@@ -100,16 +100,85 @@ def test_jacobi_temporal_segment_bitwise():
     np.testing.assert_array_equal(a.temperature(), b.temperature())
 
 
-def test_fast_paths_decline_segments():
-    """Interior-resident Pallas paths keep their own fused loops: the
-    factory returns None and the driver falls back to stepwise."""
+def test_wrap_path_segment_bitwise():
+    """The single-chip Pallas wrap path fuses: segment chunks mirror
+    run(n)'s N-step in-kernel groups + single-step tail, bitwise."""
     import jax
 
-    j = Jacobi3D(16, 16, 16, mesh_shape=(1, 1, 1),
+    def mk():
+        j = Jacobi3D(16, 16, 16, mesh_shape=(1, 1, 1),
+                     devices=jax.devices()[:1], dtype=np.float32,
+                     kernel="wrap")
+        j.init()
+        return j
+
+    a, b = mk(), mk()
+    a.run(5)
+    seg = b.make_segment(5)
+    assert seg and seg.steps == 5
+    # N=2 in-kernel groups + a single-step tail, probed per chunk
+    assert seg.probe_steps == (2, 4, 5)
+    seg.run(0)
+    np.testing.assert_array_equal(a.temperature(), b.temperature())
+
+
+def test_halo_path_segment_bitwise():
+    """The multi-device Pallas halo path fuses: each segment chunk is
+    one temporally-blocked kernel launch (slab exchange inside),
+    bitwise-equal to the fused run loop."""
+    import jax
+
+    def mk():
+        j = Jacobi3D(16, 16, 16, mesh_shape=(1, 2, 2),
+                     devices=jax.devices()[:4], dtype=np.float32,
+                     kernel="halo")
+        j.init()
+        return j
+
+    a, b = mk(), mk()
+    assert a.kernel_path == "halo"
+    a.run(5)
+    seg = b.make_segment(5)
+    assert seg and seg.probe_steps == (2, 4, 5)
+    seg.run(0)
+    np.testing.assert_array_equal(a.temperature(), b.temperature())
+
+
+def test_overlap_path_declines_loudly():
+    """The ONE remaining decline: the in-kernel RDMA overlap path
+    returns a falsy SegmentDecline carrying model/path/reason — never
+    a silent None."""
+    import jax
+
+    from stencil_tpu.parallel.megastep import SegmentDecline
+
+    j = Jacobi3D(16, 16, 16, mesh_shape=(1, 2, 2),
+                 devices=jax.devices()[:4], dtype=np.float32,
+                 kernel="halo", overlap=True)
+    j.init()
+    assert j.kernel_path == "overlap"
+    d = j.make_segment(4)
+    assert not d
+    assert isinstance(d, SegmentDecline)
+    assert d.model == "jacobi" and d.path == "overlap"
+    assert "RDMA" in d.reason
+
+
+def test_astaroth_fast_path_declines_loudly():
+    """The interior-resident MHD fast paths decline with the
+    extract/loop/insert reason (their state lives outside dd.curr)."""
+    import jax
+
+    from stencil_tpu.models.astaroth import Astaroth
+    from stencil_tpu.parallel.megastep import SegmentDecline
+
+    a = Astaroth(16, 16, 16, mesh_shape=(1, 1, 1),
                  devices=jax.devices()[:1], dtype=np.float32,
                  kernel="wrap")
-    j.init()
-    assert j.make_segment(4) is None
+    d = a.make_segment(2)
+    assert not d and isinstance(d, SegmentDecline)
+    assert d.model == "astaroth" and d.path == "wrap"
+    assert "extract/loop/insert" in d.reason
 
 
 # ----------------------------------------------------------------------
@@ -273,6 +342,128 @@ def test_astaroth_segment_accumulator_carry():
                                    rtol=1e-12, atol=1e-15)
 
 
+def _astaroth_temporal_pair(s, size, iters, check_every):
+    """(stepwise_fields, fused_engine) for the temporal path at depth
+    ``s``: the reference runs the blocked loop, the other runs ONE
+    fused segment — the same lcm(3, s)-period group sequence."""
+    import jax
+
+    from stencil_tpu.models.astaroth import Astaroth
+    from stencil_tpu.parallel.methods import Method
+
+    devs = jax.devices()[:2]
+
+    def mk():
+        a = Astaroth(*size, mesh_shape=(1, 1, 2), devices=devs,
+                     dtype=np.float64, kernel="xla",
+                     methods=Method.PpermuteSlab, exchange_every=s)
+        a.init()
+        return a
+
+    a, b = mk(), mk()
+    assert a.kernel_path == f"xla-temporal[s={s}]"
+    a.run(iters)
+    seg = b.make_segment(check_every)
+    assert seg and seg.steps == check_every
+    done = 0
+    while done < iters:
+        k = min(check_every, iters - done)
+        s2 = b.make_segment(k) if k != check_every else seg
+        s2.run(done)
+        done += k
+    return a, b
+
+
+def test_astaroth_temporal_segment_s2_group_straddle():
+    """s=2 fused segments vs the blocked loop, <= 1 ULP (f64): the
+    lcm(3,2)=6-substep period straddles iteration boundaries, so two
+    of three groups start at alpha != 0 and ship the w carry in the
+    deep exchange — the group-straddle case, INSIDE one fused
+    program."""
+    from stencil_tpu.models.astaroth import FIELDS
+
+    a, b = _astaroth_temporal_pair(2, (8, 8, 16), iters=6,
+                                   check_every=4)
+    for q in FIELDS:
+        np.testing.assert_allclose(b.field(q), a.field(q), rtol=1e-12,
+                                   atol=1e-16, err_msg=q)
+        np.testing.assert_allclose(np.asarray(b._w[q]),
+                                   np.asarray(a._w[q]),
+                                   rtol=1e-12, atol=1e-16, err_msg=q)
+
+
+@pytest.mark.slow
+def test_astaroth_temporal_segment_s3():
+    """s=3 (period == 3: every group starts at alpha_0 == 0, w never
+    rides the wire): fused segments match the blocked loop <= 1 ULP,
+    with an uneven check_every exercising the tail-iteration chunks."""
+    from stencil_tpu.models.astaroth import FIELDS
+
+    # every per-shard axis (unsharded ones included — the local
+    # periodic wrap ships s*r rows too) must be >= the deepened
+    # radius 9, hence 9x9 cross-sections
+    a, b = _astaroth_temporal_pair(3, (9, 9, 20), iters=3,
+                                   check_every=2)
+    for q in FIELDS:
+        np.testing.assert_allclose(b.field(q), a.field(q), rtol=1e-12,
+                                   atol=1e-16, err_msg=q)
+
+
+# ----------------------------------------------------------------------
+# decline visibility: fused: false is a reported fact, not a silence
+# ----------------------------------------------------------------------
+def test_driver_reports_fused_decline(tmp_path):
+    """A declining path under the fused-by-default driver: the report
+    says fused: false with the decline reason, the event log carries
+    fused_decline, and the stencil_run_fused_dispatch_total{fused}
+    counter accumulates the stepwise dispatches. (The overlap path's
+    own decline is pinned by test_overlap_path_declines_loudly; here
+    a declining factory drives the DRIVER's visibility contract
+    without needing interpreted remote DMA to execute steps.)"""
+    from stencil_tpu.parallel.megastep import decline
+    from stencil_tpu.resilience import ResiliencePolicy
+    from stencil_tpu.resilience.driver import run_resilient
+    from stencil_tpu.telemetry import get_registry
+
+    c = get_registry().counter("stencil_run_fused_dispatch_total", "")
+    before_f = c.value(fused="false")
+    before_t = c.value(fused="true")
+    j = make_jacobi()
+    rep = run_resilient(
+        j.dd, j.step, 4,
+        policy=ResiliencePolicy(check_every=2, base_delay=0.0,
+                                sleep=lambda s: None),
+        make_segment=lambda k, pe, m: decline(
+            "jacobi", "overlap",
+            "in-kernel RDMA overlap: per-launch semaphore state"))
+    assert rep.steps == 4
+    assert rep.fused is False
+    assert "RDMA" in rep.fused_decline_reason
+    declines = [e for e in rep.events if e["event"] == "fused_decline"]
+    assert declines and declines[0]["model"] == "jacobi"
+    assert declines[0]["path"] == "overlap"
+    assert c.value(fused="false") - before_f == 4
+    assert c.value(fused="true") == before_t
+    # the record round-trips the verdict (chaos-smoke CI artifact)
+    assert rep.to_record()["fused"] is False
+
+
+def test_driver_reports_fused_true():
+    from stencil_tpu.resilience import ResiliencePolicy
+    from stencil_tpu.telemetry import get_registry
+
+    c = get_registry().counter("stencil_run_fused_dispatch_total", "")
+    before_t = c.value(fused="true")
+    j = make_jacobi()
+    rep = j.run_resilient(
+        4, policy=ResiliencePolicy(check_every=2, base_delay=0.0,
+                                   sleep=lambda s: None))
+    assert rep.fused is True and rep.fused_decline_reason == ""
+    assert not [e for e in rep.events
+                if e["event"] == "fused_decline"]
+    assert c.value(fused="true") - before_t >= 2
+
+
 # ----------------------------------------------------------------------
 # ensemble: batched segments
 # ----------------------------------------------------------------------
@@ -344,6 +535,51 @@ def test_megastep_registry_targets_prove_exact_counts():
     # exact-byte cross-check: observed == expected == k x per-step
     assert cost["observed_bytes_per_shard"] == \
         cost["expected_bytes_per_shard"]
+
+
+def test_carry_contract_registry_targets_prove_exact_counts():
+    """The segment compiler's per-model carry contracts, pinned: a
+    fused PIC segment lowers to exactly k x 18 collective-permutes +
+    one probe all-reduce per trace row with HLO-exact bytes AND the
+    full (2, 9) probe column set; the astaroth temporal segment pays
+    exactly its lcm(3, s)-period grouped deep exchanges (w riding only
+    where a group starts at alpha != 0) — k x the amortized
+    deep-exchange model, byte-exact."""
+    from stencil_tpu.analysis import run_targets
+    from stencil_tpu.analysis.hlo import lowering_supported
+    from stencil_tpu.analysis.registry import default_targets
+
+    if not lowering_supported():
+        pytest.skip("StableHLO lowering unavailable")
+    targets = [t for t in default_targets()
+               if "models.pic.segment" in t.name
+               or "models.astaroth.segment" in t.name]
+    assert {t.name for t in targets} == {
+        "models.pic.segment[k=4,hlo]",
+        "models.pic.segment[k=4,cost]",
+        "models.pic.segment[k=4,probe]",
+        "models.pic.segment[k=4,donation]",
+        "models.astaroth.segment[temporal,s=2,k=4,hlo]",
+        "models.astaroth.segment[temporal,s=2,k=4,cost]"}
+    report = run_targets(targets)
+    assert not report.findings, [str(f) for f in report.findings]
+    pic = report.metrics["hlo:models.pic.segment[k=4,hlo]"]
+    assert pic["collectives"]["collective_permute"]["count"] == 72
+    assert pic["collectives"]["all_reduce"]["count"] == 2
+    # the probe bill: 2 rows x (2, 9) f32 — overflow column included
+    assert pic["collectives"]["all_reduce"]["bytes_per_shard"] == 144
+    cost = report.metrics["costmodel:models.pic.segment[k=4,cost]"]
+    assert cost["observed_bytes_per_shard"] == \
+        cost["expected_bytes_per_shard"]
+    ast = report.metrics[
+        "hlo:models.astaroth.segment[temporal,s=2,k=4,hlo]"]
+    # 2 period chunks x (8 + 16 + 16 quantities) x 2 ppermutes on the
+    # one active axis — the w-carrying groups double their quantities
+    assert ast["collectives"]["collective_permute"]["count"] == 160
+    acost = report.metrics[
+        "costmodel:models.astaroth.segment[temporal,s=2,k=4,cost]"]
+    assert acost["observed_bytes_per_shard"] == \
+        acost["expected_bytes_per_shard"]
 
 
 def test_reprobed_megastep_fixture_flagged():

@@ -357,14 +357,70 @@ def test_backfill_committed_legacy_history():
     assert gate_regressions(records) == []
 
 
+def test_payload_records_carry_contract_race_legs():
+    """The segment compiler's race legs convert into their OWN
+    trajectory groups: bench_exchange payloads with pic /
+    astaroth_temporal fused legs and pic payloads with a fused block
+    each land one extra megastep record (the one shared converter —
+    live emission and backfill can never fork these groups)."""
+    from stencil_tpu.observatory.ledger import payload_records
+
+    leg = {"check_every": 8, "steps": 16,
+           "stepwise_steps_per_s": 100.0, "fused_steps_per_s": 180.0,
+           "fused_over_stepwise": 1.8}
+    be = {"bench": "bench_exchange", "mesh": [1, 1, 1],
+          "per_device_size": [8, 8, 8], "radius": [1, 1, 1],
+          "fields": 1,
+          "configs": [{"exchange_every": 1, "steps_per_s": 50.0}],
+          "fused": {**leg, "pic": dict(leg),
+                    "astaroth_temporal": {**leg,
+                                          "exchange_every": 2}}}
+    records, skipped = payload_records(be, "t", provenance="measured",
+                                       created=1.0)
+    assert not skipped
+    by_bench = {r["bench"]: r for r in records}
+    assert {"bench_exchange", "bench_exchange.megastep",
+            "bench_exchange.megastep.pic",
+            "bench_exchange.megastep.astaroth_temporal"} \
+        <= set(by_bench)
+    ast = by_bench["bench_exchange.megastep.astaroth_temporal"]
+    assert ast["config"]["exchange_every"] == 2
+    assert ast["metrics"]["steps_per_s"] == 180.0
+    assert ast["metrics"]["fused_over_stepwise"] == 1.8
+
+    pic = {"bench": "pic", "seconds_per_step": 0.01,
+           "particle_steps_per_s": 1000.0,
+           "migration_bytes_per_shard": 64, "overflow": 0,
+           "config": {"grid": [8, 8, 8]}, "fused": dict(leg)}
+    records, skipped = payload_records(pic, "t", provenance="measured",
+                                       created=1.0)
+    assert not skipped
+    by_bench = {r["bench"]: r for r in records}
+    assert set(by_bench) == {"pic", "pic.megastep"}
+    assert by_bench["pic.megastep"]["metrics"]["steps_per_s"] == 180.0
+    assert by_bench["pic.megastep"]["config"]["check_every"] == 8
+
+
 def test_committed_seed_ledger_matches_backfill():
-    """bench/ledger.jsonl (the committed trajectory seed) is exactly
-    the backfill of the committed legacy snapshots."""
-    from stencil_tpu.observatory.ledger import validate_ledger
+    """bench/ledger.jsonl: the first ten records are exactly the
+    backfill of the committed legacy snapshots; everything after is a
+    measured record (PR 15 landed the megastep carry-contract race
+    trajectories — bench_exchange.megastep.pic / .astaroth_temporal /
+    pic.megastep — as measured history), all schema-valid and the
+    whole file gate-clean."""
+    from stencil_tpu.observatory.ledger import (gate_regressions,
+                                                validate_ledger)
     recs = read_ledger(REPO / "bench" / "ledger.jsonl")
     assert validate_ledger(recs) == []
-    assert len(recs) == 10
-    assert all(r["provenance"] == "legacy" for r in recs)
+    assert len(recs) >= 22
+    assert all(r["provenance"] == "legacy" for r in recs[:10])
+    assert all(r["provenance"] == "measured" for r in recs[10:])
+    benches = {r["bench"] for r in recs[10:]}
+    assert {"bench_exchange.megastep", "bench_exchange.megastep.pic",
+            "bench_exchange.megastep.astaroth_temporal",
+            "pic.megastep"} <= benches
+    # the measured trajectories gate clean at the committed threshold
+    assert gate_regressions(recs, threshold=0.8) == []
 
 
 def test_live_and_backfilled_records_share_groups(tmp_path):

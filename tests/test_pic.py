@@ -242,13 +242,70 @@ def test_trajectory_and_rho_bitwise_across_meshes_cic():
 
 
 # ----------------------------------------------------------------------
-# ParticleLoss + resilience (bitwise recovery)
+# megastep: the PIC carry contract (fused == stepwise, bitwise)
 # ----------------------------------------------------------------------
-def test_particle_loss_recovery_bitwise(tmp_path):
+@pytest.mark.parametrize("grid", [(8, 8, 8), (9, 9, 9)])
+def test_pic_segment_bitwise_even_and_uneven(grid):
+    """A fused PIC segment == the stepwise dispatch loop BITWISE on
+    the full carried state: rho, every particle SoA lane, the validity
+    mask, AND the cumulative overflow column — even 8^3 and uneven
+    (+-1) 9^3 partitions. The trace rows carry the contract's 9 probe
+    columns with the overflow column riding the single all-reduce."""
+    from stencil_tpu.models.pic import PARTICLE_STATE_KEYS
+
+    a = _pic(*grid, n=40, deposition="cic", seed=5)
+    b = _pic(*grid, n=40, deposition="cic", seed=5)
+    for _ in range(4):
+        a.step()
+    seg = b.make_segment(4)
+    assert seg and seg.steps == 4
+    tr = seg.run(0)
+    host = np.asarray(tr.array)
+    assert host.shape == (4, 2, 9)  # rows x stats x (rho+7 lanes+ovf)
+    # the overflow column reports the live counter (zero here) and the
+    # health columns are real reductions over the carried state
+    np.testing.assert_array_equal(host[:, 0, 8], 0.0)
+    assert (host[:, 1, :8] > 0).any()
+    for k in PARTICLE_STATE_KEYS + ("rho",):
+        np.testing.assert_array_equal(np.asarray(a.state[k]),
+                                      np.asarray(b.state[k]),
+                                      err_msg=k)
+
+
+def test_pic_segment_trace_reports_overflow_column():
+    """A budget=1 migration burst drops particles mid-segment: the
+    trace rows' overflow column (the probe's max-reduction over the
+    per-shard cumulative counters) goes nonzero IN-GRAPH, without any
+    separate probe dispatch."""
+    rng = np.random.default_rng(3)
+    n = 24
+    p = _pic(8, 8, 8, n=n, deposition="ngp", budget=1, seed=1)
+    # a burst crossing the same +x boundary: several leavers, budget 1
+    # (the test_sentinel_reports_nonzero_overflow setup, fused)
+    ics = _uniform_ics(rng, (8, 8, 8), n)
+    ics["x"] = np.full(n, 3.9)   # just inside shard x=0
+    ics["vx"] = np.full(n, 1.0)  # all cross next step
+    p.set_particles(ics)
+    tr = p.make_segment(3).run(0)
+    host = np.asarray(tr.array)
+    # the column is the probe's per-shard MAX of the cumulative
+    # counter; the exported total is the all-shard SUM — zero iff no
+    # shard dropped anything, and never above the sum
+    assert host[-1, 0, 8] > 0
+    assert host[-1, 0, 8] <= p.overflow_total()
+
+
+# ----------------------------------------------------------------------
+# ParticleLoss + resilience (bitwise recovery, fused AND stepwise)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("fused", [True, False])
+def test_particle_loss_recovery_bitwise(tmp_path, fused):
     """A ParticleLoss fault trips the sentinel (the NaN'd charge lane
     is probed non-finite), rolls back to the checkpoint whose extras
     carry the particle lanes, and the recovered run ends BITWISE-equal
-    to the fault-free run — fields and particles both."""
+    to the fault-free run — fields and particles both, under the fused
+    megastep driver (default) and the stepwise loop, with the trip at
+    the EXACT injected step in both modes."""
     from stencil_tpu.resilience import (FaultPlan, ParticleLoss,
                                         ResiliencePolicy)
 
@@ -266,11 +323,12 @@ def test_particle_loss_recovery_bitwise(tmp_path):
     plan.particle_losses.append(
         ParticleLoss(step=5, count=2, shard=(0, 0, 0)))
     pol = ResiliencePolicy(check_every=1, ckpt_every=4, base_delay=0.0,
-                           sleep=lambda s: None)
+                           sleep=lambda s: None, fuse_segments=fused)
     rep = p.run_resilient(8, policy=pol, ckpt_dir=str(tmp_path),
                           faults=plan)
     assert rep.steps == 8
     assert rep.rollbacks >= 1
+    assert rep.fused is fused
     kinds = [e["event"] for e in rep.events]
     assert "fault_particle_loss" in kinds and "restored" in kinds
     trip = [e for e in rep.events if e["event"] == "sentinel_tripped"][0]
