@@ -24,14 +24,15 @@ cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
 echo "== 1/12 lint (stencil-lint + ruff; tier=$TIER) =="
-# stencil-lint: all eleven static checkers — halo-radius footprint,
+# stencil-lint: all twelve static checkers — halo-radius footprint,
 # DMA discipline, ppermute sanity, HLO collective-permute-only
 # lowering, analytic-vs-HLO byte cross-check, the Pallas VMEM/tiling
 # audit, the dataflow trio (donation aliasing, host-transfer hygiene,
 # recompile-hazard fingerprints), the prescriptive block-shape tiling
 # gate (every Pallas kernel at 256^3/512^3-per-device shapes against
-# the PHYSICAL VMEM budget — trace-only, no TPU), and the link
-# observatory's traffic-matrix-vs-HLO exactness gate
+# the PHYSICAL VMEM budget — trace-only, no TPU), the link
+# observatory's traffic-matrix-vs-HLO exactness gate, and the RDMA
+# schedule certifier (happens-before under k-fold replay)
 # (python -m stencil_tpu.analysis, see README "Static analysis").
 # The hlo/costmodel byte checks capability-gate themselves on the
 # image's JAX (StableHLO lowering support is probed; Pallas targets
@@ -60,6 +61,32 @@ python -m stencil_tpu.analysis --plan-tiling 'analysis.tiling.*' \
 if [ -n "${CI_ARTIFACT_DIR:-}" ] && [ -f stencil_tiling_plans.json ]; then
   cp stencil_tiling_plans.json "$CI_ARTIFACT_DIR/"
 fi
+# the RDMA schedule certificates (analysis/schedule.py): the per-kernel
+# happens-before verdicts megastep's fusion gate consumes. Archived
+# next to the tiling plans; then the fused⇒certified invariant — every
+# registry target megastep fuses (fused_by_megastep) MUST hold a
+# replay_safe certificate this run, and at least one such target must
+# exist (a deregistered fused target would otherwise pass vacuously).
+python -m stencil_tpu.analysis -q --only 'analysis.schedule.*' \
+  --json stencil_schedule_certificates.json > /dev/null
+if [ -n "${CI_ARTIFACT_DIR:-}" ] && \
+   [ -f stencil_schedule_certificates.json ]; then
+  cp stencil_schedule_certificates.json "$CI_ARTIFACT_DIR/"
+fi
+python - stencil_schedule_certificates.json <<'EOF'
+import json
+import sys
+d = json.load(open(sys.argv[1]))
+fused = {k: v for k, v in d["metrics"].items()
+         if k.startswith("schedule:") and v.get("fused_by_megastep")}
+assert fused, "no fused-by-megastep schedule targets registered"
+bad = [k for k, v in fused.items() if not v.get("replay_safe")]
+assert not bad, \
+    f"megastep fuses UNCERTIFIED RDMA schedules: {bad} — every fused " \
+    f"kernel must hold a replay_safe certificate (analysis/schedule.py)"
+print(f"schedule certificates OK: {len(fused)} fused target(s), all "
+      f"replay_safe")
+EOF
 # the link observatory artifact: the modeled per-link traffic matrix
 # (whose per-method totals the linkmap checker just pinned HLO-exactly
 # above) plus the placement-quality report — QAP placement cost must

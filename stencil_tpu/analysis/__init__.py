@@ -1,7 +1,7 @@
 """stencil-lint / stencil-audit: static invariant checking for the
 stencil framework.
 
-Eleven checkers prove, WITHOUT executing anything (jaxpr tracing plus
+Twelve checkers prove, WITHOUT executing anything (jaxpr tracing plus
 lower-only StableHLO inspection and alias-map parsing of compiled —
 never dispatched — programs; seconds on any CPU box, no TPU, no
 interpreter), the invariants the whole framework hangs on:
@@ -41,6 +41,13 @@ interpreter), the invariants the whole framework hangs on:
   the PHYSICAL VMEM budget (raised ``vmem_limit_bytes`` deliberately
   distrusted — the SNIPPETS.md 512^3 Mosaic allocation failure,
   reproduced and closed);
+* :mod:`.schedule`    — happens-before certification of every remote-
+  DMA kernel's semaphore schedule under k-fold replay: send/recv slots
+  drain before re-arm, the cross-shard rendezvous is deadlock-free,
+  interior compute never reads an unwaited-inbound buffer — emitting
+  the per-kernel ``ScheduleCertificate`` the megastep segment compiler
+  consumes to fuse (or certificate-citingly decline) in-kernel RDMA
+  paths;
 * ``linkmap`` (:mod:`stencil_tpu.observatory.linkmap`) — the link
   observatory's modeled per-(src, dst) traffic matrix sums EXACTLY to
   the HLO-extracted wire bytes for every registered exchange method
@@ -71,6 +78,9 @@ from .recompile import (RecompileGuardError, RecompileSpec,
                         RecompileTarget, SingleCompileGuard,
                         assert_single_compile, check_recompile)
 from .report import ERROR, WARNING, Finding, Report
+from .schedule import (ScheduleCertificate, ScheduleSpec,
+                       ScheduleTarget, certify_traceable,
+                       check_schedule)
 from .transfer import (TransferSpec, TransferTarget, check_transfer,
                        hot_loop_transfer_guard)
 from .tiling import (TilingInfeasibleError, TilingPlan, TilingSpec,
@@ -85,7 +95,7 @@ from ..observatory.linkmap import (LinkmapSpec, LinkmapTarget,
 
 CHECKERS = ("footprint", "dma", "collectives", "hlo", "costmodel",
             "vmem", "donation", "transfer", "recompile", "tiling",
-            "linkmap")
+            "linkmap", "schedule")
 
 CHECKER_DOC = {
     "footprint": "26-direction access footprint vs declared Radius",
@@ -99,6 +109,8 @@ CHECKER_DOC = {
     "recompile": "dispatch-stable abstract fingerprints (no retrace)",
     "tiling": "prescriptive VMEM block-shape planner at 256^3/512^3",
     "linkmap": "per-link traffic matrix sums exactly to HLO bytes",
+    "schedule": "RDMA semaphore schedules certified replay-safe "
+                "(happens-before under k-fold replay)",
 }
 
 __all__ = [
@@ -108,11 +120,14 @@ __all__ = [
     "HloTarget", "PallasKernelSpec", "PallasKernelTarget",
     "LinkmapSpec", "LinkmapTarget",
     "RecompileGuardError", "RecompileSpec", "RecompileTarget",
+    "ScheduleCertificate", "ScheduleSpec", "ScheduleTarget",
     "SingleCompileGuard", "StencilOpSpec", "StencilOpTarget",
     "TransferSpec", "TransferTarget", "VmemSpec", "VmemTarget",
-    "alias_param_ids", "assert_single_compile", "check_collectives",
+    "alias_param_ids", "assert_single_compile", "certify_traceable",
+    "check_collectives",
     "check_costmodel", "check_donation", "check_hlo",
     "check_linkmap", "check_pallas_kernels", "check_recompile",
+    "check_schedule",
     "check_stencil_op", "check_tiling", "check_transfer", "check_vmem",
     "hot_loop_transfer_guard", "plan_blocks", "run_targets",
     "snap_blocks",
@@ -130,6 +145,7 @@ _DISPATCH = {
     "recompile": check_recompile,
     "tiling": check_tiling,
     "linkmap": check_linkmap,
+    "schedule": check_schedule,
 }
 
 

@@ -23,6 +23,20 @@ import sys
 from typing import List, Optional
 
 
+def _match(name: str, pattern: str) -> bool:
+    """fnmatch with literal-bracket tolerance: registry names like
+    ``analysis.tiling.jacobi_halo[512]`` collide with fnmatch's
+    character classes, so try the raw pattern first (old ``?512?``
+    spellings keep working) and then a variant with every ``[``
+    escaped to the ``[[]`` character class — ``--only
+    'analysis.schedule.*[k=4]'`` just works."""
+    if fnmatch.fnmatchcase(name, pattern):
+        return True
+    if "[" in pattern:
+        return fnmatch.fnmatchcase(name, pattern.replace("[", "[[]"))
+    return False
+
+
 def _setup_backend() -> None:
     """Analysis is pure tracing/lowering: force a small virtual-CPU
     mesh so the shard_map targets resolve their axes without touching
@@ -44,8 +58,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="stencil-lint: static halo-radius / DMA-discipline "
                     "/ collective-permutation / HLO-lowering / "
                     "cost-model / VMEM / donation / host-transfer / "
-                    "recompile / prescriptive-tiling / link-traffic "
-                    "checks (no execution)")
+                    "recompile / prescriptive-tiling / link-traffic / "
+                    "RDMA-schedule-certification checks (no execution)")
     parser.add_argument("fixtures", nargs="*",
                         help="fixture module paths (files defining "
                              "TARGETS) to check instead of the shipped "
@@ -105,10 +119,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         tiling = [t for t in default_targets() if t.checker == "tiling"]
         chosen = [t for t in tiling
-                  if fnmatch.fnmatchcase(t.name, args.plan_tiling)
-                  or fnmatch.fnmatchcase(
-                      t.name.replace("analysis.tiling.", "", 1),
-                      args.plan_tiling)]
+                  if _match(t.name, args.plan_tiling)
+                  or _match(t.name.replace("analysis.tiling.", "", 1),
+                            args.plan_tiling)]
         if not chosen:
             print(f"stencil-lint: no tiling targets match "
                   f"{args.plan_tiling!r} ({len(tiling)} registered "
@@ -142,16 +155,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # EVERY pattern must match something: a typo'd glob among
         # several must fail the run, not silently drop its coverage
         unmatched = [p for p in patterns
-                     if not any(fnmatch.fnmatchcase(t.name, p)
-                                for t in targets)]
+                     if not any(_match(t.name, p) for t in targets)]
         if unmatched:
             print(f"stencil-lint: no targets match {unmatched} "
                   f"(values that are not checker names filter target "
                   f"names by glob)", file=sys.stderr)
             return 2
         targets = [t for t in targets
-                   if any(fnmatch.fnmatchcase(t.name, p)
-                          for p in patterns)]
+                   if any(_match(t.name, p) for p in patterns)]
     if checkers and not any(t.checker in checkers for t in targets):
         # a checker filter + glob that intersect to nothing would be a
         # vacuously green run — the same silent coverage drop the

@@ -30,13 +30,15 @@ from .donation import DonationSpec, DonationTarget
 from .footprint import StencilOpSpec, StencilOpTarget
 from .hlo import HloSpec, HloTarget
 from .recompile import RecompileSpec, RecompileTarget
+from .schedule import ScheduleSpec, ScheduleTarget
 from .transfer import TransferSpec, TransferTarget
 from .vmem import VmemSpec, VmemTarget
 from ..observatory.linkmap import LinkmapSpec, LinkmapTarget
 
 Target = Union[StencilOpTarget, PallasKernelTarget, CollectiveTarget,
                HloTarget, CostModelTarget, VmemTarget, DonationTarget,
-               TransferTarget, RecompileTarget, LinkmapTarget]
+               TransferTarget, RecompileTarget, LinkmapTarget,
+               ScheduleTarget]
 
 
 def _f32(shape):
@@ -238,6 +240,124 @@ def _jacobi_halo_kernel_spec(side: int = 8) -> PallasKernelSpec:
         fn=fn, args=(_f32((Z, Y, X)), slabs["zlo"], slabs["zhi"],
                      slabs["ylo"], slabs["yhi"], org),
         axis_names=(), expect_remote_dma=False)
+
+
+# ---------------------------------------------------------------------------
+# schedule-certification targets: checker 12 — the same remote-DMA
+# kernels, their semaphore schedules certified sound under k-fold
+# replay (the proof megastep's certificate-gated fusion consumes)
+
+_SCHED_K = 4
+
+
+def _schedule_from_kernel(build, expect_max_in_flight=None,
+                          fused_by_megastep: bool = False
+                          ) -> ScheduleSpec:
+    """Lift a dma-checker kernel spec into a schedule spec: the same
+    traceable fn, certified under ``_SCHED_K``-fold replay."""
+    ps = build()
+    return ScheduleSpec(
+        fn=ps.fn, args=ps.args, axis_names=ps.axis_names,
+        replay=_SCHED_K, expect_remote_dma=ps.expect_remote_dma,
+        expect_max_in_flight=expect_max_in_flight,
+        fused_by_megastep=fused_by_megastep)
+
+
+def _overlap_schedule_spec() -> ScheduleSpec:
+    from ..ops.pallas_overlap import SCHEDULE_EXPECT
+
+    return _schedule_from_kernel(
+        _jacobi_overlap_spec,
+        expect_max_in_flight=SCHEDULE_EXPECT["max_in_flight"],
+        fused_by_megastep=True)
+
+
+def _mhd_overlap_schedule_spec(pair: bool) -> ScheduleSpec:
+    from ..ops.pallas_mhd_overlap import SCHEDULE_EXPECT
+
+    return _schedule_from_kernel(
+        lambda: _mhd_overlap_spec(pair=pair),
+        expect_max_in_flight=SCHEDULE_EXPECT["max_in_flight"])
+
+
+def _halo_schedule_spec() -> ScheduleSpec:
+    from ..ops.pallas_halo import SCHEDULE_EXPECT
+
+    return _schedule_from_kernel(
+        _jacobi_halo_kernel_spec,
+        expect_max_in_flight=SCHEDULE_EXPECT["max_in_flight"])
+
+
+def _overlap_segment_schedule_spec(side: int = 8) -> ScheduleSpec:
+    """The fused overlap SEGMENT pinned as a registry target:
+    ``_SCHED_K`` sequential ``jacobi7_overlap_pallas`` launches inside
+    ONE traced program — the exact multi-launch shape megastep's
+    chunk-of-1 unroll dispatches once the per-launch certificate
+    licenses fusion (models/jacobi.py:_build_overlap_step). Every
+    constituent launch must certify replay-safe; CI stage 1 asserts
+    it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3
+    from ..ops.pallas_overlap import SCHEDULE_EXPECT, \
+        jacobi7_overlap_pallas
+
+    mesh = _mesh((1, 2, 2))
+    counts = Dim3(1, 2, 2)
+    bz = 4 if side <= 8 else 8
+
+    def shard(q):
+        iz = jax.lax.axis_index("z")
+        iy = jax.lax.axis_index("y")
+        org = jnp.stack([iz * side, iy * side,
+                         jnp.int32(0)]).astype(jnp.int32)
+        for _ in range(_SCHED_K):
+            q = jacobi7_overlap_pallas(
+                q, org, (side // 4, side // 2, side // 2),
+                (5 * side // 8, side // 2, side // 2), 1, counts,
+                block_z=bz, interpret=False)
+        return q
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    return ScheduleSpec(
+        fn=sm, args=(_f32((2 * side, 2 * side, side)),),
+        axis_names=("x", "y", "z"), replay=_SCHED_K,
+        expect_remote_dma=True,
+        expect_max_in_flight=SCHEDULE_EXPECT["max_in_flight"],
+        fused_by_megastep=True)
+
+
+def _schedule_targets() -> List[Target]:
+    k = _SCHED_K
+    return [
+        ScheduleTarget(
+            f"analysis.schedule.parallel.pallas_exchange."
+            f"exchange_shard_pallas[k={k}]",
+            lambda: _schedule_from_kernel(_rdma_exchange_spec)),
+        ScheduleTarget(
+            f"analysis.schedule.ops.pallas_overlap."
+            f"jacobi7_overlap_pallas[k={k}]",
+            _overlap_schedule_spec),
+        ScheduleTarget(
+            f"analysis.schedule.ops.pallas_mhd_overlap."
+            f"mhd_substep_overlap[k={k}]",
+            lambda: _mhd_overlap_schedule_spec(pair=False)),
+        ScheduleTarget(
+            f"analysis.schedule.ops.pallas_mhd_overlap."
+            f"mhd_substep_overlap[pair,k={k}]",
+            lambda: _mhd_overlap_schedule_spec(pair=True)),
+        ScheduleTarget(
+            f"analysis.schedule.ops.pallas_halo."
+            f"jacobi7_halo_pallas[k={k}]",
+            _halo_schedule_spec),
+        ScheduleTarget(
+            f"analysis.schedule.parallel.megastep."
+            f"segment[overlap,k={k}]",
+            _overlap_segment_schedule_spec),
+    ]
 
 
 # ---------------------------------------------------------------------------
@@ -2204,6 +2324,9 @@ def default_targets() -> List[Target]:
     # prescriptive tiling: every shipped Pallas kernel gated at
     # 256^3/512^3-per-device shapes (checker 10)
     targets += _tiling_targets()
+    # replay-soundness certification of every remote-DMA kernel's
+    # semaphore schedule (checker 12)
+    targets += _schedule_targets()
     return targets
 
 

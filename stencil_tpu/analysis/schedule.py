@@ -1,0 +1,502 @@
+"""Checker 12: happens-before certification of in-kernel RDMA
+semaphore schedules under k-fold replay.
+
+The ``dma`` checker (checker 2) proves one LAUNCH of a Pallas kernel
+pairs every remote-DMA start with its waits.  That is not enough to
+fuse a kernel into a multi-step megastep segment: a fused segment
+replays the kernel body k times inside ONE compiled program, so the
+schedule must additionally be sound under concatenation — every
+launch must hand the next launch a quiescent semaphore file.  This
+checker extracts a **semaphore schedule graph** from each kernel's
+jaxpr — nodes are ``make_async_remote_copy`` starts, ``dma_wait``s,
+barrier signals/waits, and interior-compute reads, with the mesh axes
+each semaphore edge crosses — and simulates the *k-times-replayed*
+event order, proving three conditions:
+
+* **(a) no in-flight aliasing across sub-steps** — every send/recv
+  semaphore slot armed by replay ``i`` is drained before replay
+  ``i+1`` re-arms it (a slot re-armed while its previous copy flies is
+  the data race the distributed interpreter reports dynamically);
+* **(b) deadlock freedom of the cross-shard rendezvous** — under SPMD
+  symmetry every shard runs the same program, so a barrier wait for
+  ``v`` with fewer than ``v`` signals issued program-before is a
+  circular cross-shard wait (each shard blocks on signals its
+  neighbors would only send after passing the same wait: a deadlock
+  cycle), and signals left un-consumed at a sub-step boundary would
+  let replay ``i+1``'s rendezvous pass before the neighbors arrive
+  (stale-signal replay unsoundness);
+* **(c) no unwaited-inbound reads** — a buffer that is the target of
+  a remote copy is dirty until the copy's recv semaphore is waited;
+  interior compute reading a dirty buffer is the race that makes
+  replay unsound even when the semaphore file itself balances.
+
+The proof is emitted as a per-kernel
+:class:`ScheduleCertificate` ``{max_in_flight, replay_safe,
+reasons[]}`` in the JSON report's metrics, and
+``parallel/megastep.py`` CONSUMES it: a kernel whose certificate says
+``replay_safe`` is fused into multi-step in-kernel segments (the
+Jacobi RDMA-overlap path), while unsafe schedules decline with the
+certificate's own reasons — converting the segment compiler's
+name-matched policy declines into proofs.
+
+Scope mirrors the ``dma`` checker: only REMOTE copies are tracked
+(local double-buffer pipelines arm semaphores across grid steps by
+design), ``cond`` phases inline in syntactic order, loop bodies must
+leave the remote in-flight state invariant, and dynamic remote
+semaphore indices defeat static certification (flagged, never
+``replay_safe``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .dma import (_collect_events, _fmt_key, _BSIG, _BWAIT, _LOOP_BEGIN,
+                  _LOOP_END, _START, _WAIT)
+from .jaxprs import find_pallas_kernels, trace
+from .report import ERROR, WARNING, Finding
+
+#: replay depth certified by default: enough to expose cross-replay
+#: aliasing (needs 2), boundary staleness (needs 2), and pairing
+#: drift that only accumulates (caught by 3+), while staying cheap
+DEFAULT_REPLAY = 4
+
+
+@dataclasses.dataclass
+class ScheduleCertificate:
+    """The happens-before verdict for one kernel replayed ``replay``
+    times: ``replay_safe`` iff conditions (a)/(b)/(c) all hold;
+    ``reasons`` name every violated condition (empty when safe);
+    ``max_in_flight`` is the peak number of outstanding remote copies
+    (the semaphore-file pressure a fused segment sustains)."""
+
+    kernel: str
+    replay: int
+    max_in_flight: int
+    replay_safe: bool
+    reasons: List[str] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"kernel": self.kernel, "replay": self.replay,
+                "max_in_flight": self.max_in_flight,
+                "replay_safe": self.replay_safe,
+                "reasons": list(self.reasons)}
+
+
+@dataclasses.dataclass
+class ScheduleSpec:
+    """A traceable entry point whose Pallas kernels get schedule
+    certificates.  ``fn(*args)`` is traced abstractly (typically a
+    ``shard_map``-ped wrapper so ``lax.axis_index`` resolves);
+    ``replay`` is the certified fusion depth; ``expect_remote_dma``
+    guards against vacuous passes; ``expect_max_in_flight`` pins the
+    kernel's declared semaphore pressure (the op module's
+    ``SCHEDULE_EXPECT`` hint) so kernel refactors that change the
+    schedule shape fail the checker instead of silently re-certifying;
+    ``fused_by_megastep`` marks targets whose certificate the segment
+    compiler actually consumes — CI asserts those are ``replay_safe``.
+    """
+
+    fn: Callable
+    args: Sequence[Any]
+    axis_names: Tuple[str, ...] = ()
+    replay: int = DEFAULT_REPLAY
+    expect_remote_dma: bool = False
+    expect_max_in_flight: Optional[int] = None
+    fused_by_megastep: bool = False
+
+
+@dataclasses.dataclass
+class ScheduleTarget:
+    name: str
+    build: Callable[[], ScheduleSpec]
+
+    checker = "schedule"
+
+
+# ---------------------------------------------------------------------------
+# replayed-schedule simulation
+
+
+def _is_ref(v: Any) -> bool:
+    aval = getattr(v, "aval", None)
+    if aval is None:
+        return False
+    s = str(aval)
+    return ("Ref" in type(aval).__name__ or s.startswith("Ref")
+            or s.startswith("MemRef"))
+
+
+def _certify_events(kernel: str, events: List[Tuple], replay: int
+                    ) -> Tuple[ScheduleCertificate, List[str], bool]:
+    """Simulate ``events`` concatenated ``replay`` times.  Returns
+    ``(certificate, warning_reasons, saw_remote)`` — warning_reasons
+    are the subset of the certificate's reasons reported at WARNING
+    severity (static certification defeated, not a proven bug)."""
+    reasons: List[str] = []
+    warn_reasons: List[str] = []
+
+    def fail(msg: str, warn: bool = False) -> None:
+        if msg not in reasons:
+            reasons.append(msg)
+            if warn:
+                warn_reasons.append(msg)
+
+    # pass 1: which semaphore cells ever back a REMOTE transfer?
+    tracked: set = set()
+    saw_remote = False
+    for ev in events:
+        if ev[0] == _START and ev[2]:
+            saw_remote = True
+            tracked.update(ev[1])
+
+    # pass 2: the replayed happens-before simulation
+    armed: Dict[Tuple, List[int]] = {}     # sem key -> replay tags
+    barrier_sems: set = set()
+    value: Dict[int, int] = {}             # barrier sem -> pending signals
+    inbound: Dict[Tuple, List[int]] = {}   # recv key -> dirty dst ids
+    dirty: Dict[int, int] = {}             # dst id -> unwaited inbound
+    in_flight = 0
+    max_in_flight = 0
+    loop_stack: List[Dict[Tuple, Tuple[int, ...]]] = []
+
+    for r in range(replay):
+        for ev in events:
+            kind = ev[0]
+            if kind == "barrier_def":
+                barrier_sems.add(ev[1])
+            elif kind == _BSIG:
+                _k, sem, inc, _axes = ev
+                if sem in barrier_sems:
+                    value[sem] = value.get(sem, 0) + (inc or 0)
+            elif kind == _BWAIT:
+                _k, sem, v = ev
+                if sem not in barrier_sems or v is None:
+                    continue
+                have = value.get(sem, 0)
+                if have < v:
+                    fail(f"barrier wait for {v} with only {have} "
+                         f"signal(s) issued program-before — every "
+                         f"shard blocks on signals its neighbors send "
+                         f"only after the same wait: circular "
+                         f"cross-shard wait (deadlock cycle)")
+                    value[sem] = 0
+                else:
+                    value[sem] = have - v
+            elif kind == _START:
+                _k, keys, remote, _axes, dst_id, recv_key = ev
+                if not remote:
+                    continue
+                for key in keys:
+                    if any(i == "?" for i in key[1]):
+                        fail(f"remote DMA semaphore {_fmt_key(key)} "
+                             f"has a dynamic index — the schedule is "
+                             f"not statically certifiable", warn=True)
+                        continue
+                    tags = armed.setdefault(key, [])
+                    if tags:
+                        r0 = tags[0]
+                        if r0 != r:
+                            fail(f"semaphore slot {_fmt_key(key)} "
+                                 f"re-armed in replay {r} while its "
+                                 f"replay-{r0} copy is still in "
+                                 f"flight — in-flight aliasing "
+                                 f"across sub-steps")
+                        else:
+                            fail(f"semaphore slot {_fmt_key(key)} "
+                                 f"re-armed while its previous copy "
+                                 f"is still in flight — in-flight "
+                                 f"aliasing")
+                    tags.append(r)
+                in_flight += 1
+                max_in_flight = max(max_in_flight, in_flight)
+                if dst_id is not None and recv_key is not None:
+                    inbound.setdefault(recv_key, []).append(dst_id)
+                    dirty[dst_id] = dirty.get(dst_id, 0) + 1
+            elif kind == _WAIT:
+                key = ev[1]
+                if inbound.get(key):
+                    dst = inbound[key].pop(0)
+                    dirty[dst] -= 1
+                    in_flight -= 1
+                if key not in tracked or any(i == "?" for i in key[1]):
+                    continue
+                tags = armed.get(key)
+                if tags:
+                    tags.pop(0)
+                else:
+                    fail(f"wait on {_fmt_key(key)} with no copy in "
+                         f"flight — start/wait pairing cannot be "
+                         f"established under replay")
+            elif kind == "read":
+                rid = ev[1]
+                if dirty.get(rid, 0) > 0:
+                    fail(f"interior compute reads buffer ref@"
+                         f"{rid % 10000} while an inbound remote copy "
+                         f"targeting it is unwaited — the race that "
+                         f"makes replay unsound")
+            elif kind == _LOOP_BEGIN:
+                loop_stack.append({k: tuple(v) for k, v in armed.items()
+                                   if v})
+            elif kind == _LOOP_END:
+                before = loop_stack.pop() if loop_stack else {}
+                now = {k: tuple(v) for k, v in armed.items() if v}
+                if now != before:
+                    fail("remote in-flight state changes across a "
+                         "loop body — the replayed schedule cannot "
+                         "be certified (possible cross-iteration "
+                         "semaphore reuse)")
+                    armed = {k: list(v) for k, v in before.items()}
+        # sub-step boundary: replay r hands the semaphore file to r+1
+        stale = {s: v for s, v in value.items() if v}
+        for _sem, v in sorted(stale.items()):
+            fail(f"barrier semaphore holds {v} stale signal(s) at a "
+                 f"sub-step boundary — the next replay's rendezvous "
+                 f"can pass before its neighbors arrive (stale-signal "
+                 f"replay unsoundness)")
+
+    for key, tags in sorted(armed.items(), key=lambda kv: kv[0][0]):
+        if tags:
+            fail(f"remote copy on {_fmt_key(key)} started but never "
+                 f"awaited ({len(tags)} outstanding at kernel end)")
+
+    cert = ScheduleCertificate(kernel=kernel, replay=replay,
+                               max_in_flight=max_in_flight,
+                               replay_safe=not reasons, reasons=reasons)
+    return cert, warn_reasons, saw_remote
+
+
+# ---------------------------------------------------------------------------
+# event extraction: the dma checker's walk, with dst-buffer identity
+# on remote starts and compute-read events for condition (c)
+
+def _schedule_events(kjaxpr, notes: List[str]) -> List[Tuple]:
+    """Collect the dma checker's event stream, enriched: every remote
+    ``dma_start`` carries ``(dst_buffer_id, recv_sem_key)`` and every
+    non-DMA, non-control equation touching a (non-semaphore) Ref emits
+    a ``("read", ref_id)`` node — the interior-compute reads of
+    condition (c).  Vars canonicalize through the same ``_sub_env``
+    substitution as the dma walk, so identities line up across
+    ``cond`` branches / loop bodies / nested calls."""
+    from .jaxprs import ClosedJaxpr, Jaxpr, Var, is_semaphore_ref
+    from .dma import _sem_key, _sub_env, _unflatten
+
+    events: List[Tuple] = []
+
+    def walk(jaxpr, env):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "dma_start":
+                un = _unflatten(eqn, "tree", env)
+                if un is None or len(un) != 9:
+                    notes.append("unrecognized dma_start operand "
+                                 "layout; DMA not analyzed")
+                    continue
+                (_src, _st, dst, _dt, ssem, sst, rsem, rst,
+                 device_id) = un
+                remote = isinstance(device_id, dict) and bool(device_id)
+                keys = []
+                for sem, tr in ((ssem, sst), (rsem, rst)):
+                    if sem is not None and is_semaphore_ref(sem):
+                        keys.append(_sem_key(sem, tr))
+                axes = (tuple(str(k) for k in device_id.keys())
+                        if isinstance(device_id, dict) else ())
+                recv_key = (_sem_key(rsem, rst)
+                            if rsem is not None and is_semaphore_ref(rsem)
+                            else None)
+                dst_id = id(dst) if dst is not None else None
+                events.append((_START, tuple(keys), remote, axes,
+                               dst_id, recv_key))
+            elif name == "dma_wait":
+                un = _unflatten(eqn, "tree", env)
+                if un is None or len(un) != 9:
+                    notes.append("unrecognized dma_wait operand "
+                                 "layout; wait not analyzed")
+                    continue
+                # dma_wait waits on the dst_sem slot (wait_send swaps
+                # src/dst so the same slot holds the send semaphore)
+                _src, _st, _dst, _dt, _ss, _sst, rsem, rst, _dev = un
+                if rsem is not None and is_semaphore_ref(rsem):
+                    events.append((_WAIT, _sem_key(rsem, rst)))
+            elif name in ("get_barrier_semaphore", "semaphore_signal",
+                          "semaphore_wait"):
+                # barrier choreography: the dma checker's extraction,
+                # verbatim, on this one equation
+                _collect_events(_OneEqn(eqn), events, notes, env)
+            elif name == "cond":
+                for br in eqn.params.get("branches", ()):
+                    bj = br.jaxpr if isinstance(br, ClosedJaxpr) else br
+                    walk(bj, _sub_env(bj.invars, eqn.invars[1:], env))
+            elif name == "scan":
+                events.append((_LOOP_BEGIN,))
+                sub = eqn.params.get("jaxpr")
+                sj = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+                if isinstance(sj, Jaxpr):
+                    walk(sj, _sub_env(sj.invars, eqn.invars, env))
+                events.append((_LOOP_END,))
+            elif name == "while":
+                events.append((_LOOP_BEGIN,))
+                cn = eqn.params.get("cond_nconsts", 0)
+                bn = eqn.params.get("body_nconsts", 0)
+                carry = list(eqn.invars[cn + bn:])
+                for key, operands in (
+                        ("cond_jaxpr", list(eqn.invars[:cn]) + carry),
+                        ("body_jaxpr",
+                         list(eqn.invars[cn:cn + bn]) + carry)):
+                    sub = eqn.params.get(key)
+                    if sub is None:
+                        continue
+                    sj = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+                    if isinstance(sj, Jaxpr):
+                        walk(sj, _sub_env(sj.invars, operands, env))
+                events.append((_LOOP_END,))
+            else:
+                sub = eqn.params.get("jaxpr") or \
+                    eqn.params.get("call_jaxpr")
+                if sub is not None:
+                    sj = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+                    if isinstance(sj, Jaxpr):
+                        walk(sj, _sub_env(sj.invars, eqn.invars, env))
+                    continue
+                seen = set()
+                for v in eqn.invars:
+                    if not isinstance(v, Var):
+                        continue
+                    cv = env.get(v, v)
+                    if _is_ref(cv) and not is_semaphore_ref(cv):
+                        rid = id(cv)
+                        if rid not in seen:
+                            seen.add(rid)
+                            events.append(("read", rid))
+
+    walk(kjaxpr, {})
+    return events
+
+
+class _OneEqn:
+    """A single-equation pseudo-jaxpr so one equation can be pushed
+    through the dma checker's jaxpr-shaped walk."""
+
+    def __init__(self, eqn):
+        self.eqns = [eqn]
+
+
+# ---------------------------------------------------------------------------
+# checker entry points
+
+
+def certify_kernel(kname: str, kjaxpr, replay: int = DEFAULT_REPLAY
+                   ) -> Tuple[ScheduleCertificate, List[str], bool,
+                              List[str]]:
+    """Certificate for one kernel jaxpr.  Returns ``(certificate,
+    warning_reasons, saw_remote, notes)``."""
+    notes: List[str] = []
+    events = _schedule_events(kjaxpr, notes)
+    cert, warn_reasons, saw_remote = _certify_events(kname, events,
+                                                     replay)
+    return cert, warn_reasons, saw_remote, sorted(set(notes))
+
+
+def certify_traceable(fn: Callable, args: Sequence[Any],
+                      axis_names: Tuple[str, ...] = (),
+                      replay: int = DEFAULT_REPLAY
+                      ) -> ScheduleCertificate:
+    """Runtime API for the segment compiler: trace ``fn(*args)``,
+    certify every Pallas kernel inside, and merge into one
+    certificate (safe iff every kernel is safe).  Raises nothing —
+    an untraceable program returns an unsafe certificate whose
+    reasons say why, so callers decline instead of crashing."""
+    del axis_names  # identity comes from the traced device_id dicts
+    try:
+        closed = trace(fn, *args)
+    except Exception as e:  # noqa: BLE001
+        return ScheduleCertificate(
+            kernel="<untraceable>", replay=replay, max_in_flight=0,
+            replay_safe=False,
+            reasons=[f"schedule trace failed: {type(e).__name__}: {e}"])
+    kernels = find_pallas_kernels(closed.jaxpr)
+    if not kernels:
+        return ScheduleCertificate(
+            kernel="<none>", replay=replay, max_in_flight=0,
+            replay_safe=False,
+            reasons=["no pallas_call traced — nothing to certify"])
+    certs = []
+    for kname, kjaxpr in kernels:
+        cert, _w, _remote, _notes = certify_kernel(kname, kjaxpr, replay)
+        certs.append(cert)
+    return ScheduleCertificate(
+        kernel=",".join(c.kernel for c in certs), replay=replay,
+        max_in_flight=max(c.max_in_flight for c in certs),
+        replay_safe=all(c.replay_safe for c in certs),
+        reasons=[f"{c.kernel}: {r}" for c in certs for r in c.reasons])
+
+
+def check_schedule(target: ScheduleTarget
+                   ) -> Tuple[List[Finding], dict]:
+    """Certify every kernel the target traces to; findings are the
+    violated replay-soundness conditions, metrics are the
+    certificates (archived to the JSON report for megastep/CI)."""
+    try:
+        spec = target.build()
+    except Exception as e:  # noqa: BLE001
+        return ([Finding("schedule", target.name,
+                         f"target build failed: {type(e).__name__}: "
+                         f"{e}")], {})
+    try:
+        closed = trace(spec.fn, *spec.args)
+    except Exception as e:  # noqa: BLE001
+        return ([Finding("schedule", target.name,
+                         f"trace failed: {type(e).__name__}: {e}")], {})
+    kernels = find_pallas_kernels(closed.jaxpr)
+    if not kernels:
+        return ([Finding("schedule", target.name,
+                         "no pallas_call found in the traced program",
+                         WARNING)], {})
+    findings: List[Finding] = []
+    kernel_metrics: Dict[str, dict] = {}
+    any_remote = False
+    all_safe = True
+    peak = 0
+    seen_names: Dict[str, int] = {}
+    for kname, kjaxpr in kernels:
+        # a fused segment traces the SAME kernel once per launch —
+        # number the repeats so each launch keeps its certificate
+        n = seen_names.get(kname, 0)
+        seen_names[kname] = n + 1
+        if n:
+            kname = f"{kname}#{n}"
+        cert, warn_reasons, saw_remote, notes = certify_kernel(
+            kname, kjaxpr, int(spec.replay))
+        for n in notes:
+            findings.append(Finding("schedule",
+                                    f"{target.name}:{kname}", n,
+                                    WARNING))
+        for reason in cert.reasons:
+            sev = WARNING if reason in warn_reasons else ERROR
+            findings.append(Finding("schedule",
+                                    f"{target.name}:{kname}", reason,
+                                    sev))
+        kernel_metrics[kname] = cert.to_dict()
+        any_remote = any_remote or saw_remote
+        all_safe = all_safe and cert.replay_safe
+        peak = max(peak, cert.max_in_flight)
+    if spec.expect_remote_dma and not any_remote:
+        findings.append(Finding(
+            "schedule", target.name,
+            "expected remote DMA but none traced — the certificate "
+            "would be vacuous here (did the kernel's transport "
+            "change?)", WARNING))
+    if spec.expect_max_in_flight is not None and \
+            peak != int(spec.expect_max_in_flight):
+        findings.append(Finding(
+            "schedule", target.name,
+            f"schedule hint drift: traced max_in_flight {peak} != "
+            f"declared {int(spec.expect_max_in_flight)} (the op "
+            f"module's SCHEDULE_EXPECT hint) — re-review the kernel's "
+            f"semaphore schedule and update the hint"))
+    metrics = {"replay": int(spec.replay), "replay_safe": all_safe,
+               "max_in_flight": peak,
+               "fused_by_megastep": bool(spec.fused_by_megastep),
+               "kernels": kernel_metrics}
+    return findings, metrics

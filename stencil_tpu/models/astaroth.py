@@ -582,9 +582,11 @@ class Astaroth:
                 stride=stride),
             lambda fw, c, i: advance_iters(fw, c), state_fn, adopt)
 
-    def _set_segment_decline(self, reason: str) -> None:
+    def _set_segment_decline(self, reason: str,
+                             code: Optional[str] = None) -> None:
         self._segment_builder = None
         self._segment_decline = reason
+        self._segment_decline_code = code
 
     def make_segment(self, check_every: int, probe_every: int = 1,
                      metrics=None):
@@ -601,10 +603,13 @@ class Astaroth:
         dispatch there."""
         builder = getattr(self, "_segment_builder", None)
         if builder is None:
-            from ..parallel.megastep import decline
+            from ..parallel import megastep as ms
             reason = (getattr(self, "_segment_decline", None)
                       or "no fused-segment builder for this path")
-            return decline("astaroth", self.kernel_path, reason)
+            code = (getattr(self, "_segment_decline_code", None)
+                    or ms.DECLINE_NO_BUILDER)
+            return ms.decline("astaroth", self.kernel_path, reason,
+                              code=code)
         return builder(int(check_every), max(int(probe_every), 1),
                        metrics)
 
@@ -1057,9 +1062,11 @@ class Astaroth:
         # magnitude slower — see _build_wrap_step); a megastep over
         # dd.curr would advance stale state, so the path declines
         # loudly and the driver runs its already-fused loop stepwise
+        from ..parallel.megastep import DECLINE_INTERIOR_RESIDENT_STATE
         self._set_segment_decline(
             "interior-resident extract/loop/insert split keeps state "
-            "outside dd.curr (one fused program measured ~10x slower)")
+            "outside dd.curr (one fused program measured ~10x slower)",
+            code=DECLINE_INTERIOR_RESIDENT_STATE)
 
     def exchange_stats(self) -> dict:
         """Per-iteration exchange accounting for the BUILT compute path

@@ -171,6 +171,53 @@ def _wrap_steps(tile: int, requested: int = 0) -> int:
     return min(max(n, 1), tile)
 
 
+#: memoized overlap-kernel schedule certificates, keyed by the traced
+#: geometry AND the certifier's identity (so a monkeypatched certifier
+#: in tests is never shadowed by a cached verdict)
+_OVERLAP_CERT_MEMO: dict = {}
+
+
+def _overlap_schedule_certificate(dd, dtype, hot, cold, sph_r,
+                                  counts: Dim3):
+    """Ask the schedule certifier (analysis/schedule.py) whether the
+    in-kernel RDMA overlap kernel's semaphore schedule is sound under
+    k-fold replay on ``dd``'s mesh: trace the same per-shard program
+    ``_build_overlap_step`` runs (a synthetic even global of
+    base-shard interiors — the schedule's shape does not depend on the
+    ±1 remainder rows) and certify every Pallas kernel inside.  Any
+    trace failure comes back as an unsafe certificate, so callers
+    decline instead of crashing."""
+    from ..analysis import schedule as schedule_checker
+    from ..ops.pallas_overlap import jacobi7_overlap_pallas
+    from ..parallel.exchange import shard_origin
+
+    local = dd.local_size
+    rem = dd.rem
+    key = ((counts.z, counts.y, counts.x),
+           (local.z, local.y, local.x), (rem.z, rem.y, rem.x),
+           str(jnp.dtype(dtype)),
+           id(schedule_checker.certify_traceable))
+    hit = _OVERLAP_CERT_MEMO.get(key)
+    if hit is not None:
+        return hit
+
+    def shard(q):
+        ox, oy, oz = shard_origin(local, rem)
+        org = jnp.stack([oz, oy, ox]).astype(jnp.int32)
+        return jacobi7_overlap_pallas(q, org, hot, cold, sph_r, counts,
+                                      interpret=False)
+
+    spec = P("z", "y", "x")
+    sm = jax.shard_map(shard, mesh=dd.mesh, in_specs=spec,
+                       out_specs=spec, check_vma=False)
+    gshape = (local.z * counts.z, local.y * counts.y,
+              local.x * counts.x)
+    cert = schedule_checker.certify_traceable(
+        sm, (jax.ShapeDtypeStruct(gshape, dtype),))
+    _OVERLAP_CERT_MEMO[key] = cert
+    return cert
+
+
 def _dcn_xfree_shape(size: Dim3, devices, dcn_axis, dcn_groups, kernel,
                      align: int = 1):
     """Slice-compatible x-unsharded mesh shape when a DCN tier is
@@ -313,13 +360,16 @@ class Jacobi3D:
             lambda p, c, i: shard_advance(p, c),
             lambda: self.dd.curr["temp"], adopt)
 
-    def _set_segment_decline(self, reason: str) -> None:
-        """The built path cannot fuse: record why, so
+    def _set_segment_decline(self, reason: str,
+                             code: Optional[str] = None) -> None:
+        """The built path cannot fuse: record why (prose + a
+        ``megastep.DECLINE_*`` vocabulary code), so
         :meth:`make_segment` returns a loud, reason-carrying
         :class:`~stencil_tpu.parallel.megastep.SegmentDecline` instead
         of a silent None."""
         self._segment_builder = None
         self._segment_decline = reason
+        self._segment_decline_code = code
 
     def make_segment(self, check_every: int, probe_every: int = 1,
                      metrics=None):
@@ -330,18 +380,23 @@ class Jacobi3D:
         instead of one jitted step per iteration. Field state is
         donated end-to-end. Every built compute path fuses — the XLA
         and temporal paths unroll their shard bodies, the wrap/halo
-        Pallas paths chunk into their in-kernel multi-step launches —
-        except the in-kernel RDMA overlap path, which returns a falsy
-        reason-carrying ``SegmentDecline`` (its kernel owns device-side
-        send/recv semaphore state that must not be replayed inside one
-        unrolled program); the driver reports it and falls back to the
-        stepwise dispatch loop."""
+        Pallas paths chunk into their in-kernel multi-step launches,
+        and the in-kernel RDMA overlap path fuses its kernel launches
+        when the schedule certifier (``analysis/schedule.py``) proves
+        the semaphore schedule ``replay_safe``. A path that cannot
+        fuse returns a falsy ``SegmentDecline`` carrying the reason
+        (for the overlap path: the certificate's own reasons) and a
+        ``DECLINE_*`` vocabulary code; the driver reports it and falls
+        back to the stepwise dispatch loop."""
         builder = getattr(self, "_segment_builder", None)
         if builder is None:
-            from ..parallel.megastep import decline
+            from ..parallel import megastep as ms
             reason = (getattr(self, "_segment_decline", None)
                       or "no fused-segment builder for this path")
-            return decline("jacobi", self.kernel_path, reason)
+            code = (getattr(self, "_segment_decline_code", None)
+                    or ms.DECLINE_NO_BUILDER)
+            return ms.decline("jacobi", self.kernel_path, reason,
+                              code=code)
         return builder(int(check_every), max(int(probe_every), 1),
                        metrics)
 
@@ -611,7 +666,9 @@ class Jacobi3D:
     def _build_interior_resident_steps(self, make_body,
                                        segment_decline: Optional[str]
                                        = None,
-                                       segment_stride: int = 1) -> None:
+                                       segment_stride: int = 1,
+                                       segment_decline_code:
+                                       Optional[str] = None) -> None:
         """Shared scaffolding for the interior-resident multi-device
         builders: slice the unpadded interior out of the padded shard,
         fori_loop the per-iteration body from ``make_body(org)``, write
@@ -656,7 +713,8 @@ class Jacobi3D:
             lambda p: sm(p, jnp.asarray(1, jnp.int32)), donate_argnums=0)
 
         if segment_decline is not None:
-            self._set_segment_decline(segment_decline)
+            self._set_segment_decline(segment_decline,
+                                      code=segment_decline_code)
             return
 
         def shard_advance(p, c):
@@ -786,6 +844,7 @@ class Jacobi3D:
         reference's polled-transport overlap, src/stencil.cu:1081-1118,
         as a single kernel; see ops/pallas_overlap.py)."""
         from ..ops.pallas_overlap import jacobi7_overlap_pallas
+        from ..parallel import megastep as ms
 
         counts = mesh_dim(self.dd.mesh)
         hot, cold, sph_r = sphere_geometry(self.dd.size)
@@ -800,16 +859,25 @@ class Jacobi3D:
         # radius-1 slab exchange (ops/pallas_overlap.py phase 2)
         self._slab_exchange_cfg = dict(rz=1, ry=1, radius_rows=1,
                                        y_z_extended=False, per_iter_div=1)
-        # the ONE named fused-segment decline: the overlap kernel owns
-        # device-side RDMA send/recv semaphore state per launch;
-        # unrolling k launches into one program would interleave those
-        # barriers across iterations — it keeps its own fused loop and
-        # the driver runs it stepwise, reported loudly
-        self._build_interior_resident_steps(
-            make_body,
-            segment_decline="in-kernel RDMA overlap: the kernel owns "
-                            "per-launch send/recv semaphore state the "
-                            "segment unroll must not replay")
+        # the formerly name-matched fused-segment decline is now
+        # certificate-gated: the schedule certifier
+        # (analysis/schedule.py) replays the kernel's semaphore
+        # schedule k times and proves every launch hands the next a
+        # quiescent semaphore file (drained send/recv slots, balanced
+        # barrier, no unwaited-inbound reads). A replay_safe
+        # certificate licenses chunk-of-1 fusion — k kernel launches
+        # inside ONE compiled segment; anything else declines citing
+        # the certificate's own reasons
+        cert = _overlap_schedule_certificate(
+            self.dd, self._dtype, hot, cold, sph_r, counts)
+        self._schedule_certificate = cert
+        gate = ms.certificate_gate(cert)
+        if gate is None:
+            self._build_interior_resident_steps(make_body)
+        else:
+            self._build_interior_resident_steps(
+                make_body, segment_decline=gate,
+                segment_decline_code=ms.DECLINE_UNCERTIFIED_SCHEDULE)
 
     def exchange_stats(self) -> dict:
         """Per-iteration exchange accounting for the BUILT compute

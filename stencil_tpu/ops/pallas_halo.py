@@ -48,6 +48,13 @@ from .pallas_stencil import default_interpret, sublane_tile_bytes
 ESUB = 8  # f32 sublane tile; slab row granularity
 R = 3     # MHD stencil radius (6th order)
 
+#: schedule-certifier hint (analysis/schedule.py): these kernels issue
+#: NO DMA at all — the slab exchange runs outside the kernel — so the
+#: peak outstanding remote-copy count is zero by construction; the
+#: registry pins it so a kernel that silently GAINS a semaphore or
+#: remote copy fails the schedule checker instead of re-certifying
+SCHEDULE_EXPECT = {"max_in_flight": 0}
+
 
 def _shrink_block(dim: int, block: int, mult: int = 1) -> int:
     """Largest power-of-two-ish block <= ``block`` that divides ``dim``

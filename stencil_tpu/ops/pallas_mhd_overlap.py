@@ -50,6 +50,15 @@ from .pallas_stencil import default_interpret, on_tpu
 # pallas_exchange
 _MHD_OVERLAP_COLLECTIVE_ID = 23
 
+#: schedule-certifier hint (analysis/schedule.py): peak outstanding
+#: remote copies across the phased z/y slab + corner exchange on the
+#: registry's (1,2,2) certification mesh — all eight fields' z-lo/z-hi
+#: + y-lo/y-hi slabs plus both yz corner legs fly together before the
+#: phase-B waits (8 fields x 6 copies). Pinned so a phase reordering
+#: that piles more copies in flight (or stops draining a phase) fails
+#: the schedule checker instead of re-certifying
+SCHEDULE_EXPECT = {"max_in_flight": 48}
+
 
 def _interpret_mode():
     return False if on_tpu() else pltpu.InterpretParams()

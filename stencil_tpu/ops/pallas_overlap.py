@@ -40,6 +40,13 @@ from .pallas_stencil import on_tpu
 # collective_id namespace distinct from parallel/pallas_exchange.py
 _OVERLAP_COLLECTIVE_ID = 21
 
+#: schedule-certifier hint (analysis/schedule.py): the kernel arms at
+#: most the four face-slab remote copies (z-lo/z-hi/y-lo/y-hi) before
+#: the interior compute and drains all four before the face passes —
+#: the registry pins the peak so a schedule refactor that raises the
+#: in-flight pressure (or stops draining) fails the checker
+SCHEDULE_EXPECT = {"max_in_flight": 4}
+
 
 def _interpret_mode():
     return False if on_tpu() else pltpu.InterpretParams()

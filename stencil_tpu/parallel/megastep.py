@@ -113,42 +113,98 @@ class CarryContract:
     donate: bool = True
 
 
+# -- decline-reason vocabulary ----------------------------------------
+# Every SegmentDecline carries one of these machine-readable codes
+# alongside its prose reason, so ``fused:false`` report entries and
+# the flight-recorder ``fused_decline`` timeline events are greppable
+# by CAUSE instead of by free-form string (test_megastep pins the set).
+
+#: the built path registered no fused-segment builder at all
+DECLINE_NO_BUILDER = "no-fused-builder"
+#: an RDMA kernel whose schedule certificate is missing or says
+#: ``replay_safe=false`` (analysis/schedule.py) — proof, not policy
+DECLINE_UNCERTIFIED_SCHEDULE = "uncertified-rdma-schedule"
+#: the path keeps live state outside the segment carry (Astaroth's
+#: extract/loop/insert program split)
+DECLINE_INTERIOR_RESIDENT_STATE = "interior-resident-state"
+#: the driver was constructed with fuse_segments disabled
+DECLINE_POLICY_DISABLED = "policy-disabled"
+#: the engine handed the driver no make_segment factory
+DECLINE_NO_FACTORY = "no-segment-factory"
+#: rebuild() after degradation returned no segment factory
+DECLINE_REBUILD_NO_FACTORY = "rebuild-no-segment-factory"
+
+DECLINE_REASONS = frozenset({
+    DECLINE_NO_BUILDER, DECLINE_UNCERTIFIED_SCHEDULE,
+    DECLINE_INTERIOR_RESIDENT_STATE, DECLINE_POLICY_DISABLED,
+    DECLINE_NO_FACTORY, DECLINE_REBUILD_NO_FACTORY,
+})
+
+
 class SegmentDecline:
     """A falsy ``make_segment`` result that says WHY no fused segment
     exists for the built path — silent ``None`` returns made stepwise
-    fallbacks invisible to operators. The driver logs it, records
-    ``fused: false`` + the reason in the :class:`~stencil_tpu.
-    resilience.driver.ResilienceReport`, and exports the
-    ``stencil_run_fused_dispatch_total{fused}`` counter."""
+    fallbacks invisible to operators. ``code`` is one of the
+    ``DECLINE_*`` vocabulary constants; ``reason`` is the prose. The
+    driver logs it, records ``fused: false`` + the reason/code in the
+    :class:`~stencil_tpu.resilience.driver.ResilienceReport`, and
+    exports the ``stencil_run_fused_dispatch_total{fused}`` counter."""
 
-    def __init__(self, model: str, path: str, reason: str) -> None:
+    def __init__(self, model: str, path: str, reason: str,
+                 code: str = DECLINE_NO_BUILDER) -> None:
         self.model = str(model)
         self.path = str(path)
         self.reason = str(reason)
+        self.code = str(code)
 
     def __bool__(self) -> bool:
         return False
 
     def __repr__(self) -> str:
-        return (f"SegmentDecline({self.model}[{self.path}]: "
-                f"{self.reason})")
+        return (f"SegmentDecline({self.model}[{self.path}] "
+                f"[{self.code}]: {self.reason})")
 
 
 _DECLINES_WARNED: set = set()
 
 
-def decline(model: str, path: str, reason: str) -> SegmentDecline:
+def decline(model: str, path: str, reason: str,
+            code: str = DECLINE_NO_BUILDER) -> SegmentDecline:
     """Record a fused-segment decline LOUDLY: warn once per
     (model, path, reason) and return the falsy, reason-carrying
-    :class:`SegmentDecline` for the caller to hand back."""
+    :class:`SegmentDecline` for the caller to hand back. ``code``
+    must come from the ``DECLINE_REASONS`` vocabulary."""
     from ..utils.logging import LOG_WARN
 
+    if code not in DECLINE_REASONS:
+        raise ValueError(
+            f"unknown decline code {code!r}; the vocabulary is "
+            f"{sorted(DECLINE_REASONS)} (parallel/megastep.py)")
     key = (model, path, reason)
     if key not in _DECLINES_WARNED:
         _DECLINES_WARNED.add(key)
-        LOG_WARN(f"{model}[{path}] declines megastep fusion: {reason} "
-                 f"— campaigns on this path run stepwise")
-    return SegmentDecline(model, path, reason)
+        LOG_WARN(f"{model}[{path}] declines megastep fusion "
+                 f"[{code}]: {reason} — campaigns on this path run "
+                 f"stepwise")
+    return SegmentDecline(model, path, reason, code)
+
+
+def certificate_gate(certificate) -> Optional[str]:
+    """The megastep side of schedule certification
+    (analysis/schedule.py): ``None`` when ``certificate`` licenses
+    fusing the kernel's launches into one program (``replay_safe``),
+    else the certificate-citing decline reason the path must carry
+    (with code :data:`DECLINE_UNCERTIFIED_SCHEDULE`)."""
+    if certificate is not None and getattr(certificate, "replay_safe",
+                                           False):
+        return None
+    if certificate is None:
+        return ("uncertified RDMA schedule: no schedule certificate "
+                "for this kernel")
+    cited = "; ".join(getattr(certificate, "reasons", ()) or ()) \
+        or "certifier returned no reasons"
+    return (f"uncertified RDMA schedule (replay_safe=false over "
+            f"replay={getattr(certificate, 'replay', '?')}): {cited}")
 
 
 def segment_chunks(k: int, stride: int = 1) -> List[int]:

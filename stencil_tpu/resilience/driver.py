@@ -161,6 +161,9 @@ class ResilienceReport:
     #: then says WHY (silent stepwise fallbacks used to be invisible)
     fused: bool = False
     fused_decline_reason: str = ""
+    #: the machine-readable ``megastep.DECLINE_*`` vocabulary code
+    #: behind ``fused_decline_reason`` (greppable cause taxonomy)
+    fused_decline_code: str = ""
     events: List[Dict] = dataclasses.field(default_factory=list)
 
     def __post_init__(self) -> None:
@@ -309,10 +312,16 @@ class _ResilientRun:
         # reason at the first dispatch attempt)
         self.report.fused = self._fused
         if not self._fused:
-            self._note_fused_decline(
-                "fuse_segments disabled by policy"
-                if make_segment is not None else
-                "engine provides no fused-segment factory")
+            from ..parallel.megastep import (DECLINE_NO_FACTORY,
+                                             DECLINE_POLICY_DISABLED)
+            if make_segment is not None:
+                self._note_fused_decline(
+                    "fuse_segments disabled by policy",
+                    code=DECLINE_POLICY_DISABLED)
+            else:
+                self._note_fused_decline(
+                    "engine provides no fused-segment factory",
+                    code=DECLINE_NO_FACTORY)
         self._model_step_seconds = model_step_seconds
         self._model_bytes_per_step = model_bytes_per_step
         self.attributor = (self._make_attributor()
@@ -666,9 +675,12 @@ class _ResilientRun:
                         self._fused = False
                         # the fallback is a reported fact, not a
                         # silence: fused: false + reason + event
+                        from ..parallel.megastep import \
+                            DECLINE_REBUILD_NO_FACTORY
                         self._note_fused_decline(
                             "rebuild() returned no segment factory "
-                            "after degradation")
+                            "after degradation",
+                            code=DECLINE_REBUILD_NO_FACTORY)
             except (NotImplementedError, ValueError) as e:
                 self.report.log("degrade_rung_infeasible",
                                 config=cfg.key(),
@@ -714,16 +726,21 @@ class _ResilientRun:
 
     # -- megastep segmentation ------------------------------------------
     def _note_fused_decline(self, reason: str, model: str = "",
-                            path: str = "") -> None:
+                            path: str = "", code: str = "") -> None:
         """Make a stepwise fallback VISIBLE: the report says
-        ``fused: false`` with the reason, the event log carries a
+        ``fused: false`` with the reason AND its vocabulary code
+        (``megastep.DECLINE_*``), the event log carries a
         ``fused_decline`` record, and the fleet counter's
         ``fused=false`` series accumulates the stepwise dispatches."""
+        from ..parallel.megastep import DECLINE_NO_FACTORY
+
         self.report.fused = False
         self.report.fused_decline_reason = reason
+        self.report.fused_decline_code = code or DECLINE_NO_FACTORY
         self.report.log("fused_decline",
                         model=model or self._perf_entry,
-                        path=path, reason=reason)
+                        path=path, reason=reason,
+                        code=self.report.fused_decline_code)
 
     def _next_seg_len(self) -> int:
         """Steps until the next host boundary: campaign end, the
@@ -760,7 +777,8 @@ class _ResilientRun:
                              "this configuration")
             self._note_fused_decline(
                 reason, model=getattr(seg, "model", ""),
-                path=getattr(seg, "path", ""))
+                path=getattr(seg, "path", ""),
+                code=getattr(seg, "code", ""))
             LOG_WARN(f"no fused-segment support for this configuration "
                      f"({reason}); continuing with the stepwise "
                      f"dispatch loop")

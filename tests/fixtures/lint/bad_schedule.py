@@ -1,0 +1,104 @@
+"""Negative controls for the SCHEDULE-CERTIFICATION checker.
+
+Each target is a Pallas kernel whose semaphore schedule is unsound
+under k-fold replay — exactly the programs megastep fusion must never
+be licensed for. ``python -m stencil_tpu.analysis
+tests/fixtures/lint/bad_schedule.py`` MUST exit nonzero, naming the
+violated condition (in-flight aliasing vs deadlock cycle).
+
+These kernels are TRACED, never executed, so they lint identically on
+images without the distributed interpreter.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from stencil_tpu.analysis import ScheduleSpec, ScheduleTarget
+from stencil_tpu.parallel.mesh import make_mesh
+
+from jax.sharding import PartitionSpec as P
+
+
+def _mesh2():
+    return make_mesh((1, 1, 2), jax.devices()[:2])
+
+
+def _spec(kern, n_sems: int = 2) -> ScheduleSpec:
+    def shard(p):
+        return pl.pallas_call(
+            kern,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            out_shape=jax.ShapeDtypeStruct(p.shape, p.dtype),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((n_sems,)),
+                            pltpu.SemaphoreType.DMA((n_sems,))],
+            compiler_params=pltpu.CompilerParams(
+                collective_id=13, has_side_effects=True),
+            interpret=False,
+        )(p)
+
+    mesh = _mesh2()
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    return ScheduleSpec(
+        fn=sm, args=(jax.ShapeDtypeStruct((8, 8, 8), jnp.float32),),
+        axis_names=("x", "y", "z"), expect_remote_dma=True)
+
+
+def _other(n=2):
+    me = lax.axis_index("z")
+    return {"z": lax.rem(me + 1, jnp.int32(n))}
+
+
+def _slot_reuse_under_replay() -> ScheduleSpec:
+    """One launch looks almost disciplined (the recv side is waited),
+    but the SEND semaphore is still in flight at kernel end — replay
+    i+1 re-arms the same slot while replay i's copy flies: the
+    in-flight aliasing a fused multi-launch segment would hit."""
+
+    def kern(in_ref, out_ref, send, recv):
+        bsem = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bsem, inc=1, device_id=_other())
+        pltpu.semaphore_wait(bsem, 1)
+        rc = pltpu.make_async_remote_copy(
+            src_ref=in_ref.at[0:1], dst_ref=out_ref.at[0:1],
+            send_sem=send.at[0], recv_sem=recv.at[0],
+            device_id=_other())
+        rc.start()
+        rc.wait_recv()
+        # BUG: no wait_send — the send slot is armed across the
+        # sub-step boundary
+
+    return _spec(kern)
+
+
+def _wait_cycle_deadlock() -> ScheduleSpec:
+    """Two-shard rendezvous wait-cycle: every shard WAITS for its
+    neighbor's signal BEFORE signaling — under SPMD symmetry both
+    block forever (the circular cross-shard wait the certifier must
+    refuse to license)."""
+
+    def kern(in_ref, out_ref, send, recv):
+        bsem = pltpu.get_barrier_semaphore()
+        # BUG: wait precedes the only signal that could satisfy it
+        pltpu.semaphore_wait(bsem, 1)
+        pltpu.semaphore_signal(bsem, inc=1, device_id=_other())
+        rc = pltpu.make_async_remote_copy(
+            src_ref=in_ref.at[0:1], dst_ref=out_ref.at[0:1],
+            send_sem=send.at[0], recv_sem=recv.at[0],
+            device_id=_other())
+        rc.start()
+        rc.wait()
+
+    return _spec(kern)
+
+
+TARGETS = [
+    ScheduleTarget("fixture.schedule_slot_reuse_under_replay",
+                   _slot_reuse_under_replay),
+    ScheduleTarget("fixture.schedule_wait_cycle_deadlock",
+                   _wait_cycle_deadlock),
+]

@@ -47,10 +47,11 @@ def test_shipped_registry_is_clean(full_report):
     assert floor >= 105  # the PR 9 acceptance criterion itself
     assert len(report.targets_checked) >= floor
     assert report.ok
-    # all eleven checkers actually ran (and were timed)
+    # all twelve checkers actually ran (and were timed)
     assert set(report.checker_seconds) == {
         "footprint", "dma", "collectives", "hlo", "costmodel", "vmem",
-        "donation", "transfer", "recompile", "tiling", "linkmap"}
+        "donation", "transfer", "recompile", "tiling", "linkmap",
+        "schedule"}
 
 
 def test_checker_filter():
@@ -179,6 +180,44 @@ def test_dma_fixture_flagged():
                for m in by_target["fixture.semaphore_reused_in_flight"])
     assert any("barrier wait value 2 != 1" in m
                for m in by_target["fixture.barrier_signal_wait_mismatch"])
+
+
+def test_schedule_fixture_flagged():
+    """The two replay-soundness negative controls, each named by its
+    violated condition: in-flight aliasing across sub-steps vs the
+    cross-shard wait-cycle deadlock."""
+    report = run_targets(load_targets(FIXTURES / "bad_schedule.py"))
+    assert not report.ok
+    by_target = {}
+    for f in report.errors:
+        by_target.setdefault(f.target.split(":")[0], []).append(f.message)
+    assert any("in-flight aliasing across sub-steps" in m
+               for m in by_target["fixture.schedule_slot_reuse_under_replay"])
+    assert any("deadlock cycle" in m
+               for m in by_target["fixture.schedule_wait_cycle_deadlock"])
+    # the certificates say WHY in the metrics artifact too
+    slot = report.metrics[
+        "schedule:fixture.schedule_slot_reuse_under_replay"]
+    assert slot["replay_safe"] is False
+    assert any(not k["replay_safe"] for k in slot["kernels"].values())
+
+
+def test_schedule_registry_certifies_fused_kernels(full_report):
+    """The proof megastep consumes: every schedule target the segment
+    compiler fuses through (``fused_by_megastep``) holds a
+    ``replay_safe`` certificate with the pinned in-flight peak — and
+    at least one production RDMA kernel earns it."""
+    fused = {name: m for name, m in full_report.metrics.items()
+             if name.startswith("schedule:") and m.get("fused_by_megastep")}
+    assert any("jacobi7_overlap_pallas" in name for name in fused), \
+        list(full_report.metrics)
+    for name, m in fused.items():
+        assert m["replay_safe"] is True, (name, m)
+    overlap = full_report.metrics[
+        "schedule:analysis.schedule.ops.pallas_overlap."
+        "jacobi7_overlap_pallas[k=4]"]
+    assert overlap["max_in_flight"] == 4
+    assert overlap["replay"] == 4
 
 
 def test_collectives_fixture_flagged():
@@ -546,6 +585,19 @@ def test_cli_only_accepts_target_globs(tmp_path):
     assert rc == 1
     assert json.loads(report.read_text())["counts"]["targets"] == 3
 
+    # literal brackets in target names: fnmatch treats [..] as a
+    # character class, so '--only' escapes them — the bracketed
+    # schedule fixtureless registry names match as spelled. The
+    # fixture's targets carry no brackets, so exercise the escape
+    # against the shipped registry spelling instead
+    report2 = tmp_path / "r2.json"
+    rc = main(["-q", "--only", "analysis.schedule.*[k=4]",
+               "--json", str(report2)])
+    assert rc == 0
+    data2 = json.loads(report2.read_text())
+    assert data2["counts"]["targets"] >= 4
+    assert all("k=4]" in t for t in data2["targets_checked"])
+
     # a glob matching nothing is a usage error — even when OTHER
     # patterns matched (a typo'd glob must not silently drop its
     # coverage from a green run)
@@ -571,7 +623,8 @@ def test_cli_only_accepts_target_globs(tmp_path):
                                      "bad_attribution.py",
                                      "bad_tiling.py",
                                      "bad_linkmap.py",
-                                     "bad_segment_carry.py"])
+                                     "bad_segment_carry.py",
+                                     "bad_schedule.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from stencil_tpu._compat import remote_dma_runnable
 from stencil_tpu.models.jacobi import Jacobi3D
 from stencil_tpu.parallel.megastep import (MAX_UNROLL, probe_rel_steps,
                                            segment_chunks)
@@ -144,24 +145,92 @@ def test_halo_path_segment_bitwise():
     np.testing.assert_array_equal(a.temperature(), b.temperature())
 
 
-def test_overlap_path_declines_loudly():
-    """The ONE remaining decline: the in-kernel RDMA overlap path
-    returns a falsy SegmentDecline carrying model/path/reason — never
-    a silent None."""
+def _make_overlap_jacobi():
     import jax
-
-    from stencil_tpu.parallel.megastep import SegmentDecline
 
     j = Jacobi3D(16, 16, 16, mesh_shape=(1, 2, 2),
                  devices=jax.devices()[:4], dtype=np.float32,
                  kernel="halo", overlap=True)
     j.init()
     assert j.kernel_path == "overlap"
+    return j
+
+
+def test_overlap_path_fuses_under_certificate():
+    """The in-kernel RDMA overlap path FUSES: the schedule certifier
+    (analysis/schedule.py) proves the kernel's semaphore schedule
+    replay-safe — four face slabs, every slot drained per launch —
+    and make_segment consumes the certificate into a real Segment.
+    Traced only here; execution is covered by the capability-gated
+    bitwise test below."""
+    j = _make_overlap_jacobi()
+    seg = j.make_segment(4)
+    assert seg and seg.steps == 4
+    cert = j._schedule_certificate
+    assert cert is not None and cert.replay_safe is True
+    assert cert.max_in_flight == 4 and not cert.reasons
+
+
+def test_overlap_path_declines_on_unsafe_certificate(monkeypatch):
+    """replay_safe=False gates fusion OFF: make_segment returns a
+    falsy SegmentDecline quoting the certificate's reasons[] under
+    the uncertified-rdma-schedule code — never a silent None. (The
+    certificate memo keys on the certifier's identity, so the
+    monkeypatched verdict is never shadowed by a cached one.)"""
+    from stencil_tpu.analysis import schedule as schedule_checker
+    from stencil_tpu.parallel.megastep import (
+        DECLINE_UNCERTIFIED_SCHEDULE, SegmentDecline)
+
+    def unsafe(fn, args, axis_names=(), replay=4):
+        return schedule_checker.ScheduleCertificate(
+            kernel="jacobi7_overlap", replay=replay, max_in_flight=9,
+            replay_safe=False,
+            reasons=["in-flight aliasing across sub-steps"])
+
+    monkeypatch.setattr(schedule_checker, "certify_traceable", unsafe)
+    j = _make_overlap_jacobi()
     d = j.make_segment(4)
-    assert not d
-    assert isinstance(d, SegmentDecline)
+    assert not d and isinstance(d, SegmentDecline)
     assert d.model == "jacobi" and d.path == "overlap"
-    assert "RDMA" in d.reason
+    assert d.code == DECLINE_UNCERTIFIED_SCHEDULE
+    assert "uncertified RDMA schedule" in d.reason
+    assert "in-flight aliasing across sub-steps" in d.reason
+
+
+@pytest.mark.skipif(
+    not remote_dma_runnable(),
+    reason="Pallas remote DMA needs a TPU backend or the distributed "
+           "(mosaic) TPU interpreter")
+def test_overlap_segment_bitwise():
+    """Certificate-gated fused RDMA segment == stepwise, bitwise: the
+    k launches fused into one program carry exactly the per-launch
+    semaphore drain the certificate proved."""
+    a, b = _make_overlap_jacobi(), _make_overlap_jacobi()
+    a.run(4)
+    seg = b.make_segment(4)
+    assert seg and seg.steps == 4
+    seg.run(0)
+    np.testing.assert_array_equal(a.temperature(), b.temperature())
+
+
+def test_decline_reason_vocabulary():
+    """The decline_reason vocabulary is pinned: fused:false events and
+    the flight-recorder timeline are greppable by CAUSE, and decline()
+    refuses codes outside the set."""
+    from stencil_tpu.parallel import megastep as ms
+
+    assert ms.DECLINE_REASONS == frozenset({
+        "no-fused-builder", "uncertified-rdma-schedule",
+        "interior-resident-state", "policy-disabled",
+        "no-segment-factory", "rebuild-no-segment-factory",
+    })
+    d = ms.decline("jacobi", "xla", "free-form prose")
+    assert d.code == ms.DECLINE_NO_BUILDER  # the default
+    d = ms.decline("jacobi", "overlap", "gate said no",
+                   code=ms.DECLINE_UNCERTIFIED_SCHEDULE)
+    assert not d and d.code == "uncertified-rdma-schedule"
+    with pytest.raises(ValueError, match="unknown decline code"):
+        ms.decline("jacobi", "xla", "typo", code="not-a-real-code")
 
 
 def test_astaroth_fast_path_declines_loudly():
@@ -416,10 +485,11 @@ def test_driver_reports_fused_decline(tmp_path):
     """A declining path under the fused-by-default driver: the report
     says fused: false with the decline reason, the event log carries
     fused_decline, and the stencil_run_fused_dispatch_total{fused}
-    counter accumulates the stepwise dispatches. (The overlap path's
-    own decline is pinned by test_overlap_path_declines_loudly; here
-    a declining factory drives the DRIVER's visibility contract
-    without needing interpreted remote DMA to execute steps.)"""
+    counter accumulates the stepwise dispatches. (Certificate-gated
+    overlap declines are pinned by
+    test_overlap_path_declines_on_unsafe_certificate; here a declining
+    factory drives the DRIVER's visibility contract without needing
+    interpreted remote DMA to execute steps.)"""
     from stencil_tpu.parallel.megastep import decline
     from stencil_tpu.resilience import ResiliencePolicy
     from stencil_tpu.resilience.driver import run_resilient
@@ -435,10 +505,12 @@ def test_driver_reports_fused_decline(tmp_path):
                                 sleep=lambda s: None),
         make_segment=lambda k, pe, m: decline(
             "jacobi", "overlap",
-            "in-kernel RDMA overlap: per-launch semaphore state"))
+            "uncertified RDMA schedule: replay_safe=false (test stub)",
+            code="uncertified-rdma-schedule"))
     assert rep.steps == 4
     assert rep.fused is False
     assert "RDMA" in rep.fused_decline_reason
+    assert rep.fused_decline_code == "uncertified-rdma-schedule"
     declines = [e for e in rep.events if e["event"] == "fused_decline"]
     assert declines and declines[0]["model"] == "jacobi"
     assert declines[0]["path"] == "overlap"
@@ -524,7 +596,12 @@ def test_megastep_registry_targets_prove_exact_counts():
         # the dataflow audits of the same fused program (PR 9)
         "parallel.megastep.segment[k=4,donation]",
         "parallel.megastep.segment[k=4,transfer]",
-        "parallel.megastep.segment[k=4,recompile]"}
+        "parallel.megastep.segment[k=4,recompile]",
+        # the fused RDMA segment's schedule certificate (PR 16);
+        # pinned by test_lint's schedule tests, excluded from the
+        # collective-count audit below (it is traced, not lowered)
+        "analysis.schedule.parallel.megastep.segment[overlap,k=4]"}
+    targets = [t for t in targets if t.checker != "schedule"]
     report = run_targets(targets)
     assert not report.findings, report.findings
     hlo = report.metrics["hlo:parallel.megastep.segment[k=4,hlo]"]
