@@ -24,15 +24,17 @@ cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
 echo "== 1/12 lint (stencil-lint + ruff; tier=$TIER) =="
-# stencil-lint: all twelve static checkers — halo-radius footprint,
+# stencil-lint: all thirteen static checkers — halo-radius footprint,
 # DMA discipline, ppermute sanity, HLO collective-permute-only
 # lowering, analytic-vs-HLO byte cross-check, the Pallas VMEM/tiling
 # audit, the dataflow trio (donation aliasing, host-transfer hygiene,
 # recompile-hazard fingerprints), the prescriptive block-shape tiling
 # gate (every Pallas kernel at 256^3/512^3-per-device shapes against
 # the PHYSICAL VMEM budget — trace-only, no TPU), the link
-# observatory's traffic-matrix-vs-HLO exactness gate, and the RDMA
-# schedule certifier (happens-before under k-fold replay)
+# observatory's traffic-matrix-vs-HLO exactness gate, the RDMA
+# schedule certifier (happens-before under k-fold replay), and the
+# precision certifier (dtype-flow proofs gating low-precision wire
+# formats)
 # (python -m stencil_tpu.analysis, see README "Static analysis").
 # The hlo/costmodel byte checks capability-gate themselves on the
 # image's JAX (StableHLO lowering support is probed; Pallas targets
@@ -86,6 +88,44 @@ assert not bad, \
     f"kernel must hold a replay_safe certificate (analysis/schedule.py)"
 print(f"schedule certificates OK: {len(fused)} fused target(s), all "
       f"replay_safe")
+EOF
+# the precision certificates (analysis/precision.py): the per-target
+# dtype-flow verdicts the wire-format gate consumes. Archived next to
+# the schedule certificates; then the realized⇒certified invariant —
+# every declared-narrowing wire target in the registry MUST hold a
+# safe certificate with zero silent converts this run (and at least
+# one such target must exist, or dropping the bf16 registry entries
+# would pass vacuously), and every target of checker 13 must certify
+# safe — the same certificates make_exchange's realize()-time gate
+# re-derives before it lets a narrow wire ship.
+python -m stencil_tpu.analysis -q --only precision \
+  --json precision_certificates.json > /dev/null
+if [ -n "${CI_ARTIFACT_DIR:-}" ] && [ -f precision_certificates.json ]
+then
+  cp precision_certificates.json "$CI_ARTIFACT_DIR/"
+fi
+python - precision_certificates.json <<'EOF'
+import json
+import sys
+d = json.load(open(sys.argv[1]))
+certs = {k: v for k, v in d["metrics"].items()
+         if k.startswith("precision:")}
+assert len(certs) >= 13, f"precision coverage shrank: {sorted(certs)}"
+unsafe = [k for k, v in certs.items() if not v.get("safe")]
+assert not unsafe, \
+    f"UNCERTIFIED precision targets: {unsafe} — every registered " \
+    f"entry point must hold a safe PrecisionCertificate " \
+    f"(analysis/precision.py)"
+leaky = [k for k, v in certs.items() if v.get("silent_converts")]
+assert not leaky, f"silent converts in shipped paths: {leaky}"
+wired = {k: v for k, v in certs.items() if any(
+    rec.get("declared") not in (None, "f32")
+    for rec in v.get("wire_dtypes", {}).values())}
+assert wired, "no declared-narrowing wire targets registered"
+for k, v in wired.items():
+    assert v["max_rel_error_bound"] > 0, (k, v)
+print(f"precision certificates OK: {len(certs)} target(s) all safe, "
+      f"{len(wired)} narrow-wire declaration(s) certified")
 EOF
 # the link observatory artifact: the modeled per-link traffic matrix
 # (whose per-method totals the linkmap checker just pinned HLO-exactly

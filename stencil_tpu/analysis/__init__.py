@@ -1,7 +1,7 @@
 """stencil-lint / stencil-audit: static invariant checking for the
 stencil framework.
 
-Twelve checkers prove, WITHOUT executing anything (jaxpr tracing plus
+Thirteen checkers prove, WITHOUT executing anything (jaxpr tracing plus
 lower-only StableHLO inspection and alias-map parsing of compiled —
 never dispatched — programs; seconds on any CPU box, no TPU, no
 interpreter), the invariants the whole framework hangs on:
@@ -48,6 +48,15 @@ interpreter), the invariants the whole framework hangs on:
   the per-kernel ``ScheduleCertificate`` the megastep segment compiler
   consumes to fuse (or certificate-citingly decline) in-kernel RDMA
   paths;
+* :mod:`.precision`   — dtype-flow certification: every
+  ``convert_element_type`` is declared (wire/compute declarations on
+  ``make_exchange``/``CarryContract``) or flagged silent, additive
+  reductions accumulate at >= the declared compute dtype, every
+  ``ppermute`` operand carries exactly its axis's declared wire dtype
+  per ``linkmap`` link class, and narrowing happens at most once per
+  hop — emitting the per-target ``PrecisionCertificate`` that gates
+  low-precision halo wire formats (``wire_format="bf16"`` refuses to
+  realize uncertified);
 * ``linkmap`` (:mod:`stencil_tpu.observatory.linkmap`) — the link
   observatory's modeled per-(src, dst) traffic matrix sums EXACTLY to
   the HLO-extracted wire bytes for every registered exchange method
@@ -74,6 +83,10 @@ from .donation import (DonationSpec, DonationTarget, alias_param_ids,
                        check_donation)
 from .footprint import StencilOpSpec, StencilOpTarget, check_stencil_op
 from .hlo import HloSpec, HloTarget, check_hlo
+from .precision import (PrecisionCertificate, PrecisionGateError,
+                        PrecisionSpec, PrecisionTarget,
+                        axis_link_classes, certify_wire_format,
+                        check_precision)
 from .recompile import (RecompileGuardError, RecompileSpec,
                         RecompileTarget, SingleCompileGuard,
                         assert_single_compile, check_recompile)
@@ -95,7 +108,7 @@ from ..observatory.linkmap import (LinkmapSpec, LinkmapTarget,
 
 CHECKERS = ("footprint", "dma", "collectives", "hlo", "costmodel",
             "vmem", "donation", "transfer", "recompile", "tiling",
-            "linkmap", "schedule")
+            "linkmap", "schedule", "precision")
 
 CHECKER_DOC = {
     "footprint": "26-direction access footprint vs declared Radius",
@@ -111,6 +124,9 @@ CHECKER_DOC = {
     "linkmap": "per-link traffic matrix sums exactly to HLO bytes",
     "schedule": "RDMA semaphore schedules certified replay-safe "
                 "(happens-before under k-fold replay)",
+    "precision": "dtype-flow proofs: declared converts only, >= f32 "
+                 "accumulation, exact per-link wire dtypes, one "
+                 "quantization per hop",
 }
 
 __all__ = [
@@ -119,15 +135,17 @@ __all__ = [
     "CostModelTarget", "DonationSpec", "DonationTarget", "HloSpec",
     "HloTarget", "PallasKernelSpec", "PallasKernelTarget",
     "LinkmapSpec", "LinkmapTarget",
+    "PrecisionCertificate", "PrecisionGateError", "PrecisionSpec",
+    "PrecisionTarget",
     "RecompileGuardError", "RecompileSpec", "RecompileTarget",
     "ScheduleCertificate", "ScheduleSpec", "ScheduleTarget",
     "SingleCompileGuard", "StencilOpSpec", "StencilOpTarget",
     "TransferSpec", "TransferTarget", "VmemSpec", "VmemTarget",
-    "alias_param_ids", "assert_single_compile", "certify_traceable",
-    "check_collectives",
+    "alias_param_ids", "assert_single_compile", "axis_link_classes",
+    "certify_traceable", "certify_wire_format", "check_collectives",
     "check_costmodel", "check_donation", "check_hlo",
-    "check_linkmap", "check_pallas_kernels", "check_recompile",
-    "check_schedule",
+    "check_linkmap", "check_pallas_kernels", "check_precision",
+    "check_recompile", "check_schedule",
     "check_stencil_op", "check_tiling", "check_transfer", "check_vmem",
     "hot_loop_transfer_guard", "plan_blocks", "run_targets",
     "snap_blocks",
@@ -146,6 +164,7 @@ _DISPATCH = {
     "tiling": check_tiling,
     "linkmap": check_linkmap,
     "schedule": check_schedule,
+    "precision": check_precision,
 }
 
 

@@ -59,7 +59,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "/ collective-permutation / HLO-lowering / "
                     "cost-model / VMEM / donation / host-transfer / "
                     "recompile / prescriptive-tiling / link-traffic / "
-                    "RDMA-schedule-certification checks (no execution)")
+                    "RDMA-schedule-certification / "
+                    "precision-certification checks (no execution)")
     parser.add_argument("fixtures", nargs="*",
                         help="fixture module paths (files defining "
                              "TARGETS) to check instead of the shipped "

@@ -237,8 +237,8 @@ def exchange_round_model(method_name: str,
                          shard_interior_zyx: Sequence[int], radius,
                          counts, elem_sizes: Sequence[int],
                          steps: int = 1,
-                         dtype_groups: "int | None" = None
-                         ) -> Tuple[int, int]:
+                         dtype_groups: "int | None" = None,
+                         wire_format=None) -> Tuple[int, int]:
     """Analytic (messages, wire_bytes) ONE shard contributes per deep
     exchange round under strategy ``method_name`` — the per-method
     refinement of :func:`deep_exchange_bytes_per_shard` the autotuner
@@ -258,7 +258,10 @@ def exchange_round_model(method_name: str,
     packed engine concatenates per DTYPE (f32 and i32 pack separately
     despite equal sizes — parallel/exchange.py groups by ``.dtype``);
     pass the distinct-dtype count when known, else it is approximated
-    by the distinct element sizes.
+    by the distinct element sizes. ``wire_format`` prices the halo
+    payload at the on-wire width (a bf16 axis halves its 4-byte
+    lanes) — only the ppermute engines carry narrow formats, and the
+    certificate gate enforces that before any such plan realizes.
     """
     from ..parallel.exchange import exchanged_bytes_per_sweep
 
@@ -284,9 +287,15 @@ def exchange_round_model(method_name: str,
     else:
         messages = directions * len(elem_sizes)
 
+    # only the slab/packed ppermute engines implement narrow wire
+    # formats (parallel.methods.WIRE_CAPABLE); everything else ships
+    # storage bytes
+    wf = (wire_format if method_name in ("PpermuteSlab",
+                                         "PpermutePacked") else None)
     nbytes = 0
     for esize in elem_sizes:
-        per_axis = exchanged_bytes_per_sweep(padded, deep, counts, esize)
+        per_axis = exchanged_bytes_per_sweep(padded, deep, counts,
+                                             esize, wire_format=wf)
         for name, b in per_axis.items():
             if method_name == "AllGather":
                 b *= gather_factor.get(name, 1)
@@ -299,7 +308,8 @@ def configured_step_seconds(method_name: str,
                             counts, elem_sizes: Sequence[int],
                             steps: int,
                             coeffs: LinkCoefficients = DEFAULT_ICI_COEFFS,
-                            dtype_groups: "int | None" = None) -> float:
+                            dtype_groups: "int | None" = None,
+                            wire_format=None) -> float:
     """Alpha-beta exchange seconds per STEP of one (method,
     exchange_every) configuration: the deep round's cost spread over
     the ``steps`` steps it feeds — :func:`temporal_step_exchange_seconds`
@@ -307,7 +317,7 @@ def configured_step_seconds(method_name: str,
     with MEASURED coefficients to prune the sweep before timing."""
     messages, nbytes = exchange_round_model(
         method_name, shard_interior_zyx, radius, counts, elem_sizes,
-        steps, dtype_groups)
+        steps, dtype_groups, wire_format=wire_format)
     return coeffs.seconds(messages, nbytes) / steps
 
 

@@ -68,6 +68,43 @@ def find_pallas_kernels(jaxpr: Jaxpr) -> List[Tuple[str, Jaxpr]]:
     return out
 
 
+def leaf_aval(leaf: Any) -> Tuple[Tuple[int, ...], str, bool]:
+    """(shape, dtype, weak_type) of an array-ish leaf."""
+    import numpy as np
+
+    shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+    dtype = str(np.dtype(getattr(leaf, "dtype", np.float32)))
+    weak = bool(getattr(leaf, "weak_type", False))
+    aval = getattr(leaf, "aval", None)
+    if aval is not None:
+        weak = bool(getattr(aval, "weak_type", weak))
+    return shape, dtype, weak
+
+
+def flat_with_paths(tree: Any) -> List[Tuple[str, Any]]:
+    """(path_string, leaf) pairs in canonical flatten order."""
+    return [("".join(str(k) for k in path), leaf) for path, leaf in
+            jax.tree_util.tree_flatten_with_path(tree)[0]]
+
+
+def dtype_pairs(curr: Any, next_: Any
+                ) -> Optional[List[Tuple[str,
+                                         Tuple[Tuple[int, ...], str, bool],
+                                         Tuple[Tuple[int, ...], str, bool]]]]:
+    """The shared curr/next dtype-pair walk: flatten both trees and
+    pair each leaf's (shape, dtype, weak_type) by position —
+    ``(path, curr_aval, next_aval)`` per leaf, or ``None`` when the
+    two trees disagree on leaf count (the pytree itself drifted).
+    Both the recompile checker (carried-state fingerprint stability)
+    and the precision checker (wire formats must not leak into the
+    carried state) consume this one walker."""
+    cf, nf = flat_with_paths(curr), flat_with_paths(next_)
+    if len(cf) != len(nf):
+        return None
+    return [(cpath, leaf_aval(cleaf), leaf_aval(nleaf))
+            for (cpath, cleaf), (_np, nleaf) in zip(cf, nf)]
+
+
 def literal_int(x: Any) -> Optional[int]:
     """Static integer value of a jaxpr atom, or None when traced."""
     if isinstance(x, Literal):

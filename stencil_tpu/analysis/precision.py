@@ -1,0 +1,604 @@
+"""Checker 13: dtype-flow certification of halo wire formats.
+
+The twelve shipped checkers audit collectives, bytes, VMEM, dataflow,
+tiling, and semaphore schedules — never *dtype flow*.  That gap is
+what kept ROADMAP item 1 (low-precision wire formats) unshippable: a
+bf16 halo path is only sound if the narrowing is confined to the wire,
+and nothing could prove it.  This checker walks every registered entry
+point's jaxpr building a dtype-provenance state per value — how many
+times it has been quantized since the last collective hop, which
+narrow dtype it still round-trips exactly through, and the widest
+float dtype in its lineage — classifies every
+``convert_element_type`` as **declared** (named by a wire/compute
+declaration: ``make_exchange(wire_format=...)``,
+``CarryContract.compute_dtype``/``wire_formats``) or **silent**
+(ERROR), and proves three conditions:
+
+* **(a) accumulation floor** — every additive reduction
+  (``reduce_sum``/``psum``/``dot_general``/``cumsum``/
+  ``scatter-add``/``add_any``) runs at >= the declared compute dtype
+  (default f32) even when storage is narrower: the MHD
+  storage/compute split becomes a proven invariant, not a convention.
+  The check reads the reduction's OUTPUT dtype — that is the
+  accumulator width (``preferred_element_type`` and all);
+* **(b) declared wire dtype per link class** — each
+  ``ppermute``/``all_gather``/``all_to_all`` operand carries exactly
+  the wire dtype its axis declares, joined against ``linkmap``'s
+  axis -> self/ici-hop<k>/dcn classification (the per-LINK story:
+  bf16 on the far tier, f32 where the wire is free);
+* **(c) at most one quantization per hop** — a value may be narrowed
+  at most once between collective hops.  Widen-then-renarrow to the
+  SAME dtype is an exact round-trip (the sequential axis sweeps
+  re-narrow arrived halos without loss); narrowing twice with
+  arithmetic in between is double quantization and is flagged.
+
+Each target emits a :class:`PrecisionCertificate`
+``{wire_dtypes, silent_converts, narrowest_accum,
+max_rel_error_bound, safe, reasons[]}`` into the report metrics, and
+the engines CONSUME it, schedule-certifier style
+(``parallel/megastep.certificate_gate`` precedent):
+``make_exchange(wire_format="bf16", ...)`` refuses to realize —
+loudly, :class:`PrecisionGateError` — unless
+:func:`certify_wire_format` proves the built program safe.  The
+per-hop error bound is analytic: round-to-nearest narrowing to a
+p-bit significand perturbs each halo element by a relative error of
+at most ``2**-p`` (bf16: ``2**-8``), and ``wire_format="f32"`` is the
+bitwise identity path (bound 0.0) — both pinned by the Jacobi
+fused-vs-stepwise tests.
+
+Like every checker here the pass is trace-only (``jax.make_jaxpr``
+over ``ShapeDtypeStruct``s): no FLOPs, no devices, seconds on a
+backendless CI box.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .jaxprs import ClosedJaxpr, Jaxpr, Literal, dtype_pairs, trace
+from .report import ERROR, Finding
+
+#: additive reductions whose accumulator width condition (a) floors
+#: (order-insensitive sums — max/min reductions carry no rounding
+#: accumulation and are exempt)
+REDUCTION_PRIMS = frozenset({
+    "reduce_sum", "cumsum", "add_any", "dot_general", "scatter-add"})
+
+#: primitives that move values verbatim — they propagate the
+#: exact-round-trip state; everything else is arithmetic and clears it
+VALUE_PRESERVING = frozenset({
+    "slice", "dynamic_slice", "dynamic_update_slice", "concatenate",
+    "reshape", "transpose", "broadcast_in_dim", "squeeze",
+    "expand_dims", "rev", "copy", "gather", "select_n", "pad",
+    "stop_gradient", "split"})
+
+#: collectives that put bytes on the wire (condition (b)/(c) join
+#: points); psum is a reduction, not a wire-format carrier
+WIRE_PRIMS = frozenset({"ppermute", "all_gather", "all_to_all"})
+
+
+class PrecisionGateError(RuntimeError):
+    """A narrowing wire format failed certification at realize time."""
+
+
+def _is_float(dt: Any) -> bool:
+    try:
+        import jax.numpy as jnp
+
+        return bool(jnp.issubdtype(np.dtype(dt), jnp.floating))
+    except TypeError:
+        return False
+
+
+def _nmant(dt: Any) -> int:
+    import jax.numpy as jnp
+
+    return int(jnp.finfo(np.dtype(dt)).nmant)
+
+
+def _dtname(dt: Any) -> str:
+    return str(np.dtype(dt))
+
+
+def _wider(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    """The wider of two float dtype names (None = no float lineage)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a if _nmant(a) >= _nmant(b) else b
+
+
+def rel_error_bound(wire_dtype_name: str) -> float:
+    """Per-hop relative rounding bound of narrowing to this wire
+    dtype: round-to-nearest to a (nmant+1)-bit significand perturbs
+    each element by at most ``2**-(nmant+1)`` (bf16: 2**-8)."""
+    return float(2.0 ** -(_nmant(wire_dtype_name) + 1))
+
+
+# ---------------------------------------------------------------------------
+# per-value provenance state
+
+
+@dataclasses.dataclass
+class _V:
+    """Dtype provenance of one traced value.
+
+    ``quant``    — lossy narrowings since the last collective hop;
+    ``exact_in`` — narrow dtype the value still round-trips exactly
+                   through (set by a narrowing, survives widening and
+                   value-preserving movement, cleared by arithmetic);
+    ``orig``     — widest float dtype in the lineage (the STORAGE
+                   dtype condition (b) derives the expected wire
+                   dtype from)."""
+
+    quant: int = 0
+    exact_in: Optional[str] = None
+    orig: Optional[str] = None
+
+
+def _fresh(aval: Any) -> _V:
+    dt = getattr(aval, "dtype", None)
+    return _V(orig=_dtname(dt) if dt is not None and _is_float(dt)
+              else None)
+
+
+@dataclasses.dataclass
+class _Ctx:
+    """One traversal's declarations and collectors."""
+
+    wire: Optional[Dict[str, str]]          # axis -> declared format
+    compute_nmant: int
+    declared: frozenset                     # {(src, dst)} narrowings
+    link_classes: Dict[str, str]
+    silent: Dict[Tuple[str, str], int] = dataclasses.field(
+        default_factory=dict)
+    wire_dtypes: Dict[str, Dict[str, Any]] = dataclasses.field(
+        default_factory=dict)
+    accum_dtypes: List[str] = dataclasses.field(default_factory=list)
+    reasons: List[str] = dataclasses.field(default_factory=list)
+    max_bound: float = 0.0
+
+    def fail(self, msg: str) -> None:
+        if msg not in self.reasons:
+            self.reasons.append(msg)
+
+
+def declared_pairs_for(wire: Optional[Dict[str, str]],
+                       compute_dtype: Optional[str] = "float32",
+                       storage_dtype: Optional[str] = None,
+                       extra: Sequence[Tuple[str, str]] = ()
+                       ) -> frozenset:
+    """The set of (src, dst) narrowing conversions the declarations
+    name: each bf16 wire axis declares float32 -> bfloat16 (the send
+    boundary; the widen back is lossless and needs no declaration),
+    and a storage/compute split declares compute -> storage (the
+    store-back of an MHD-style bf16-storage / f32-compute model)."""
+    pairs = set(tuple(p) for p in extra)
+    for fmt in (wire or {}).values():
+        if fmt == "bf16":
+            pairs.add(("float32", "bfloat16"))
+    if storage_dtype is not None and compute_dtype is not None \
+            and _is_float(storage_dtype) and _is_float(compute_dtype) \
+            and _nmant(storage_dtype) < _nmant(compute_dtype):
+        pairs.add((_dtname(compute_dtype), _dtname(storage_dtype)))
+    return frozenset(pairs)
+
+
+def axis_link_classes(counts: Any,
+                      devices: Optional[Sequence] = None,
+                      dcn_axis: Optional[int] = None,
+                      n_slices: int = 1) -> Dict[str, str]:
+    """Each mesh axis's link class for a +1 neighbor shift —
+    ``self`` (1-device axis: the periodic wrap is a local copy, no
+    wire), else ``linkmap``'s classification of the representative
+    shard-0 edge (``ici-hop<k>`` / ``dcn``).  Lazy import: linkmap
+    reaches back into parallel/exchange."""
+    from ..geometry import Dim3
+    from ..observatory.linkmap import link_class_of, mesh_distance_matrix
+
+    counts = Dim3.of(counts)
+    dist = mesh_distance_matrix(counts, devices, dcn_axis, n_slices)
+    step = {0: 1, 1: counts.x, 2: counts.x * counts.y}
+    out: Dict[str, str] = {}
+    for a, name in ((0, "x"), (1, "y"), (2, "z")):
+        out[name] = ("self" if counts[a] == 1 else
+                     link_class_of(0, step[a], dist, counts,
+                                   dcn_axis, n_slices))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the abstract interpreter
+
+
+def _state_of(v: Any, env: Dict) -> _V:
+    if isinstance(v, Literal):
+        return _fresh(v.aval)
+    s = env.get(v)
+    if s is None:
+        s = _fresh(v.aval)
+        env[v] = s
+    return s
+
+
+def _join(states: Sequence[_V], preserve: bool,
+          out_dtype: Optional[str]) -> _V:
+    quant = max((s.quant for s in states), default=0)
+    origs = [s.orig for s in states if s.orig is not None]
+    orig = None
+    for o in origs:
+        orig = _wider(orig, o)
+    if not preserve and out_dtype is not None and _is_float(out_dtype):
+        orig = _wider(orig, _dtname(out_dtype))
+    exact: Optional[str] = None
+    if preserve:
+        exacts = {s.exact_in for s in states if s.orig is not None}
+        if len(exacts) == 1:
+            exact = next(iter(exacts))
+    return _V(quant=quant, exact_in=exact, orig=orig)
+
+
+def _axis_of(params: Dict) -> Optional[str]:
+    ax = params.get("axis_name")
+    if isinstance(ax, (tuple, list)):
+        ax = ax[0] if ax else None
+    return str(ax) if ax is not None else None
+
+
+def _sub_jaxpr(obj: Any) -> Optional[Jaxpr]:
+    if isinstance(obj, ClosedJaxpr):
+        return obj.jaxpr
+    if isinstance(obj, Jaxpr):
+        return obj
+    return None
+
+
+def _map_io(sub: Jaxpr, ins: Sequence[_V], env: Dict) -> Dict:
+    sub_env: Dict = {}
+    if len(sub.invars) == len(ins):
+        for var, s in zip(sub.invars, ins):
+            sub_env[var] = dataclasses.replace(s)
+    return sub_env
+
+
+def _walk(jaxpr: Jaxpr, env: Dict, ctx: _Ctx) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        ins = [_state_of(v, env) for v in eqn.invars]
+
+        if name == "convert_element_type":
+            src = _dtname(eqn.invars[0].aval.dtype)
+            dst = _dtname(eqn.outvars[0].aval.dtype)
+            s = ins[0]
+            if _is_float(src) and _is_float(dst):
+                if _nmant(dst) < _nmant(src):
+                    if (src, dst) not in ctx.declared:
+                        key = (src, dst)
+                        ctx.silent[key] = ctx.silent.get(key, 0) + 1
+                    if s.exact_in == dst:
+                        out = dataclasses.replace(s)  # exact round-trip
+                    else:
+                        out = _V(quant=s.quant + 1, exact_in=dst,
+                                 orig=s.orig)
+                else:
+                    out = _V(quant=s.quant, exact_in=s.exact_in,
+                             orig=_wider(s.orig, dst))
+            else:
+                out = _V(orig=dst if _is_float(dst) else None)
+            env[eqn.outvars[0]] = out
+            continue
+
+        if name in WIRE_PRIMS:
+            axis = _axis_of(eqn.params)
+            link = ctx.link_classes.get(axis or "", "ici-hop1")
+            for i, v in enumerate(eqn.invars):
+                dt = _dtname(v.aval.dtype)
+                s = ins[i]
+                if axis is not None:
+                    rec = ctx.wire_dtypes.setdefault(
+                        axis, {"dtypes": [], "link_class": link,
+                               "declared": (ctx.wire or {}).get(axis)})
+                    if dt not in rec["dtypes"]:
+                        rec["dtypes"].append(dt)
+                if not _is_float(dt) or s.orig is None:
+                    continue
+                if _nmant(dt) < _nmant(s.orig):
+                    ctx.max_bound = max(ctx.max_bound,
+                                        rel_error_bound(dt))
+                if ctx.wire is not None and axis in (ctx.wire or {}):
+                    from ..parallel.exchange import wire_dtype
+
+                    expected = _dtname(
+                        wire_dtype(np.dtype(s.orig), ctx.wire[axis]))
+                    if dt != expected:
+                        ctx.fail(
+                            f"(b) wire dtype mismatch on axis {axis} "
+                            f"({link}): {name} operand is {dt} but "
+                            f"the declared wire format "
+                            f"'{ctx.wire[axis]}' for {s.orig} storage "
+                            f"expects {expected}")
+                if s.quant > 1:
+                    ctx.fail(
+                        f"(c) double quantization: {name} operand on "
+                        f"axis {axis} ({link}) was narrowed "
+                        f"{s.quant} times since the previous hop — "
+                        f"quantize at most once per hop")
+            for ov, s in zip(eqn.outvars, ins):
+                env[ov] = _V(quant=0, exact_in=s.exact_in, orig=s.orig)
+            continue
+
+        if name in REDUCTION_PRIMS or name.startswith("psum"):
+            for ov in eqn.outvars:
+                dt = getattr(ov.aval, "dtype", None)
+                if dt is not None and _is_float(dt):
+                    dtn = _dtname(dt)
+                    if dtn not in ctx.accum_dtypes:
+                        ctx.accum_dtypes.append(dtn)
+                    if _nmant(dtn) < ctx.compute_nmant:
+                        ctx.fail(
+                            f"(a) accumulation below the compute "
+                            f"floor: {name} accumulates at {dtn} "
+                            f"(nmant {_nmant(dtn)}) — reductions must "
+                            f"run at >= the declared compute dtype "
+                            f"(nmant {ctx.compute_nmant}) even when "
+                            f"storage is narrower")
+            for ov in eqn.outvars:
+                dt = getattr(ov.aval, "dtype", None)
+                env[ov] = _join(ins, preserve=False,
+                                out_dtype=_dtname(dt)
+                                if dt is not None else None)
+            continue
+
+        if name == "scan":
+            sub = _sub_jaxpr(eqn.params.get("jaxpr"))
+            if sub is not None:
+                sub_env = _map_io(sub, ins, env)
+                _walk(sub, sub_env, ctx)
+                outs = [sub_env.get(ov, _fresh(ov.aval))
+                        if not isinstance(ov, Literal) else _fresh(ov.aval)
+                        for ov in sub.outvars]
+                for ov, s in zip(eqn.outvars,
+                                 outs[-len(eqn.outvars):]):
+                    env[ov] = dataclasses.replace(s)
+            continue
+
+        if name == "while":
+            cn = eqn.params.get("cond_nconsts", 0)
+            bn = eqn.params.get("body_nconsts", 0)
+            carry = ins[cn + bn:]
+            cond = _sub_jaxpr(eqn.params.get("cond_jaxpr"))
+            body = _sub_jaxpr(eqn.params.get("body_jaxpr"))
+            if cond is not None:
+                _walk(cond, _map_io(cond, ins[:cn] + carry, env), ctx)
+            if body is not None:
+                body_env = _map_io(body, ins[cn:cn + bn] + carry, env)
+                _walk(body, body_env, ctx)
+                outs = [body_env.get(ov, _fresh(ov.aval))
+                        if not isinstance(ov, Literal) else _fresh(ov.aval)
+                        for ov in body.outvars]
+                for ov, s in zip(eqn.outvars, outs):
+                    env[ov] = dataclasses.replace(s)
+            continue
+
+        if name == "cond":
+            branch_outs: List[List[_V]] = []
+            for br in eqn.params.get("branches", ()):
+                bj = _sub_jaxpr(br)
+                if bj is None:
+                    continue
+                br_env = _map_io(bj, ins[1:], env)
+                _walk(bj, br_env, ctx)
+                branch_outs.append(
+                    [br_env.get(ov, _fresh(ov.aval))
+                     if not isinstance(ov, Literal) else _fresh(ov.aval)
+                     for ov in bj.outvars])
+            for i, ov in enumerate(eqn.outvars):
+                states = [outs[i] for outs in branch_outs
+                          if i < len(outs)]
+                env[ov] = (_join(states, preserve=True, out_dtype=None)
+                           if states else _fresh(ov.aval))
+            continue
+
+        if name == "pallas_call":
+            kj = _sub_jaxpr(eqn.params.get("jaxpr"))
+            if kj is not None:
+                _walk(kj, {}, ctx)  # refs: fresh states, audit eqns
+            for ov in eqn.outvars:
+                env[ov] = _fresh(ov.aval)
+            continue
+
+        sub = _sub_jaxpr(eqn.params.get("jaxpr")
+                         or eqn.params.get("call_jaxpr"))
+        if sub is not None:
+            sub_env = _map_io(sub, ins, env)
+            _walk(sub, sub_env, ctx)
+            outs = [sub_env.get(ov, _fresh(ov.aval))
+                    if not isinstance(ov, Literal) else _fresh(ov.aval)
+                    for ov in sub.outvars]
+            if len(outs) == len(eqn.outvars):
+                for ov, s in zip(eqn.outvars, outs):
+                    env[ov] = dataclasses.replace(s)
+            else:
+                for ov in eqn.outvars:
+                    env[ov] = _fresh(ov.aval)
+            continue
+
+        preserve = name in VALUE_PRESERVING
+        for ov in eqn.outvars:
+            dt = getattr(ov.aval, "dtype", None)
+            env[ov] = _join(ins, preserve=preserve,
+                            out_dtype=_dtname(dt)
+                            if dt is not None else None)
+
+
+# ---------------------------------------------------------------------------
+# certificates
+
+
+@dataclasses.dataclass
+class PrecisionCertificate:
+    """The dtype-flow verdict for one entry point: ``safe`` iff no
+    silent converts and conditions (a)/(b)/(c) all hold; ``reasons``
+    name every violated condition.  ``max_rel_error_bound`` is the
+    analytic per-element, per-hop relative rounding bound of the
+    narrowest wire dtype crossed (0.0 = bitwise identity wire)."""
+
+    target: str
+    wire_dtypes: Dict[str, Dict[str, Any]]
+    silent_converts: List[Dict[str, Any]]
+    narrowest_accum: Optional[str]
+    max_rel_error_bound: float
+    safe: bool
+    reasons: List[str] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"target": self.target,
+                "wire_dtypes": {k: dict(v) for k, v in
+                                sorted(self.wire_dtypes.items())},
+                "silent_converts": list(self.silent_converts),
+                "narrowest_accum": self.narrowest_accum,
+                "max_rel_error_bound": self.max_rel_error_bound,
+                "safe": self.safe, "reasons": list(self.reasons)}
+
+
+@dataclasses.dataclass
+class PrecisionSpec:
+    """A traceable entry point plus its dtype declarations.
+
+    ``wire`` — per-axis declared wire formats (``{"x": "f32"|"bf16",
+    ...}``); ``None`` = no declaration (observe-only: wire dtypes are
+    recorded, condition (b) exact-match is not enforced — narrowing
+    still needs a declaration or it is a silent convert).
+    ``compute_min`` — the accumulation floor condition (a) proves.
+    ``storage_dtype`` — declares a compute -> storage narrowing (the
+    bf16-storage / f32-compute split).  ``counts``/``dcn_axis``/
+    ``n_slices`` feed the linkmap join for per-link-class reporting.
+    """
+
+    fn: Callable
+    args: Sequence[Any]
+    wire: Optional[Dict[str, str]] = None
+    compute_min: str = "float32"
+    storage_dtype: Optional[str] = None
+    declared_pairs: Tuple[Tuple[str, str], ...] = ()
+    counts: Optional[Any] = None
+    dcn_axis: Optional[int] = None
+    n_slices: int = 1
+
+
+@dataclasses.dataclass
+class PrecisionTarget:
+    name: str
+    build: Callable[[], PrecisionSpec]
+
+    checker = "precision"
+
+
+def _certify(name: str, closed: ClosedJaxpr, spec: PrecisionSpec
+             ) -> PrecisionCertificate:
+    link_classes = (axis_link_classes(spec.counts, None, spec.dcn_axis,
+                                      spec.n_slices)
+                    if spec.counts is not None else {})
+    ctx = _Ctx(wire=dict(spec.wire) if spec.wire is not None else None,
+               compute_nmant=_nmant(spec.compute_min),
+               declared=declared_pairs_for(spec.wire, spec.compute_min,
+                                           spec.storage_dtype,
+                                           spec.declared_pairs),
+               link_classes=link_classes)
+    env: Dict = {}
+    for v in closed.jaxpr.invars:
+        env[v] = _fresh(v.aval)
+    _walk(closed.jaxpr, env, ctx)
+    for (src, dst), n in sorted(ctx.silent.items()):
+        ctx.fail(f"silent convert: {src} -> {dst} ({n}x) is a lossy "
+                 f"narrowing named by no wire/compute declaration")
+    if ctx.wire is not None:
+        for ax, fmt in sorted(ctx.wire.items()):
+            if fmt != "f32" and link_classes.get(ax) != "self":
+                ctx.max_bound = max(
+                    ctx.max_bound,
+                    rel_error_bound("bfloat16" if fmt == "bf16"
+                                    else fmt))
+    narrowest = None
+    for dtn in ctx.accum_dtypes:
+        narrowest = (dtn if narrowest is None
+                     or _nmant(dtn) < _nmant(narrowest) else narrowest)
+    silent = [{"from": src, "to": dst, "count": n}
+              for (src, dst), n in sorted(ctx.silent.items())]
+    return PrecisionCertificate(
+        target=name, wire_dtypes=ctx.wire_dtypes,
+        silent_converts=silent, narrowest_accum=narrowest,
+        max_rel_error_bound=ctx.max_bound, safe=not ctx.reasons,
+        reasons=ctx.reasons)
+
+
+def certify_wire_format(fn: Callable, args: Sequence[Any],
+                        counts: Any = None,
+                        wire_formats: Optional[Dict[str, str]] = None,
+                        compute_min: str = "float32",
+                        dcn_axis: Optional[int] = None,
+                        n_slices: int = 1) -> PrecisionCertificate:
+    """Runtime API for the realize-time gate
+    (``make_exchange(wire_format=...)``): trace ``fn(*args)``, prove
+    the dtype flow against the declared per-axis wire formats, and
+    additionally prove the wire format does NOT leak into the carried
+    state (every output leaf keeps its input dtype — the donated
+    double-buffer contract).  Raises nothing — an untraceable program
+    returns an unsafe certificate whose reasons say why, so callers
+    refuse instead of crashing."""
+    import jax
+
+    spec = PrecisionSpec(fn=fn, args=args,
+                         wire=dict(wire_formats or {}) or None,
+                         compute_min=compute_min, counts=counts,
+                         dcn_axis=dcn_axis, n_slices=n_slices)
+    try:
+        closed = trace(fn, *args)
+    except Exception as e:  # noqa: BLE001
+        return PrecisionCertificate(
+            target="<untraceable>", wire_dtypes={}, silent_converts=[],
+            narrowest_accum=None, max_rel_error_bound=0.0, safe=False,
+            reasons=[f"precision trace failed: "
+                     f"{type(e).__name__}: {e}"])
+    cert = _certify("<wire-format-gate>", closed, spec)
+    try:
+        out = jax.eval_shape(fn, *args)
+    except Exception:  # noqa: BLE001 - trace above already succeeded
+        out = None
+    if out is not None:
+        pairs = dtype_pairs(args[0] if len(args) == 1 else list(args),
+                            out)
+        for path, (_is, idt, _iw), (_os, odt, _ow) in (pairs or []):
+            if idt != odt:
+                cert.reasons.append(
+                    f"wire dtype leaked into the carried state at "
+                    f"{path}: input {idt} -> output {odt} (the wire "
+                    f"format must stay on the wire)")
+                cert.safe = False
+    return cert
+
+
+def check_precision(target: PrecisionTarget
+                    ) -> Tuple[List[Finding], dict]:
+    """Certify the target's dtype flow; findings are the violated
+    conditions and silent converts, metrics are the certificate
+    (archived to the JSON report for the tuner/CI gate)."""
+    try:
+        spec = target.build()
+    except Exception as e:  # noqa: BLE001
+        return ([Finding("precision", target.name,
+                         f"target build failed: {type(e).__name__}: "
+                         f"{e}")], {})
+    try:
+        closed = trace(spec.fn, *spec.args)
+    except Exception as e:  # noqa: BLE001
+        return ([Finding("precision", target.name,
+                         f"trace failed: {type(e).__name__}: {e}")], {})
+    cert = _certify(target.name, closed, spec)
+    findings = [Finding("precision", target.name, r, ERROR)
+                for r in cert.reasons]
+    return findings, cert.to_dict()

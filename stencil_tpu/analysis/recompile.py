@@ -41,6 +41,7 @@ import dataclasses
 import hashlib
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from .jaxprs import dtype_pairs, flat_with_paths, leaf_aval
 from .report import ERROR, Finding
 
 #: arm the runtime trace-count guard in the drivers/service
@@ -77,24 +78,10 @@ class RecompileTarget:
     checker = "recompile"
 
 
-def _leaf_aval(leaf: Any) -> Tuple[Tuple[int, ...], str, bool]:
-    """(shape, dtype, weak_type) of an array-ish leaf."""
-    import numpy as np
-
-    shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
-    dtype = str(np.dtype(getattr(leaf, "dtype", np.float32)))
-    weak = bool(getattr(leaf, "weak_type", False))
-    aval = getattr(leaf, "aval", None)
-    if aval is not None:
-        weak = bool(getattr(aval, "weak_type", weak))
-    return shape, dtype, weak
-
-
-def _flat_with_paths(tree: Any):
-    import jax
-
-    return [("".join(str(k) for k in path), leaf) for path, leaf in
-            jax.tree_util.tree_flatten_with_path(tree)[0]]
+# the (shape, dtype, weak_type) leaf walk is shared with the precision
+# checker — one dtype-pair extractor, analysis/jaxprs.py
+_leaf_aval = leaf_aval
+_flat_with_paths = flat_with_paths
 
 
 def abstract_fingerprint(fn: Callable, args: Sequence[Any],
@@ -167,9 +154,8 @@ def check_recompile(target: RecompileTarget
 
     carry_leaves = 0
     for argnum, path in spec.carry:
-        in_flat = _flat_with_paths(spec.args[argnum])
         try:
-            out_flat = _flat_with_paths(_out_subtree(out, path))
+            out_sub = _out_subtree(out, path)
         except (IndexError, KeyError, TypeError):
             findings.append(Finding(
                 "recompile", target.name,
@@ -177,18 +163,19 @@ def check_recompile(target: RecompileTarget
                 f"output tree — the carried state for arg{argnum} "
                 f"cannot feed back", ERROR))
             continue
-        if len(in_flat) != len(out_flat):
+        pairs = dtype_pairs(spec.args[argnum], out_sub)
+        if pairs is None:
             findings.append(Finding(
                 "recompile", target.name,
-                f"carry arg{argnum}: {len(in_flat)} input leaves vs "
-                f"{len(out_flat)} output leaves — the state pytree "
-                f"changes shape across a dispatch (retrace every "
-                f"step)", ERROR))
+                f"carry arg{argnum}: "
+                f"{len(_flat_with_paths(spec.args[argnum]))} input "
+                f"leaves vs {len(_flat_with_paths(out_sub))} output "
+                f"leaves — the state pytree changes shape across a "
+                f"dispatch (retrace every step)", ERROR))
             continue
-        carry_leaves += len(in_flat)
-        for (ipath, ileaf), (opath, oleaf) in zip(in_flat, out_flat):
-            ishape, idtype, iweak = _leaf_aval(ileaf)
-            oshape, odtype, oweak = _leaf_aval(oleaf)
+        carry_leaves += len(pairs)
+        for ipath, (ishape, idtype, iweak), \
+                (oshape, odtype, oweak) in pairs:
             where = f"arg{argnum}{ipath}"
             if ishape != oshape:
                 findings.append(Finding(

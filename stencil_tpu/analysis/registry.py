@@ -29,6 +29,7 @@ from .dma import PallasKernelSpec, PallasKernelTarget
 from .donation import DonationSpec, DonationTarget
 from .footprint import StencilOpSpec, StencilOpTarget
 from .hlo import HloSpec, HloTarget
+from .precision import PrecisionSpec, PrecisionTarget
 from .recompile import RecompileSpec, RecompileTarget
 from .schedule import ScheduleSpec, ScheduleTarget
 from .transfer import TransferSpec, TransferTarget
@@ -38,7 +39,7 @@ from ..observatory.linkmap import LinkmapSpec, LinkmapTarget
 Target = Union[StencilOpTarget, PallasKernelTarget, CollectiveTarget,
                HloTarget, CostModelTarget, VmemTarget, DonationTarget,
                TransferTarget, RecompileTarget, LinkmapTarget,
-               ScheduleTarget]
+               ScheduleTarget, PrecisionTarget]
 
 
 def _f32(shape):
@@ -1543,6 +1544,45 @@ def _make_exchange_entry(method_name: str):
 
 
 @functools.lru_cache(maxsize=None)
+def _make_exchange_wire_entry(method_name: str, fmt: str = "bf16"):
+    """The certified low-precision wire path: building this entry IS
+    the gate — make_exchange refuses (PrecisionGateError) unless the
+    precision checker certifies the narrowing program safe."""
+    from ..geometry import Radius
+    from ..parallel.exchange import make_exchange
+    from ..parallel.methods import Method
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    fs = {"q": _f32((20, 20, 20))}
+    ex = make_exchange(mesh, Radius.constant(1), Method[method_name],
+                       wire_format=fmt, fields_spec=fs)
+    return ex, (dict(fs),)
+
+
+def _wire_exchange_hlo(method_name: str) -> HloSpec:
+    fn, args = _make_exchange_wire_entry(method_name)
+    return HloSpec(fn=fn, args=args, allow=("collective_permute",))
+
+
+def _wire_exchange_cost(method_name: str) -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+    from ..parallel.exchange import exchanged_bytes_per_sweep
+
+    fn, args = _make_exchange_wire_entry(method_name)
+    expected = sum(exchanged_bytes_per_sweep(
+        (10, 10, 10), Radius.constant(1), Dim3(*_EXCHANGE_MESH), 4,
+        wire_format="bf16").values())
+    # the whole point of the format, pinned: bf16 wire bytes are
+    # EXACTLY half the f32 bill (the HLO cross-check then proves the
+    # lowered program pays this figure)
+    full = _sweep_bytes((10, 10, 10), Radius.constant(1),
+                        Dim3(*_EXCHANGE_MESH), 4)
+    assert expected * 2 == full
+    return CostModelSpec(fn=fn, args=args,
+                         expected_bytes_per_shard=expected)
+
+
+@functools.lru_cache(maxsize=None)
 def _megastep_segment_entry():
     import numpy as np
 
@@ -2043,6 +2083,74 @@ _TILING_EXPECT = {
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# precision targets: dtype-flow certification of every exchange/step/
+# segment entry point (checker 13), plus the certified bf16-wire
+# customer's HLO/byte cross-checks
+
+
+def _precision_spec(entry, wire=None, counts=None):
+    from ..geometry import Dim3
+
+    fn, args = entry()
+    return PrecisionSpec(fn=fn, args=args,
+                         wire=dict(wire) if wire else None,
+                         counts=Dim3(*(counts or _EXCHANGE_MESH)))
+
+
+def _wire_format_targets() -> List[Target]:
+    """The bf16 wire format's lowering contract: collective-permute-
+    only, with HLO-observed wire bytes exactly half the f32 bill."""
+    out: List[Target] = []
+    for m in ("PpermuteSlab", "PpermutePacked"):
+        out.append(HloTarget(
+            f"parallel.exchange.make_exchange[{m},wire=bf16,hlo]",
+            lambda m=m: _wire_exchange_hlo(m)))
+        out.append(CostModelTarget(
+            f"parallel.exchange.make_exchange[{m},wire=bf16,bytes]",
+            lambda m=m: _wire_exchange_cost(m)))
+    return out
+
+
+def _precision_targets() -> List[Target]:
+    w32 = {"x": "f32", "y": "f32", "z": "f32"}
+    wbf = {"x": "bf16", "y": "bf16", "z": "bf16"}
+    targets: List[Target] = []
+    for m in ("PpermuteSlab", "PpermutePacked"):
+        targets.append(PrecisionTarget(
+            f"analysis.precision.parallel.exchange.make_exchange[{m}]",
+            lambda m=m: _precision_spec(
+                lambda: _make_exchange_entry(m), wire=w32)))
+        targets.append(PrecisionTarget(
+            f"analysis.precision.parallel.exchange."
+            f"make_exchange[{m},wire=bf16]",
+            lambda m=m: _precision_spec(
+                lambda: _make_exchange_wire_entry(m), wire=wbf)))
+    targets += [
+        PrecisionTarget("analysis.precision.models.jacobi.step_n",
+                        lambda: _precision_spec(_jacobi_step_entry)),
+        PrecisionTarget("analysis.precision.models.astaroth.iter_n",
+                        lambda: _precision_spec(_astaroth_iter_entry,
+                                                counts=(1, 1, 2))),
+        PrecisionTarget("analysis.precision.models.astaroth.segment",
+                        lambda: _precision_spec(
+                            _astaroth_segment_entry, counts=(1, 1, 2))),
+        PrecisionTarget("analysis.precision.parallel.megastep.segment",
+                        lambda: _precision_spec(_megastep_segment_entry)),
+        PrecisionTarget("analysis.precision.distributed.segment",
+                        lambda: _precision_spec(_domain_segment_entry)),
+        PrecisionTarget("analysis.precision.models.pic.step",
+                        lambda: _precision_spec(_pic_step_entry)),
+        PrecisionTarget("analysis.precision.models.pic.segment",
+                        lambda: _precision_spec(_pic_segment_entry)),
+        PrecisionTarget("analysis.precision.serving.ensemble.step_n",
+                        lambda: _precision_spec(_ensemble_step_entry)),
+        PrecisionTarget("analysis.precision.serving.ensemble.segment",
+                        lambda: _precision_spec(_ensemble_segment_entry)),
+    ]
+    return targets
+
+
 def default_targets() -> List[Target]:
     """Every shipped contract stencil-lint proves on each run."""
     targets: List[Target] = [
@@ -2327,6 +2435,12 @@ def default_targets() -> List[Target]:
     # replay-soundness certification of every remote-DMA kernel's
     # semaphore schedule (checker 12)
     targets += _schedule_targets()
+    # the certified bf16 wire customer: HLO/byte proofs that wire
+    # bytes exactly halve
+    targets += _wire_format_targets()
+    # dtype-flow certification of every exchange/step/segment entry
+    # point (checker 13)
+    targets += _precision_targets()
     return targets
 
 

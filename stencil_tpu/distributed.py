@@ -35,7 +35,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .geometry import Dim3, Dim3Like, Radius, Rect3
 from .local_domain import (LocalDomain, get_exterior as _dom_exterior,
                            get_interior as _dom_interior, raw_size, zyx_shape)
-from .parallel.exchange import exchanged_bytes_per_sweep, make_exchange
+from .parallel.exchange import (exchanged_bytes_per_sweep, make_exchange,
+                                normalize_wire_format)
 from .parallel.mesh import make_mesh, mesh_dim
 from .parallel.methods import Method, pick_method
 from .numerics import div_ceil
@@ -65,6 +66,11 @@ class DistributedDomain:
         # allocation pads deepen to s*r so the deep slabs have a home.
         self.exchange_every = 1
         self.alloc_radius = self.radius
+        # halo wire format ("f32" | "bf16" | per-axis dict): a
+        # narrowing format is certificate-gated at realize() —
+        # make_exchange refuses to build unless the precision checker
+        # proves the program safe (analysis/precision.py)
+        self.wire_format = "f32"
         # hierarchical DCN tier (set_dcn_axis); populated by realize()
         self._dcn_requested = False
         self._dcn_axis_req: Optional[int] = None
@@ -147,6 +153,21 @@ class DistributedDomain:
             raise ValueError(f"exchange_every must be >= 1, got {s}")
         self.exchange_every = int(s)
 
+    def set_wire_format(self, fmt) -> None:
+        """Per-axis halo wire format: ``"f32"`` (identity, the
+        default), ``"bf16"`` (halos convert to bfloat16 at the send
+        boundary and widen back on arrival — wire bytes exactly halve;
+        halo MATH is unchanged, every field keeps its storage dtype),
+        or a per-axis dict like ``{"x": "bf16"}``. A narrowing format
+        only realizes behind a ``safe``
+        :class:`~stencil_tpu.analysis.precision.PrecisionCertificate`
+        (``realize()`` raises ``PrecisionGateError`` otherwise) and is
+        supported by the PpermuteSlab/PpermutePacked methods only."""
+        from .parallel.exchange import normalize_wire_format
+        assert self.mesh is None, "set_wire_format before realize()"
+        normalize_wire_format(fmt)  # validate eagerly, fail at the call
+        self.wire_format = fmt
+
     def set_dcn_axis(self, axis: Union[int, str, None] = None,
                      groups=None) -> None:
         """Enable the hierarchical node/slice tier (the NodePartition
@@ -177,7 +198,8 @@ class DistributedDomain:
     def autotune(self, timer=None, use_cache: bool = True,
                  force: bool = False, cache_path=None,
                  max_measurements: int = 4, depths=None,
-                 overlap_options=(False,), topology_path=None):
+                 overlap_options=(False,), topology_path=None,
+                 wire_formats=("f32",)):
         """Measure the live mesh and adopt the fastest exchange plan
         (the measured per-pair transport routing of the reference,
         src/stencil.cu:371-458, as a whole-program decision). Runs the
@@ -206,7 +228,7 @@ class DistributedDomain:
             depths=DEFAULT_DEPTHS if depths is None else depths,
             overlap_options=overlap_options,
             max_measurements=max_measurements,
-            topology_path=topology_path)
+            topology_path=topology_path, wire_formats=wire_formats)
         self.apply_plan(plan)
         return plan
 
@@ -220,6 +242,9 @@ class DistributedDomain:
         self.methods = Method[plan.config.method]
         if plan.config.exchange_every != self.exchange_every:
             self.set_exchange_every(plan.config.exchange_every)
+        wf = getattr(plan.config, "wire_format", "f32")
+        if wf != self.wire_format:
+            self.set_wire_format(wf)
         self.plan = plan
 
     @property
@@ -316,6 +341,14 @@ class DistributedDomain:
             raise NotImplementedError(
                 "Boundary.NONE (zero-Dirichlet exterior) is supported by "
                 "the PpermuteSlab and PpermutePacked methods only")
+        wire_narrows = any(v != "f32" for v in
+                           normalize_wire_format(self.wire_format).values())
+        if wire_narrows and pick_method(self.methods) not in \
+                (Method.PpermuteSlab, Method.PpermutePacked):
+            raise NotImplementedError(
+                f"wire_format {self.wire_format!r} narrows the halo "
+                f"wire, supported only by the PpermuteSlab and "
+                f"PpermutePacked methods")
 
         t0 = time.perf_counter()
         # --- DCN tier + partition: choose the subdomain grid -----------
@@ -382,15 +415,26 @@ class DistributedDomain:
         # the ordinary per-step exchange). Byte counters price the deep
         # slabs; exchange_bytes_amortized_per_step() divides by s.
         t0 = time.perf_counter()
+        wire_kw = {}
+        if wire_narrows:
+            # the precision gate: make_exchange traces the exchange
+            # over these specs, runs checker 13, and REFUSES to build
+            # (PrecisionGateError) unless the certificate is safe
+            wire_kw = dict(
+                wire_format=self.wire_format,
+                fields_spec={q: jax.ShapeDtypeStruct(
+                    zyx_shape(global_padded), self._dtypes[q])
+                    for q in self._names})
         self._exchange_fn = make_exchange(
             self.mesh, self.alloc_radius, self.methods, rem=self.rem,
-            nonperiodic=self.boundary == Boundary.NONE)
+            nonperiodic=self.boundary == Boundary.NONE, **wire_kw)
         counts = mesh_dim(self.mesh)
         self._bytes_per_axis = {"x": 0, "y": 0, "z": 0}
         for q in self._names:
             b = exchanged_bytes_per_sweep(zyx_shape(padded_local),
                                           self.alloc_radius, counts,
-                                          self._dtypes[q].itemsize)
+                                          self._dtypes[q].itemsize,
+                                          wire_format=self.wire_format)
             for k in b:
                 self._bytes_per_axis[k] += b[k]
         self.setup_seconds["plan"] = time.perf_counter() - t0
@@ -508,8 +552,17 @@ class DistributedDomain:
     # ------------------------------------------------------------------
     def exchange_bytes_per_axis(self) -> Dict[str, int]:
         """Bytes one shard puts on the ICI per exchange, per mesh axis
-        (the per-method byte-counter analog)."""
+        (the per-method byte-counter analog). Wire-format aware: a
+        bf16 axis reports its on-wire (halved) bytes."""
         return dict(self._bytes_per_axis)
+
+    @property
+    def precision_certificate(self):
+        """The :class:`~stencil_tpu.analysis.precision.
+        PrecisionCertificate` the realize()-time gate proved for this
+        domain's exchange program — None before realize() and on the
+        identity (all-f32) wire path, where no gate runs."""
+        return getattr(self._exchange_fn, "precision_certificate", None)
 
     def exchange_bytes_total(self) -> int:
         """Total cross-device bytes per exchange over the whole mesh

@@ -49,17 +49,20 @@ def sphere_geometry(gsize: Dim3):
 def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
                       gsize: Dim3, origin_xyz, method: Method,
                       kernel: str = "xla", rem: Dim3 = Dim3(0, 0, 0),
-                      nonperiodic: bool = False):
+                      nonperiodic: bool = False, wire_format=None):
     """One fused Jacobi step on one shard: exchange + 7-point update +
     Dirichlet sphere sources. ``origin_xyz`` is the shard's global
     origin (traced axis_index-derived inside shard_map, or static
     (0,0,0) single-chip). Shared by Jacobi3D and the driver entry.
     ``kernel``: "xla" (fused slicing) or "pallas" (z-plane-pipelined
-    VMEM kernel, ops/pallas_stencil.py)."""
+    VMEM kernel, ops/pallas_stencil.py). ``wire_format`` narrows the
+    halo WIRE only (send-boundary convert, widen on arrival —
+    parallel/exchange.py); the update math runs at storage dtype."""
     hot_c, cold_c, sph_r = sphere_geometry(gsize)
 
     p = dispatch_exchange({"temp": p}, radius, counts, method,
-                          rem=rem, nonperiodic=nonperiodic)["temp"]
+                          rem=rem, nonperiodic=nonperiodic,
+                          wire_format=wire_format)["temp"]
     if kernel == "pallas":
         from ..ops.pallas_stencil import jacobi7_pallas
         new = jacobi7_pallas(p, radius, local)
@@ -267,7 +270,7 @@ class Jacobi3D:
                  kernel: str = "auto", overlap: bool = False,
                  dcn_axis=None, dcn_groups=None,
                  exchange_every: Optional[int] = None,
-                 boundary=None) -> None:
+                 boundary=None, wire_format=None) -> None:
         self.dd = DistributedDomain(x, y, z, devices=devices)
         self.dd.set_radius(1)
         self.dd.set_methods(methods)
@@ -282,6 +285,10 @@ class Jacobi3D:
             self.dd.set_exchange_every(self._exchange_every)
         if boundary is not None:
             self.dd.set_boundary(boundary)
+        if wire_format is not None:
+            # halo wire narrowing (send-boundary bf16, widen on
+            # arrival); realize() below runs the precision gate
+            self.dd.set_wire_format(wire_format)
         if dcn_axis is not None or dcn_groups is not None:
             self.dd.set_dcn_axis(dcn_axis, dcn_groups)
         if placement is not None:
@@ -418,6 +425,10 @@ class Jacobi3D:
         from ..topology import Boundary
         nonper = dd.boundary == Boundary.NONE
         s_every = dd.exchange_every
+        from ..parallel.exchange import normalize_wire_format
+        wire = dd.wire_format
+        wire_narrows = any(v != "f32"
+                           for v in normalize_wire_format(wire).values())
         # single-chip fast path: periodic wrap fused INTO the stencil
         # kernel (no halo storage, no exchange program) — the TPU-native
         # answer to the reference's same-GPU PeerAccessSender shortcut.
@@ -432,7 +443,7 @@ class Jacobi3D:
         # (+-1) z/y shards supported via the kernel's interior-length
         # overlay (x is never sharded here, so rem.x is always 0)
         halo_ok = (counts.x == 1 and not self._overlap and radius_ok
-                   and not nonper)
+                   and not nonper and not wire_narrows)
         # the overlapped fast path: in-kernel RDMA slab exchange hidden
         # behind the interior compute (ops/pallas_overlap.py) — the
         # reference's interior/exchange/exterior choreography as one
@@ -442,7 +453,8 @@ class Jacobi3D:
         overlap_ok = (self._overlap and counts.x == 1
                       and rem == Dim3(0, 0, 0) and radius_ok
                       and local.z >= 4 and local.y >= 2
-                      and not nonper and s_every == 1)
+                      and not nonper and s_every == 1
+                      and not wire_narrows)
         from ..ops.pallas_stencil import on_tpu
         from ..utils.logging import LOG_INFO
         # explicit kernel='halo' with overlap opts into the RDMA overlap
@@ -492,6 +504,11 @@ class Jacobi3D:
                 raise ValueError("exchange_every > 1 is not supported "
                                  "with kernel='pallas' (use xla, wrap "
                                  "or halo)")
+            if wire_narrows:
+                raise NotImplementedError(
+                    "a narrowing wire_format is not supported with "
+                    "exchange_every > 1 (the temporal deep exchange "
+                    "has no wire-narrowing variant yet)")
             self.kernel_path = (f"xla-temporal[s={s_every}]"
                                 + ("-overlap" if self._overlap else ""))
             self._build_temporal_step()
@@ -502,6 +519,12 @@ class Jacobi3D:
         step_fn = (jacobi_shard_step_overlap if self._overlap
                    else jacobi_shard_step)
 
+        if wire_narrows and self._overlap:
+            raise NotImplementedError(
+                "a narrowing wire_format is not supported with "
+                "overlap=True (overlapped_update has no wire-narrowing "
+                "variant yet)")
+
         def shard_step(p):
             from ..parallel.exchange import shard_origin
             origin = shard_origin(local, rem)
@@ -509,7 +532,8 @@ class Jacobi3D:
                 return step_fn(p, radius, counts, local, gsize,
                                origin, method, kernel, nonper)
             return step_fn(p, radius, counts, local, gsize,
-                           origin, method, kernel, rem, nonper)
+                           origin, method, kernel, rem, nonper,
+                           wire_format=wire)
 
         spec = P("z", "y", "x")
         sm = jax.shard_map(shard_step, mesh=dd.mesh, in_specs=spec,

@@ -46,6 +46,66 @@ from .methods import Method, pick_method
 AXIS_TO_DIM = {0: 2, 1: 1, 2: 0}
 AXIS_NAME = {0: "x", 1: "y", 2: "z"}
 
+#: halo WIRE formats: what a slab is converted to at the send boundary
+#: (TEMPI's canonical-datatype pack layer, arXiv:2012.14363). "f32" is
+#: the identity path — full storage precision on the wire; "bf16"
+#: narrows float32 slabs to bfloat16 for the ppermute and widens on
+#: arrival, so halo math runs unchanged at storage precision while
+#: wire bytes exactly halve. Narrower storage dtypes are never
+#: re-narrowed, and non-float lanes always ride at full width.
+WIRE_FORMATS = ("f32", "bf16")
+
+
+def normalize_wire_format(wire_format) -> Dict[str, str]:
+    """Canonical per-axis wire-format map ``{"x"|"y"|"z": fmt}``.
+
+    Accepts ``None`` (full precision), a single format string applied
+    to every mesh axis, or a per-axis dict (missing axes default to
+    "f32") — the per-link declaration surface: bf16 on the DCN axis,
+    f32 on ICI."""
+    if wire_format is None:
+        return {"x": "f32", "y": "f32", "z": "f32"}
+    if isinstance(wire_format, str):
+        if wire_format not in WIRE_FORMATS:
+            raise ValueError(f"unknown wire format {wire_format!r}; "
+                             f"expected one of {WIRE_FORMATS}")
+        return {"x": wire_format, "y": wire_format, "z": wire_format}
+    out = {"x": "f32", "y": "f32", "z": "f32"}
+    for k, v in dict(wire_format).items():
+        if k not in out:
+            raise ValueError(f"unknown mesh axis {k!r} in wire_format")
+        if v not in WIRE_FORMATS:
+            raise ValueError(f"unknown wire format {v!r} for axis "
+                             f"{k!r}; expected one of {WIRE_FORMATS}")
+        out[k] = v
+    return out
+
+
+def wire_dtype(dtype, fmt: str):
+    """The on-wire dtype of a slab stored as ``dtype`` under wire
+    format ``fmt`` — only float32 narrows (to bfloat16); everything
+    else ships at storage width."""
+    if fmt == "bf16" and np.dtype(dtype) == np.dtype(np.float32):
+        return jnp.bfloat16
+    return dtype
+
+
+def wire_elem_size(elem_size: int, fmt: str) -> int:
+    """Byte width of one element on the wire (the cost-model twin of
+    :func:`wire_dtype`): a 4-byte element under "bf16" ships as 2."""
+    if fmt == "bf16" and int(elem_size) == 4:
+        return 2
+    return int(elem_size)
+
+
+def _to_wire(slab, fmt: str):
+    wd = wire_dtype(slab.dtype, fmt)
+    return slab if wd == slab.dtype else slab.astype(wd)
+
+
+def _from_wire(slab, dtype):
+    return slab if slab.dtype == dtype else slab.astype(dtype)
+
 
 def _axis_size(axis_name: str) -> int:
     """Size of a mesh axis from inside shard_map."""
@@ -114,7 +174,8 @@ def exchange_shard(arr: jnp.ndarray, radius: Radius,
                    axis_order: Tuple[int, ...] = (0, 1, 2),
                    rem: Dim3 = Dim3(0, 0, 0),
                    alloc_radius: "Radius | None" = None,
-                   nonperiodic: bool = False) -> jnp.ndarray:
+                   nonperiodic: bool = False,
+                   wire_format=None) -> jnp.ndarray:
     """Fill all halo regions of one padded shard via sequential axis
     sweeps. Must be traced inside ``shard_map`` over mesh axes
     ('x','y','z') when the corresponding mesh_counts entry is > 1.
@@ -138,8 +199,14 @@ def exchange_shard(arr: jnp.ndarray, radius: Radius,
     must not exceed the allocation pads on any face.
     ``nonperiodic``: zero-fill halos across the open global boundary
     (``topology.Boundary.NONE`` — zero-Dirichlet exterior).
+    ``wire_format``: per-axis halo wire format (see
+    :func:`normalize_wire_format`) — a narrowing axis converts the send
+    slab at the wire boundary, one ppermute later widens it back to the
+    storage dtype on arrival; halo math is unchanged. Single-device
+    axes are local copies and always stay at full precision.
     """
     alloc_r = alloc_radius if alloc_radius is not None else radius
+    wf = normalize_wire_format(wire_format)
     for a in axis_order:
         r_lo = radius.face(a, -1)
         r_hi = radius.face(a, 1)
@@ -160,9 +227,13 @@ def exchange_shard(arr: jnp.ndarray, radius: Radius,
 
         # fill the hi-side halo [p_lo+L, p_lo+L+r_hi): data lives at the
         # +a neighbor's interior lo edge [p_lo, p_lo + r_hi)
+        narrow = n_dev > 1 and wf[name] != "f32"
         if r_hi > 0:
             src = lax.slice_in_dim(arr, p_lo, p_lo + r_hi, axis=dim)
-            recv = _shift_from_plus(src, name, n_dev)
+            if narrow:
+                src = _to_wire(src, wf[name])
+            recv = _from_wire(_shift_from_plus(src, name, n_dev),
+                              arr.dtype)
             if nonperiodic:
                 recv = _edge_masked(recv, 1, name, n_dev)
             arr = lax.dynamic_update_slice_in_dim(arr, recv, p_lo + L,
@@ -172,7 +243,10 @@ def exchange_shard(arr: jnp.ndarray, radius: Radius,
         if r_lo > 0:
             src = lax.dynamic_slice_in_dim(arr, p_lo + L - r_lo, r_lo,
                                            axis=dim)
-            recv = _shift_from_minus(src, name, n_dev)
+            if narrow:
+                src = _to_wire(src, wf[name])
+            recv = _from_wire(_shift_from_minus(src, name, n_dev),
+                              arr.dtype)
             if nonperiodic:
                 recv = _edge_masked(recv, -1, name, n_dev)
             arr = lax.dynamic_update_slice_in_dim(arr, recv, p_lo - r_lo,
@@ -368,7 +442,8 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                           axis_order: Tuple[int, ...] = (0, 1, 2),
                           rem: Dim3 = Dim3(0, 0, 0),
                           alloc_radius: "Radius | None" = None,
-                          nonperiodic: bool = False
+                          nonperiodic: bool = False,
+                          wire_format=None
                           ) -> Dict[str, jnp.ndarray]:
     """Multi-quantity exchange with per-direction packing: all
     quantities' slabs for one axis-direction are flattened and
@@ -388,11 +463,15 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
     shapes stay static (capacity-sized slabs), so one program serves
     every shard.
 
-    ``alloc_radius``/``nonperiodic``: same contract as
+    ``alloc_radius``/``nonperiodic``/``wire_format``: same contract as
     :func:`exchange_shard` (deep-carry allocations for temporal
-    blocking; zero-Dirichlet exterior for ``Boundary.NONE``).
+    blocking; zero-Dirichlet exterior for ``Boundary.NONE``; per-axis
+    halo wire narrowing — here the whole packed per-dtype-group buffer
+    narrows once before its single ppermute and widens once on
+    arrival).
     """
     alloc_r = alloc_radius if alloc_radius is not None else radius
+    wf = normalize_wire_format(wire_format)
     names = sorted(arrs.keys())  # sorted so both endpoints agree on
     # layout (reference sorts messages by size, src/packer.cu:69,182-183)
     out = {k: v for k, v in arrs.items()}
@@ -439,8 +518,11 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                     shapes.append(src.shape)
                     slabs.append(src.reshape(-1))
                 packed = jnp.concatenate(slabs) if len(slabs) > 1 else slabs[0]
+                if n_dev > 1 and wf[name] != "f32":
+                    packed = _to_wire(packed, wf[name])
                 moved = (_shift_from_plus(packed, name, n_dev) if side == 1
                          else _shift_from_minus(packed, name, n_dev))
+                moved = _from_wire(moved, dt)
                 if nonperiodic:
                     moved = _edge_masked(moved, side, name, n_dev)
                 # unpack
@@ -514,26 +596,30 @@ def dispatch_exchange(fields: Dict[str, jnp.ndarray], radius: Radius,
                       axis_order: Tuple[int, ...] = (0, 1, 2),
                       rem: Dim3 = Dim3(0, 0, 0),
                       alloc_radius: "Radius | None" = None,
-                      nonperiodic: bool = False) -> Dict[str, jnp.ndarray]:
+                      nonperiodic: bool = False,
+                      wire_format=None) -> Dict[str, jnp.ndarray]:
     """Route a multi-quantity shard exchange to the selected strategy —
     the single dispatch point shared by the orchestrator and the fused
     model steps (the Method-routing analog of src/stencil.cu:371-458).
 
-    ``alloc_radius``/``nonperiodic`` (ppermute methods only): deep-carry
-    allocations for temporal blocking and the zero-Dirichlet exterior
-    of ``Boundary.NONE`` — see :func:`exchange_shard`."""
+    ``alloc_radius``/``nonperiodic``/``wire_format`` (ppermute methods
+    only): deep-carry allocations for temporal blocking, the
+    zero-Dirichlet exterior of ``Boundary.NONE``, and per-axis halo
+    wire narrowing — see :func:`exchange_shard`."""
     uneven = rem != Dim3(0, 0, 0)
+    wf = normalize_wire_format(wire_format)
+    narrows = any(v != "f32" for v in wf.values())
     if uneven and method not in (Method.PpermuteSlab,
                                  Method.PpermutePacked):
         raise NotImplementedError(
             f"uneven (+-1 remainder) subdomains are only supported by "
             f"the PpermuteSlab and PpermutePacked methods, not {method}")
-    if ((alloc_radius is not None or nonperiodic)
+    if ((alloc_radius is not None or nonperiodic or narrows)
             and method not in (Method.PpermuteSlab, Method.PpermutePacked)):
         raise NotImplementedError(
-            f"deep-carry allocations and non-periodic boundaries are "
-            f"only supported by the PpermuteSlab and PpermutePacked "
-            f"methods, not {method}")
+            f"deep-carry allocations, non-periodic boundaries, and "
+            f"narrow wire formats are only supported by the "
+            f"PpermuteSlab and PpermutePacked methods, not {method}")
     if method == Method.PallasDMA:
         from .pallas_exchange import exchange_shard_pallas
         return {k: exchange_shard_pallas(v, radius, mesh_counts, axis_order)
@@ -541,12 +627,12 @@ def dispatch_exchange(fields: Dict[str, jnp.ndarray], radius: Radius,
     if method == Method.PpermutePacked:
         return exchange_shard_packed(fields, radius, mesh_counts,
                                      axis_order, rem, alloc_radius,
-                                     nonperiodic)
+                                     nonperiodic, wf)
     if method == Method.AllGather:
         return {k: exchange_shard_allgather(v, radius, mesh_counts, axis_order)
                 for k, v in fields.items()}
     return {k: exchange_shard(v, radius, mesh_counts, axis_order, rem,
-                              alloc_radius, nonperiodic)
+                              alloc_radius, nonperiodic, wf)
             for k, v in fields.items()}
 
 
@@ -554,7 +640,8 @@ def make_exchange(mesh: Mesh, radius: Radius,
                   methods: Method = Method.Default,
                   axis_order: Tuple[int, ...] = (0, 1, 2),
                   rem: Dim3 = Dim3(0, 0, 0),
-                  nonperiodic: bool = False):
+                  nonperiodic: bool = False,
+                  wire_format=None, fields_spec=None):
     """Build a jitted multi-quantity halo exchange over ``mesh``.
 
     Returns ``exchange(fields: dict[str, Array]) -> dict[str, Array]``
@@ -569,18 +656,62 @@ def make_exchange(mesh: Mesh, radius: Radius,
     copy of every field disappears. Callers must drop their references
     to the passed arrays (``DistributedDomain.exchange`` rebinds
     ``curr`` from the result).
+
+    ``wire_format`` declares the per-axis halo wire dtype ("f32" |
+    "bf16", uniform string or per-axis dict — see
+    :func:`normalize_wire_format`). A NARROWING wire format is
+    certificate-gated: ``fields_spec`` (a ``{name: ShapeDtypeStruct}``
+    dict of the global padded fields) is then required, the precision
+    checker (checker 13, ``analysis/precision.py``) proves the built
+    program's dtype flow sound — declared converts only, reductions at
+    >= f32, exactly the declared wire dtype per link class, no double
+    quantization — and an unsafe certificate raises
+    ``PrecisionGateError`` instead of realizing. The returned callable
+    carries ``wire_format``, ``precision_declaration``, and
+    ``precision_certificate`` attributes.
     """
     method = pick_method(methods)
     counts = Dim3(mesh.shape["x"], mesh.shape["y"], mesh.shape["z"])
     spec = P("z", "y", "x")
+    wf = normalize_wire_format(wire_format)
+    narrows = any(v != "f32" for v in wf.values())
 
     def shard_fn(fields: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         return dispatch_exchange(fields, radius, counts, method, axis_order,
-                                 rem, nonperiodic=nonperiodic)
+                                 rem, nonperiodic=nonperiodic,
+                                 wire_format=wf)
 
     sm = jax.shard_map(shard_fn, mesh=mesh,
                        in_specs=spec, out_specs=spec, check_vma=False)
-    return jax.jit(sm, donate_argnums=0)
+    ex = jax.jit(sm, donate_argnums=0)
+    cert = None
+    if narrows:
+        # the certificate gate: an uncertified narrow wire format
+        # refuses to realize, loudly (the schedule-certifier precedent,
+        # parallel/megastep.certificate_gate)
+        from ..analysis import precision as _precision
+
+        if fields_spec is None:
+            raise ValueError(
+                "make_exchange: a narrowing wire_format is certificate-"
+                "gated — pass fields_spec={name: jax.ShapeDtypeStruct("
+                "global_padded_shape, dtype)} so the precision checker "
+                "can prove the program before it realizes")
+        cert = _precision.certify_wire_format(
+            ex, ({q: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                  for q, v in dict(fields_spec).items()},),
+            counts=counts, wire_formats=wf)
+        if not cert.safe:
+            raise _precision.PrecisionGateError(
+                "make_exchange: wire format "
+                f"{ {k: v for k, v in wf.items()} } is NOT certified "
+                f"safe — refusing to realize: "
+                + "; ".join(cert.reasons))
+    ex.wire_format = dict(wf)
+    ex.precision_declaration = {"wire": {ax: fmt for ax, fmt in wf.items()},
+                                "compute": "float32"}
+    ex.precision_certificate = cert
+    return ex
 
 
 def interior_slab_bytes(shard_zyx: Sequence[int], mesh_counts: Dim3,
@@ -660,14 +791,17 @@ def measure_slab_exchange_seconds(mesh: Mesh, local: Dim3, dtype,
 def exchanged_bytes_per_sweep(shard_padded_shape_zyx: Sequence[int],
                               radius: Radius, mesh_counts: Dim3,
                               elem_size: int,
-                              axis_order: Tuple[int, ...] = (0, 1, 2)
-                              ) -> Dict[str, int]:
+                              axis_order: Tuple[int, ...] = (0, 1, 2),
+                              wire_format=None) -> Dict[str, int]:
     """Per-axis bytes one shard puts on the wire per exchange — the
     byte-counter observability analog (reference: stencil.hpp:86-93,
     src/stencil.cu:516-637). Counts only shifts that cross devices
-    (n_dev > 1); same-device wraps are local copies."""
+    (n_dev > 1); same-device wraps are local copies. A narrowing
+    ``wire_format`` axis prices its elements at the on-wire width
+    (4-byte lanes exactly halve under "bf16")."""
     out = {"x": 0, "y": 0, "z": 0}
     shape = list(shard_padded_shape_zyx)
+    wf = normalize_wire_format(wire_format)
     for a in axis_order:
         dim = AXIS_TO_DIM[a]
         if mesh_counts[a] <= 1:
@@ -676,5 +810,6 @@ def exchanged_bytes_per_sweep(shard_padded_shape_zyx: Sequence[int],
         for d in range(3):
             if d != dim:
                 other *= shape[d]
-        out[AXIS_NAME[a]] = radius.wire_rows(a) * other * elem_size
+        es = wire_elem_size(elem_size, wf[AXIS_NAME[a]])
+        out[AXIS_NAME[a]] = radius.wire_rows(a) * other * es
     return out

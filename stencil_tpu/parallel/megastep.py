@@ -103,7 +103,17 @@ class CarryContract:
       ``pallas_call``, so a chunk is one kernel launch, not an
       unroll). Chunks of 1 are the depth-1 tail;
     * ``donate`` — donate the state pytree end-to-end (default; the
-      audit registry proves the alias map).
+      audit registry proves the alias map);
+    * ``compute_dtype`` — the model's declared minimum accumulation
+      dtype (default ``"float32"``): the precision certifier
+      (``analysis/precision.py``) proves every reduction in the fused
+      segment runs at >= this width even when storage is narrower —
+      the MHD storage/compute split as a proven invariant;
+    * ``wire_formats`` — declared per-axis halo wire formats
+      (``{"x"|"y"|"z": "f32"|"bf16"}`` or a single format string,
+      default None = full-precision wire): the certifier classifies
+      the segment's narrow/widen convert pairs at the ppermute
+      boundary as DECLARED rather than silent.
     """
 
     specs: Any
@@ -111,6 +121,8 @@ class CarryContract:
     probe_extra: Optional[Callable[[Any], Dict[str, Any]]] = None
     stride: int = 1
     donate: bool = True
+    compute_dtype: Optional[str] = "float32"
+    wire_formats: Optional[Any] = None
 
 
 # -- decline-reason vocabulary ----------------------------------------

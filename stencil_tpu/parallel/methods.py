@@ -53,6 +53,19 @@ METHOD_PRIORITY: Tuple["Method", ...] = (
     Method.AllGather)
 
 
+#: strategies whose data plane supports narrow halo wire formats
+#: (wire_format="bf16"): the slab/packed ppermute engines convert at
+#: the send boundary and widen on arrival; the RDMA and all-gather
+#: paths ship raw storage bytes
+WIRE_CAPABLE: Tuple["Method", ...] = (Method.PpermuteSlab,
+                                      Method.PpermutePacked)
+
+
+def method_supports_wire_format(m: "Method") -> bool:
+    """Can this strategy carry a NARROWING halo wire format?"""
+    return m in WIRE_CAPABLE
+
+
 def method_runnable(m: "Method") -> bool:
     """Can this strategy actually EXECUTE in this process? Every
     XLA-collective strategy runs anywhere; PallasDMA (explicit

@@ -73,7 +73,8 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
                  depths: Sequence[int] = DEFAULT_DEPTHS,
                  overlap_options: Sequence[bool] = (False,),
                  max_measurements: int = 4,
-                 runnable=None, topology: "Dict | None" = None) -> Plan:
+                 runnable=None, topology: "Dict | None" = None,
+                 wire_formats: Sequence[str] = ("f32",)) -> Plan:
     """The core search (timer injected — deterministic under
     :class:`FakeTimer`): cache lookup, alpha-beta calibration,
     model-ranked pruning, measurement of the survivors, plan store.
@@ -89,6 +90,12 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
     consumed INSTEAD of pingponging the two global link classes, so a
     machine fingerprinted once never pays calibration again and the
     plan records the full per-axis fabric.
+
+    ``wire_formats``: halo wire formats to enumerate as candidate
+    dimensions (default f32-only; add ``"bf16"`` to rank narrow-wire
+    configurations — the calibrated model prices their halved wire
+    bytes, and realize() only accepts the winner behind a ``safe``
+    :class:`~stencil_tpu.analysis.precision.PrecisionCertificate`).
     """
     fp = fingerprint(inputs)
     if read_cache:
@@ -126,7 +133,8 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
     # --- plan: rank every feasible candidate with the CALIBRATED model
     cands = candidate_space(geom, depths=depths,
                             overlap_options=overlap_options,
-                            runnable=runnable)
+                            runnable=runnable,
+                            wire_formats=wire_formats)
     if not cands:
         raise ValueError("no feasible exchange configuration for this "
                          "geometry (shards smaller than the radius?)")
@@ -134,7 +142,8 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
         c: configured_step_seconds(c.method, geom.shard_interior_zyx,
                                    geom.radius, geom.counts,
                                    geom.elem_sizes, c.exchange_every,
-                                   coeffs, geom.dtype_groups)
+                                   coeffs, geom.dtype_groups,
+                                   wire_format=c.wire_format)
         for c in cands}
     ranked = sorted(cands, key=lambda c: predicted[c])
 
@@ -223,7 +232,8 @@ def inputs_from_domain(dd, dim) -> Dict:
         platform=platform, device_count=len(dd._devices),
         mesh_shape=list(dim), grid=list(dd.size), radius=dd.radius,
         quantities={q: str(dd._dtypes[q]) for q in dd._names},
-        boundary=dd.boundary.name, n_slices=dd.n_slices)
+        boundary=dd.boundary.name, n_slices=dd.n_slices,
+        wire_format=getattr(dd, "wire_format", "f32"))
 
 
 def autotune_domain(dd, timer=None, use_cache: bool = True,
@@ -231,7 +241,8 @@ def autotune_domain(dd, timer=None, use_cache: bool = True,
                     depths: Sequence[int] = DEFAULT_DEPTHS,
                     overlap_options: Sequence[bool] = (False,),
                     max_measurements: int = 4,
-                    topology_path=None) -> Plan:
+                    topology_path=None,
+                    wire_formats: Sequence[str] = ("f32",)) -> Plan:
     """Autotune a configured ``DistributedDomain`` (called by
     ``DistributedDomain.autotune()`` — use that). Chooses the partition
     the orchestrator will use, builds the real :class:`MeshTimer` over
@@ -309,4 +320,4 @@ def autotune_domain(dd, timer=None, use_cache: bool = True,
                         write_cache=use_cache, cache_path=cache_path,
                         depths=depths, overlap_options=overlap_options,
                         max_measurements=max_measurements,
-                        topology=topology)
+                        topology=topology, wire_formats=wire_formats)

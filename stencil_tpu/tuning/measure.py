@@ -77,7 +77,7 @@ class FakeTimer:
         messages, nbytes = exchange_round_model(
             cand.method, geom.shard_interior_zyx, geom.radius,
             geom.counts, geom.elem_sizes, cand.exchange_every,
-            geom.dtype_groups)
+            geom.dtype_groups, wire_format=cand.wire_format)
         t = self.coeffs.seconds(messages, nbytes)
         t *= self.scale.get(cand.method, 1.0)
         if cand.overlap:
@@ -171,11 +171,20 @@ class MeshTimer:
         from ..parallel.methods import Method
 
         deep = geom.radius.deepened(cand.exchange_every)
-        ex = make_exchange(self.mesh, deep, Method[cand.method],
-                           rem=self.rem, nonperiodic=self.nonperiodic)
         dim = mesh_dim(self.mesh)
         padded = raw_size(self.local, deep)
         gshape = zyx_shape(padded * dim)
+        kw = {}
+        if cand.wire_format != "f32":
+            # narrow-wire candidates time the gated engine — the same
+            # certificate-checked program realize() would deploy
+            kw = dict(wire_format=cand.wire_format,
+                      fields_spec={
+                          f"q{i}": jax.ShapeDtypeStruct(gshape, dt)
+                          for i, dt in enumerate(self.dtypes)})
+        ex = make_exchange(self.mesh, deep, Method[cand.method],
+                           rem=self.rem, nonperiodic=self.nonperiodic,
+                           **kw)
         sharding = NamedSharding(self.mesh, P("z", "y", "x"))
         make = {i: jax.jit(lambda dt=dt: jnp.zeros(gshape, dt),
                            out_shardings=sharding)

@@ -45,15 +45,26 @@ _PPERMUTE = ("PpermuteSlab", "PpermutePacked")
 
 @dataclasses.dataclass(frozen=True)
 class Candidate:
-    """One point of the configuration space the tuner sweeps."""
+    """One point of the configuration space the tuner sweeps.
+
+    ``wire_format`` is the halo wire dtype choice ("f32" | "bf16",
+    ``parallel.exchange.WIRE_FORMATS``): "f32" is the identity wire,
+    "bf16" halves the wire bytes on the ppermute engines and only
+    realizes behind a safe :class:`~stencil_tpu.analysis.precision.
+    PrecisionCertificate` (the ``make_exchange`` gate)."""
 
     method: str
     exchange_every: int = 1
     overlap: bool = False
+    wire_format: str = "f32"
 
     def key(self) -> str:
         tag = f"{self.method}[s={self.exchange_every}"
-        return tag + (",overlap]" if self.overlap else "]")
+        if self.overlap:
+            tag += ",overlap"
+        if self.wire_format != "f32":
+            tag += f",wire={self.wire_format}"
+        return tag + "]"
 
     @staticmethod
     def from_key(key: str) -> "Candidate":
@@ -61,7 +72,11 @@ class Candidate:
         rest = rest.rstrip("]")
         parts = rest.split(",")
         s = int(parts[0].split("=")[1])
-        return Candidate(method, s, "overlap" in parts[1:])
+        wire = "f32"
+        for p in parts[1:]:
+            if p.startswith("wire="):
+                wire = p.split("=", 1)[1]
+        return Candidate(method, s, "overlap" in parts[1:], wire)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,6 +117,10 @@ def candidate_feasible(cand: Candidate, geom: TuneGeometry) -> bool:
             return False
         if cand.overlap:
             return False
+        # narrow wire formats ride the send-boundary convert of the
+        # ppermute engines only (parallel.methods.WIRE_CAPABLE)
+        if cand.wire_format != "f32":
+            return False
     if cand.exchange_every < 1:
         return False
     # the deepened radius must fit the SMALLEST shard on every face
@@ -118,13 +137,18 @@ def candidate_feasible(cand: Candidate, geom: TuneGeometry) -> bool:
 def candidate_space(geom: TuneGeometry,
                     depths: Sequence[int] = DEFAULT_DEPTHS,
                     overlap_options: Sequence[bool] = (False,),
-                    runnable: Optional[Callable] = None
+                    runnable: Optional[Callable] = None,
+                    wire_formats: Sequence[str] = ("f32",)
                     ) -> List[Candidate]:
     """Every feasible, runnable configuration, in deterministic
     tie-break order (method priority x depth ascending x overlap off
-    first). ``runnable`` filters strategies the backend cannot execute
-    (capability probes — PallasDMA off-TPU); defaults to
-    ``parallel.methods.method_runnable``."""
+    first x full-precision wire first). ``runnable`` filters
+    strategies the backend cannot execute (capability probes —
+    PallasDMA off-TPU); defaults to
+    ``parallel.methods.method_runnable``. ``wire_formats`` is opt-in:
+    the default sweeps only the identity "f32" wire; pass
+    ``("f32", "bf16")`` to also rank the certified half-width wire on
+    the ppermute engines."""
     from ..parallel.methods import Method, method_runnable
 
     if runnable is None:
@@ -135,9 +159,10 @@ def candidate_space(geom: TuneGeometry,
             continue
         for s in sorted(set(int(d) for d in depths)):
             for ovl in overlap_options:
-                cand = Candidate(name, s, bool(ovl))
-                if candidate_feasible(cand, geom):
-                    out.append(cand)
+                for wf in wire_formats:
+                    cand = Candidate(name, s, bool(ovl), str(wf))
+                    if candidate_feasible(cand, geom):
+                        out.append(cand)
     return out
 
 
@@ -356,9 +381,13 @@ def fingerprint_inputs(platform: str, device_count: int,
                        grid: Sequence[int], radius: Radius,
                        quantities: Dict[str, str],
                        boundary: str, n_slices: int = 1,
-                       library_version: Optional[str] = None) -> Dict:
+                       library_version: Optional[str] = None,
+                       wire_format: str = "f32") -> Dict:
     """The identity a plan is valid for (see module docstring).
-    ``quantities`` maps name -> numpy dtype string."""
+    ``quantities`` maps name -> numpy dtype string. ``wire_format``
+    is part of the identity: a plan tuned for the f32 wire must never
+    replay onto a bf16-wire domain (the measured coefficients price a
+    different byte bill)."""
     if library_version is None:
         from .. import __version__ as library_version
     return {
@@ -371,6 +400,7 @@ def fingerprint_inputs(platform: str, device_count: int,
         "boundary": str(boundary),
         "n_slices": int(n_slices),
         "library_version": str(library_version),
+        "wire_format": str(wire_format),
     }
 
 
@@ -413,7 +443,8 @@ class Plan:
         return Plan(
             config=Candidate(str(cfg["method"]),
                              int(cfg["exchange_every"]),
-                             bool(cfg.get("overlap", False))),
+                             bool(cfg.get("overlap", False)),
+                             str(cfg.get("wire_format", "f32"))),
             fingerprint=str(rec["fingerprint"]),
             coefficients=dict(rec.get("coefficients", {})),
             costs=dict(rec.get("costs", {})),

@@ -1,0 +1,91 @@
+"""Negative controls for the PRECISION-CERTIFICATION checker.
+
+Each target is a step body whose dtype flow violates one of the three
+proof conditions (or narrows silently) — exactly the programs a
+low-precision wire format must never be licensed for. ``python -m
+stencil_tpu.analysis tests/fixtures/lint/bad_precision.py`` MUST exit
+nonzero, naming the violated condition:
+
+* a bf16 ``psum`` accumulation SOLD as f32 (the result is cast back
+  up, but the reduction itself ran below the compute floor) —
+  condition (a);
+* a silent f32 -> bf16 narrowing inside a step body, declared by no
+  wire or compute dtype — a silent convert;
+* a double-quantized wire hop (bf16 -> f32 -> arithmetic -> bf16
+  before ONE ``ppermute``): each quantization compounds error, so
+  narrowing is licensed at most once per hop — condition (c).
+
+Everything here is TRACED, never executed.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from stencil_tpu.analysis import PrecisionSpec, PrecisionTarget
+from stencil_tpu.geometry import Dim3
+from stencil_tpu.parallel.mesh import make_mesh
+
+
+def _mesh2():
+    return make_mesh((1, 1, 2), jax.devices()[:2])
+
+
+def _sharded(shard, wire=None):
+    mesh = _mesh2()
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    return PrecisionSpec(
+        fn=sm, args=(jax.ShapeDtypeStruct((8, 8, 8), jnp.float32),),
+        wire=wire, counts=Dim3(1, 1, 2))
+
+
+def _bf16_psum_sold_as_f32() -> PrecisionSpec:
+    """The classic mixed-precision lie: the reduction runs at bf16 and
+    the result is cast back to f32 — every digit the accumulation lost
+    is still lost, but the output dtype claims full precision."""
+
+    def shard(x):
+        acc = lax.psum(x.astype(jnp.bfloat16), "z")
+        return acc.astype(jnp.float32)
+
+    return _sharded(shard)
+
+
+def _silent_step_narrowing() -> PrecisionSpec:
+    """A step body that quietly round-trips through bf16 (a stray
+    mixed-precision cast, no wire or compute declaration anywhere):
+    the checker must flag the narrowing as a silent convert."""
+
+    def shard(x):
+        y = (x.astype(jnp.bfloat16) * 2).astype(jnp.float32)
+        return y + 1.0
+
+    return _sharded(shard)
+
+
+def _double_quantized_wire_hop() -> PrecisionSpec:
+    """A declared bf16 wire hop whose operand was ALREADY quantized
+    once: bf16 -> f32 -> new arithmetic -> bf16 -> ppermute compounds
+    two independent roundings into one hop's error budget."""
+
+    def shard(x):
+        y = x.astype(jnp.bfloat16).astype(jnp.float32)
+        y = y * 1.5
+        w = y.astype(jnp.bfloat16)
+        n = 2
+        w = lax.ppermute(w, "z", [(i, (i + 1) % n) for i in range(n)])
+        return w.astype(jnp.float32)
+
+    return _sharded(shard, wire={"z": "bf16"})
+
+
+TARGETS = [
+    PrecisionTarget("fixture.precision_bf16_psum_sold_as_f32",
+                    _bf16_psum_sold_as_f32),
+    PrecisionTarget("fixture.precision_silent_step_narrowing",
+                    _silent_step_narrowing),
+    PrecisionTarget("fixture.precision_double_quantized_wire_hop",
+                    _double_quantized_wire_hop),
+]

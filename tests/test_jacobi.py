@@ -64,3 +64,73 @@ def test_jacobi_single_device():
     j.run(4)
     np.testing.assert_allclose(j.temperature(), run_dense(size, 4),
                                rtol=0, atol=1e-13)
+
+
+# ---------------------------------------------------------------------------
+# low-precision halo wire formats (parallel/exchange.py wire_format=,
+# certified by analysis/precision.py)
+
+
+def _wire_pair(size, boundary, wire, steps=5, method=Method.PpermuteSlab):
+    """Run the same campaign twice — full-precision wire vs ``wire`` —
+    and return (reference, narrowed, certificate)."""
+    from stencil_tpu.topology import Boundary
+
+    kw = dict(mesh_shape=(2, 2, 2), dtype=np.float32, kernel="xla",
+              methods=method,
+              boundary=Boundary[boundary] if boundary else None)
+    ref = Jacobi3D(size.x, size.y, size.z, **kw)
+    ref.init()
+    ref.run(steps)
+    jw = Jacobi3D(size.x, size.y, size.z, wire_format=wire, **kw)
+    jw.init()
+    jw.run(steps)
+    return ref.temperature(), jw.temperature(), jw.dd.precision_certificate
+
+
+@pytest.mark.parametrize("boundary", ["PERIODIC", "NONE"])
+@pytest.mark.parametrize("n", [16, 17])
+def test_jacobi_bf16_wire_error_bound(boundary, n):
+    """The certificate's analytic bound is LIVE: a bf16 wire injects at
+    most one 2^-8 relative rounding per halo cell per hop, and the
+    7-point average is a contraction, so ``steps`` steps stay within
+    ``steps * max_rel_error_bound`` of the f32-wire run — on even 16^3
+    and uneven (+-1 remainder) 17^3 grids, periodic and zero-Dirichlet
+    exterior alike. The halo MATH runs at f32: only the wire narrows."""
+    steps = 5
+    size = Dim3(n, n, n)
+    want, got, cert = _wire_pair(size, boundary, "bf16", steps=steps)
+    assert cert is not None and cert.safe
+    assert cert.max_rel_error_bound == 2.0 ** -8  # bf16: 2^-(7+1)
+    assert got.dtype == np.float32  # storage dtype untouched
+    scale = np.abs(want).max()
+    err = np.abs(got - want).max()
+    assert err <= steps * cert.max_rel_error_bound * scale, (err, scale)
+    # non-vacuous: the narrowed wire actually perturbed the halos
+    assert err > 0.0
+
+
+def test_jacobi_bf16_wire_fused_equals_stepwise():
+    """The fused n-step loop and n single steps build the same shard
+    program, so the bf16-wire results are bitwise identical — the wire
+    rounding is deterministic, not noise."""
+    size = Dim3(16, 16, 16)
+    kw = dict(mesh_shape=(2, 2, 2), dtype=np.float32, kernel="xla",
+              methods=Method.PpermutePacked, wire_format="bf16")
+    a = Jacobi3D(size.x, size.y, size.z, **kw)
+    a.init()
+    a.run(4)
+    b = Jacobi3D(size.x, size.y, size.z, **kw)
+    b.init()
+    for _ in range(4):
+        b.step()
+    np.testing.assert_array_equal(a.temperature(), b.temperature())
+
+
+def test_jacobi_f32_wire_is_identity():
+    """``wire_format="f32"`` is the do-nothing declaration: bitwise
+    identical to the undeclared path, no gate, no certificate."""
+    size = Dim3(16, 16, 16)
+    want, got, cert = _wire_pair(size, "PERIODIC", "f32", steps=4)
+    assert cert is None  # identity wire never runs the gate
+    np.testing.assert_array_equal(got, want)

@@ -47,11 +47,11 @@ def test_shipped_registry_is_clean(full_report):
     assert floor >= 105  # the PR 9 acceptance criterion itself
     assert len(report.targets_checked) >= floor
     assert report.ok
-    # all twelve checkers actually ran (and were timed)
+    # all thirteen checkers actually ran (and were timed)
     assert set(report.checker_seconds) == {
         "footprint", "dma", "collectives", "hlo", "costmodel", "vmem",
         "donation", "transfer", "recompile", "tiling", "linkmap",
-        "schedule"}
+        "schedule", "precision"}
 
 
 def test_checker_filter():
@@ -218,6 +218,63 @@ def test_schedule_registry_certifies_fused_kernels(full_report):
         "jacobi7_overlap_pallas[k=4]"]
     assert overlap["max_in_flight"] == 4
     assert overlap["replay"] == 4
+
+
+def test_precision_fixture_flagged():
+    """The three dtype-flow negative controls, each named by its
+    violated condition: the bf16 psum sold as f32 (condition (a)),
+    the silent in-step narrowing, and the double-quantized wire hop
+    (condition (c))."""
+    report = run_targets(load_targets(FIXTURES / "bad_precision.py"))
+    assert not report.ok
+    by_target = {}
+    for f in report.errors:
+        by_target.setdefault(f.target.split(":")[0], []).append(f.message)
+    assert any("(a) accumulation below the compute floor" in m
+               for m in by_target["fixture.precision_bf16_psum_sold_as_f32"])
+    assert any("silent convert" in m
+               for m in by_target["fixture.precision_silent_step_narrowing"])
+    assert any("(c) double quantization" in m
+               for m in by_target[
+                   "fixture.precision_double_quantized_wire_hop"])
+    # the certificates say WHY in the metrics artifact too
+    psum = report.metrics["precision:fixture.precision_bf16_psum_sold_as_f32"]
+    assert psum["safe"] is False
+    assert psum["narrowest_accum"] == "bfloat16"
+    silent = report.metrics[
+        "precision:fixture.precision_silent_step_narrowing"]
+    assert silent["silent_converts"] == [
+        {"from": "float32", "to": "bfloat16", "count": 1}]
+
+
+def test_precision_registry_certifies_shipped_paths(full_report):
+    """The proof the wire-format gate consumes: EVERY registered entry
+    point holds a ``safe`` certificate with zero silent converts, the
+    declared-bf16 exchange targets carry exactly the bf16 wire dtype on
+    every narrowing axis with the analytic 2^-8 bound, and the f32
+    paths certify bitwise-identity wire (bound 0.0)."""
+    certs = {name: m for name, m in full_report.metrics.items()
+             if name.startswith("precision:")}
+    assert len(certs) >= 13, list(certs)
+    for name, m in certs.items():
+        assert m["safe"] is True, (name, m)
+        assert m["silent_converts"] == [], (name, m)
+    bf16 = full_report.metrics[
+        "precision:analysis.precision.parallel.exchange."
+        "make_exchange[PpermuteSlab,wire=bf16]"]
+    assert bf16["max_rel_error_bound"] == 2.0 ** -8
+    for ax, rec in bf16["wire_dtypes"].items():
+        if rec["declared"] == "bf16":
+            assert rec["dtypes"] == ["bfloat16"], (ax, rec)
+    f32 = full_report.metrics[
+        "precision:analysis.precision.parallel.exchange."
+        "make_exchange[PpermuteSlab]"]
+    assert f32["max_rel_error_bound"] == 0.0
+    # accumulation floor held everywhere it was observed
+    for name, m in certs.items():
+        if m["narrowest_accum"] is not None:
+            assert m["narrowest_accum"] in ("float32", "float64"), \
+                (name, m)
 
 
 def test_collectives_fixture_flagged():
@@ -624,7 +681,8 @@ def test_cli_only_accepts_target_globs(tmp_path):
                                      "bad_tiling.py",
                                      "bad_linkmap.py",
                                      "bad_segment_carry.py",
-                                     "bad_schedule.py"])
+                                     "bad_schedule.py",
+                                     "bad_precision.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""

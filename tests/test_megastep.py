@@ -600,8 +600,12 @@ def test_megastep_registry_targets_prove_exact_counts():
         # the fused RDMA segment's schedule certificate (PR 16);
         # pinned by test_lint's schedule tests, excluded from the
         # collective-count audit below (it is traced, not lowered)
-        "analysis.schedule.parallel.megastep.segment[overlap,k=4]"}
-    targets = [t for t in targets if t.checker != "schedule"]
+        "analysis.schedule.parallel.megastep.segment[overlap,k=4]",
+        # the fused segment's dtype-flow certificate (PR 17); pinned
+        # by test_lint's precision tests, likewise traced not lowered
+        "analysis.precision.parallel.megastep.segment"}
+    targets = [t for t in targets
+               if t.checker not in ("schedule", "precision")]
     report = run_targets(targets)
     assert not report.findings, report.findings
     hlo = report.metrics["hlo:parallel.megastep.segment[k=4,hlo]"]
@@ -637,7 +641,12 @@ def test_carry_contract_registry_targets_prove_exact_counts():
         "models.pic.segment[k=4,probe]",
         "models.pic.segment[k=4,donation]",
         "models.astaroth.segment[temporal,s=2,k=4,hlo]",
-        "models.astaroth.segment[temporal,s=2,k=4,cost]"}
+        "models.astaroth.segment[temporal,s=2,k=4,cost]",
+        # the segments' dtype-flow certificates (PR 17) — pinned by
+        # test_lint's precision tests, not re-certified here
+        "analysis.precision.models.pic.segment",
+        "analysis.precision.models.astaroth.segment"}
+    targets = [t for t in targets if t.checker != "precision"]
     report = run_targets(targets)
     assert not report.findings, [str(f) for f in report.findings]
     pic = report.metrics["hlo:models.pic.segment[k=4,hlo]"]

@@ -236,6 +236,58 @@ def test_fingerprint_invalidation_radius_dtype_mesh():
         library_version="99.0", **changed)) != fp
 
 
+def test_fingerprint_invalidation_wire_format():
+    """A cached plan tuned for the f32 wire must NOT be served to a
+    bf16-wire campaign (its measured seconds priced twice the wire
+    bytes) — the wire format is part of the fingerprint key."""
+    base = dict(platform="cpu", device_count=8, mesh_shape=[2, 2, 2],
+                grid=[16, 16, 16], radius=Radius.constant(1),
+                quantities={"q0": "float32"}, boundary="PERIODIC")
+    fp = fingerprint(fingerprint_inputs(**base))
+    # the default IS f32 — spelling it out must not re-key the cache
+    assert fingerprint(fingerprint_inputs(wire_format="f32",
+                                          **base)) == fp
+    assert fingerprint(fingerprint_inputs(wire_format="bf16",
+                                          **base)) != fp
+
+
+def test_candidate_wire_format_space_and_feasibility():
+    """Opting wire formats into the sweep doubles the ppermute
+    candidates only (narrow wire is a slab/packed capability), the
+    bf16 variants rank strictly cheaper than their f32 twins under the
+    calibrated model (half the wire bytes), and the key round-trips."""
+    geom = TuneGeometry(shard_interior_zyx=(8, 8, 8),
+                        min_interior_zyx=(8, 8, 8),
+                        radius=Radius.constant(1), counts=Dim3(2, 2, 2),
+                        elem_sizes=(4,))
+    base = candidate_space(geom, depths=(1,))
+    wired = candidate_space(geom, depths=(1,),
+                            wire_formats=("f32", "bf16"))
+    ppermute = [c for c in base
+                if c.method in ("PpermuteSlab", "PpermutePacked")]
+    assert len(wired) == len(base) + len(ppermute)
+    assert all(c.method in ("PpermuteSlab", "PpermutePacked")
+               for c in wired if c.wire_format == "bf16")
+    coeffs = LinkCoefficients(alpha_s=0.0, beta_bytes_per_s=1e10)
+    for c in wired:
+        if c.wire_format != "bf16":
+            continue
+        twin = next(t for t in wired
+                    if t.method == c.method and t.wire_format == "f32"
+                    and t.exchange_every == c.exchange_every
+                    and t.overlap == c.overlap)
+
+        def price(cand):
+            return configured_step_seconds(
+                cand.method, geom.shard_interior_zyx, geom.radius,
+                geom.counts, geom.elem_sizes, cand.exchange_every,
+                coeffs, wire_format=cand.wire_format)
+
+        assert price(c) < price(twin)
+        assert "wire=bf16" in c.key()
+        assert Candidate.from_key(c.key()) == c
+
+
 # ---------------------------------------------------------------------------
 # the end-to-end search (fake timer; deterministic)
 
