@@ -325,6 +325,13 @@ def emit_bench_artifacts(args, payload, source: str):
                     "link_classes",
                     {k: round(v["share"], 6)
                      for k, v in payload["link_classes"].items()})
+            # wire-layout provenance: which halo message geometry the
+            # measured bytes rode (slab / irredundant packed boxes),
+            # stamped AFTER the fingerprint is fixed — same rule as
+            # above, a note that never forks a trajectory group
+            if payload.get("wire_layout"):
+                rec["config"].setdefault("wire_layout",
+                                         payload["wire_layout"])
             append_record(ledger, rec)
         for s in skipped:
             print(f"{source}: ledger skip: {s}", file=sys.stderr)

@@ -49,6 +49,12 @@ def main() -> None:
     ap.add_argument("--exchange-every", default="1", metavar="S[,S...]",
                     help="temporal-blocking depths to sweep (comma "
                          "list; 1 = the classic per-step exchange)")
+    ap.add_argument("--wire-layout", default="slab", metavar="L[,L...]",
+                    help="halo wire message layouts (comma list of "
+                         "slab,irredundant): the first is the sweep's "
+                         "layout; each EXTRA layout races per-exchange "
+                         "seconds + the blocked Jacobi steps/s against "
+                         "the sweep baseline at its smallest depth")
     ap.add_argument("--json-out", default="", metavar="PATH",
                     help="write the steps/s + byte-model comparison "
                          "as a JSON artifact")
@@ -91,13 +97,21 @@ def main() -> None:
     from stencil_tpu.parallel.mesh import default_mesh_shape
     from stencil_tpu.utils.timers import device_sync
 
+    from stencil_tpu.parallel.packing import WIRE_LAYOUTS
+
     ndev = len(jax.devices())
     mesh_shape = default_mesh_shape(ndev)
     gx, gy, gz = (args.x * mesh_shape.x, args.y * mesh_shape.y,
                   args.z * mesh_shape.z)
     depths = _parse_depths(args.exchange_every)
+    layouts = [t.strip() for t in args.wire_layout.split(",") if t.strip()]
+    bad = [t for t in layouts if t not in WIRE_LAYOUTS]
+    if not layouts or bad:
+        raise SystemExit(f"--wire-layout wants a comma list from "
+                         f"{WIRE_LAYOUTS}, got {args.wire_layout!r}")
+    primary_layout = layouts[0]
 
-    def jacobi_steps_per_s(methods, s):
+    def jacobi_steps_per_s(methods, s, layout=primary_layout):
         """Honest steps/s of the REAL blocked hot path: the Jacobi
         model's fused run loop (deep exchange + sub-steps incl. the
         redundant ring compute) under the given configuration, measured
@@ -105,24 +119,30 @@ def main() -> None:
         (``_common.grouped_steps_per_s``)."""
         j = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape,
                      dtype=np.float32, kernel="xla", methods=methods,
-                     exchange_every=s if s > 1 else None)
+                     exchange_every=s if s > 1 else None,
+                     wire_layout=layout)
         j.init()
         n, dt, sps = grouped_steps_per_s(j.run, j.block, args.iters,
                                          group=s)
         return n, dt, sps, j
 
-    results = []
-    link_classes = None  # baseline depth's classified link map
-    for s in depths:
+    def make_domain(layout=primary_layout, s=1):
         dd = DistributedDomain(gx, gy, gz)
         dd.set_mesh_shape(mesh_shape)
         dd.set_radius(Radius.face_edge_corner(args.fr, args.er, args.cr))
         dd.set_methods(methods_from_args(args))
+        dd.set_wire_layout(layout)
         if s > 1:
             dd.set_exchange_every(s)
         for i in range(args.fields):
             dd.add_data(f"q{i}", np.float32)
         dd.realize()
+        return dd
+
+    results = []
+    link_classes = None  # baseline depth's classified link map
+    for s in depths:
+        dd = make_domain(s=s)
 
         # per-exchange timing (the classic bench line, now per config)
         stats = timed_samples(dd.exchange, lambda: device_sync(dd.curr),
@@ -180,6 +200,49 @@ def main() -> None:
                     }
                     for (axis, klass), b
                     in sorted(link["bytes_per_step"].items())}
+
+    layout_cmp = None
+    if len(layouts) > 1:
+        # wire-layout race: each extra layout re-runs the smallest
+        # swept depth's two measurements (per-exchange seconds on the
+        # domain, blocked Jacobi steps/s on the real hot path) and is
+        # reported as a ratio against the sweep baseline in results[0].
+        # Bytes come from the SAME per-layout model the static analyzer
+        # pins against HLO, so the bytes ratio is exact, not sampled.
+        base = results[0]
+        s0 = depths[0]
+        layout_cmp = {"baseline_layout": primary_layout,
+                      "exchange_every": s0, "races": {}}
+        for layout in layouts[1:]:
+            dd = make_domain(layout=layout, s=s0)
+            stats = timed_samples(dd.exchange,
+                                  lambda: device_sync(dd.curr),
+                                  args.iters)
+            tm = stats.trimean()
+            per_ex = dd.exchange_bytes_total()
+            n, dt, sps, _ = jacobi_steps_per_s(
+                methods_from_args(args), s0, layout=layout)
+            bytes_ratio = (per_ex
+                           / (base["bytes_per_exchange_model"] or 1))
+            sps_ratio = sps / base["steps_per_s"]
+            layout_cmp["races"][layout] = {
+                "bytes_per_exchange_model": per_ex,
+                "bytes_ratio": bytes_ratio,
+                "trimean_exchange_s": tm,
+                "exchange_s_ratio": tm / base["trimean_exchange_s"],
+                "steps_per_s": sps,
+                "steps_per_s_ratio": sps_ratio,
+            }
+            print(csv_line("bench_exchange_layout", layout,
+                           primary_layout, s0, per_ex,
+                           f"{tm:.6e}", f"{bytes_ratio:.4f}",
+                           f"{sps_ratio:.3f}"))
+            print(f"bench_exchange layout: {layout} "
+                  f"{per_ex}B/exchange "
+                  f"({bytes_ratio:.3f}x {primary_layout} bytes) "
+                  f"{sps:.3f} steps/s "
+                  f"(x{sps_ratio:.2f} blocked loop)",
+                  file=sys.stderr)
 
     autotune_cmp = None
     if args.autotune:
@@ -333,7 +396,13 @@ def main() -> None:
             "steps_per_s_ratio": {
                 k: r["steps_per_s"] / base["steps_per_s"]
                 for k, r in results_by_s.items()},
+            # the halo message geometry the whole sweep rode — the
+            # ledger stamps this into config (post-fingerprint) so
+            # observatory queries can split slab vs irredundant runs
+            "wire_layout": primary_layout,
         }
+        if layout_cmp is not None:
+            comparison["wire_layout_race"] = layout_cmp
         if autotune_cmp is not None:
             comparison["autotune"] = autotune_cmp
         if fused_cmp is not None:

@@ -124,9 +124,36 @@ wired = {k: v for k, v in certs.items() if any(
 assert wired, "no declared-narrowing wire targets registered"
 for k, v in wired.items():
     assert v["max_rel_error_bound"] > 0, (k, v)
+# the irredundant wire layout must hold its own safe certificates —
+# the layout reroutes every halo byte through the packed-box pack/
+# unpack path, and dropping its registry entries would let a dtype
+# regression in that path ship unproven
+irr = [k for k, v in certs.items()
+       if "layout=irredundant" in k and v.get("safe")]
+assert irr, "no safe irredundant-layout precision certificate " \
+    "registered (make_exchange[...,layout=irredundant])"
+fp8 = [k for k, v in certs.items() if "wire=e4m3" in k and v.get("safe")]
+assert fp8, "no safe fp8 wire certificate registered"
 print(f"precision certificates OK: {len(certs)} target(s) all safe, "
-      f"{len(wired)} narrow-wire declaration(s) certified")
+      f"{len(wired)} narrow-wire declaration(s) certified, "
+      f"{len(irr)} irredundant-layout, {len(fp8)} fp8")
 EOF
+# the pack-layout report (parallel/packing.py): slab-vs-irredundant
+# modeled wire bytes for the canonical exchange configs — the numbers
+# the registry's CostModel targets just pinned HLO-exactly above,
+# archived standalone next to the precision certificates so TPU runs
+# can read the expected savings without re-deriving the model
+python - > pack_layout_report.json <<'EOF'
+import json
+from stencil_tpu.parallel.packing import pack_layout_report
+rep = pack_layout_report()
+assert rep and all(r["irredundant_bytes"] < r["slab_bytes"]
+                   for r in rep.values()), rep
+json.dump(rep, __import__("sys").stdout, indent=1)
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ] && [ -f pack_layout_report.json ]; then
+  cp pack_layout_report.json "$CI_ARTIFACT_DIR/"
+fi
 # the link observatory artifact: the modeled per-link traffic matrix
 # (whose per-method totals the linkmap checker just pinned HLO-exactly
 # above) plus the placement-quality report — QAP placement cost must
@@ -236,8 +263,10 @@ OBS_LEDGER="$(mktemp -t obs_ledger.XXXXXX.jsonl)"; rm -f "$OBS_LEDGER"
   python bench_exchange.py --x 8 --y 8 --z 8 --iters 20 --fake-cpu 8 \
         --exchange-every 1,4 --autotune --tune-cache "$TUNE_CACHE" \
         --fuse-segments --check-every 8 \
+        --wire-layout slab,irredundant \
         --json-out "$BENCH_JSON" --metrics-json "$BENCH_METRICS" )
-BENCH_JSON="$BENCH_JSON" BENCH_METRICS="$BENCH_METRICS" python - <<'EOF'
+BENCH_JSON="$BENCH_JSON" BENCH_METRICS="$BENCH_METRICS" \
+OBS_LEDGER="$OBS_LEDGER" python - <<'EOF'
 import json
 import os
 d = json.load(open(os.environ["BENCH_JSON"]))
@@ -303,6 +332,20 @@ for key, v in lc.items():
                          axis=axis, link_class=klass)
     assert got == v["utilization"], (key, got, v)
     assert 0 < v["utilization"] < 1, (key, v)
+# wire-layout race: the irredundant leg must move strictly fewer
+# modeled bytes than the slab baseline (the static analyzer pinned the
+# exact figures against HLO in stage 1; here the measured race must
+# exist and agree with the model's direction), and the ledger record
+# this run appended must carry the layout provenance stamp
+assert d["wire_layout"] == "slab", d["wire_layout"]
+race = d["wire_layout_race"]["races"]["irredundant"]
+assert 0 < race["bytes_ratio"] < 1, race
+assert race["steps_per_s"] > 0, race
+led = [json.loads(l) for l in open(os.environ["OBS_LEDGER"])
+       if l.strip()]
+mine = [r for r in led if r.get("bench") == "bench_exchange"]
+assert mine and mine[-1]["config"].get("wire_layout") == "slab", \
+    "ledger record missing config.wire_layout stamp"
 print(f"bench smoke OK: rounds/step x{1/rounds['4']:.0f} fewer, "
       f"steps/s ratio {speed['4']:.2f}, tuned/default "
       f"x{at['tuned_over_default']:.2f} "

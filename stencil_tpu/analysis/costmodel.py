@@ -97,42 +97,70 @@ def io_bytes(closed) -> int:
     return total
 
 
+def sweep_wire_bytes(shard_padded_zyx: Sequence[int], radius, counts,
+                     elem_size: int,
+                     axis_order: Tuple[int, ...] = (0, 1, 2),
+                     wire_format=None, layout: str = "slab",
+                     alloc_radius=None) -> Dict[str, int]:
+    """Per-axis wire bytes one shard ships per exchange round, under
+    either wire layout — the single byte-model entry the tuner, the
+    runtime counters, and the registry cost targets share. "slab"
+    delegates to ``parallel.exchange.exchanged_bytes_per_sweep``
+    (full-allocation cross-sections); "irredundant" to
+    ``parallel.packing.irredundant_bytes_per_sweep`` (each wire-halo
+    cell priced exactly once)."""
+    from ..parallel.exchange import exchanged_bytes_per_sweep
+    from ..parallel.packing import (irredundant_bytes_per_sweep,
+                                    normalize_wire_layout)
+
+    if normalize_wire_layout(layout) == "irredundant":
+        return irredundant_bytes_per_sweep(
+            shard_padded_zyx, radius, counts, elem_size, axis_order,
+            wire_format=wire_format, alloc_radius=alloc_radius)
+    return exchanged_bytes_per_sweep(shard_padded_zyx, radius, counts,
+                                     elem_size, axis_order,
+                                     wire_format=wire_format)
+
+
 def deep_exchange_bytes_per_shard(shard_interior_zyx: Sequence[int],
                                   radius, counts, elem_size: int,
-                                  steps: int) -> int:
+                                  steps: int,
+                                  wire_layout: str = "slab") -> int:
     """Wire bytes ONE shard puts on the ICI per ``steps``-deep exchange
     (temporal blocking): the deepened radius' rows over the DEEPENED
-    padded cross-sections — the same ``exchanged_bytes_per_sweep``
-    source of truth the runtime counters and the HLO cross-check use,
-    evaluated on the deep allocation."""
-    from ..parallel.exchange import exchanged_bytes_per_sweep
-
+    padded cross-sections — the same ``sweep_wire_bytes`` source of
+    truth the runtime counters and the HLO cross-check use, evaluated
+    on the deep allocation under the selected wire layout."""
     deep = radius.deepened(steps)
     lo, hi = deep.pad_lo(), deep.pad_hi()
     z, y, x = shard_interior_zyx
     padded = (z + lo.z + hi.z, y + lo.y + hi.y, x + lo.x + hi.x)
-    return sum(exchanged_bytes_per_sweep(padded, deep, counts,
-                                         elem_size).values())
+    return sum(sweep_wire_bytes(padded, deep, counts, elem_size,
+                                layout=wire_layout).values())
 
 
 def amortized_step_wire_bytes(shard_interior_zyx: Sequence[int],
                               radius, counts, elem_size: int,
-                              steps: int) -> float:
+                              steps: int,
+                              wire_layout: str = "slab") -> float:
     """Per-shard wire bytes charged to each STEP under ``steps``-deep
     blocking: the deep exchange's bytes spread over the ``steps`` steps
     it feeds. Rows amortize back to the base count but the slab
     cross-sections carry the ``2*steps*r`` allocation growth — bytes
     stay ~flat while exchange ROUNDS drop ``steps``x, which is the
-    entire temporal-blocking trade."""
+    entire temporal-blocking trade (the irredundant layout claws the
+    cross-section growth back, which is why its win scales with s)."""
     return deep_exchange_bytes_per_shard(shard_interior_zyx, radius,
-                                         counts, elem_size, steps) / steps
+                                         counts, elem_size, steps,
+                                         wire_layout) / steps
 
 
 def migration_record_rows(n_fields: int) -> int:
     """Rows of one particle-migration wire record: the SoA fields plus
-    the three riding offset components and the validity flag — the one
-    constant the engine packs (``parallel.migrate.RECORD_EXTRA_ROWS``),
-    re-exported here so the byte model cannot drift from the packer."""
+    ``parallel.migrate.RECORD_EXTRA_ROWS`` packed control rows — the
+    engine's one packing constant, re-exported here so the byte model
+    cannot drift from the packer (whatever the record format packs the
+    offsets and validity into, both sides count the same rows)."""
     from ..parallel.migrate import migration_record_rows as rows
 
     return rows(n_fields)
@@ -238,7 +266,8 @@ def exchange_round_model(method_name: str,
                          counts, elem_sizes: Sequence[int],
                          steps: int = 1,
                          dtype_groups: "int | None" = None,
-                         wire_format=None) -> Tuple[int, int]:
+                         wire_format=None,
+                         wire_layout: str = "slab") -> Tuple[int, int]:
     """Analytic (messages, wire_bytes) ONE shard contributes per deep
     exchange round under strategy ``method_name`` — the per-method
     refinement of :func:`deep_exchange_bytes_per_shard` the autotuner
@@ -262,9 +291,9 @@ def exchange_round_model(method_name: str,
     payload at the on-wire width (a bf16 axis halves its 4-byte
     lanes) — only the ppermute engines carry narrow formats, and the
     certificate gate enforces that before any such plan realizes.
+    ``wire_layout`` likewise prices the message shape ("slab" |
+    "irredundant") for the ppermute engines only.
     """
-    from ..parallel.exchange import exchanged_bytes_per_sweep
-
     deep = radius.deepened(steps)
     lo, hi = deep.pad_lo(), deep.pad_hi()
     z, y, x = shard_interior_zyx
@@ -290,12 +319,13 @@ def exchange_round_model(method_name: str,
     # only the slab/packed ppermute engines implement narrow wire
     # formats (parallel.methods.WIRE_CAPABLE); everything else ships
     # storage bytes
-    wf = (wire_format if method_name in ("PpermuteSlab",
-                                         "PpermutePacked") else None)
+    wire_capable = method_name in ("PpermuteSlab", "PpermutePacked")
+    wf = wire_format if wire_capable else None
+    layout = wire_layout if wire_capable else "slab"
     nbytes = 0
     for esize in elem_sizes:
-        per_axis = exchanged_bytes_per_sweep(padded, deep, counts,
-                                             esize, wire_format=wf)
+        per_axis = sweep_wire_bytes(padded, deep, counts, esize,
+                                    wire_format=wf, layout=layout)
         for name, b in per_axis.items():
             if method_name == "AllGather":
                 b *= gather_factor.get(name, 1)
@@ -309,7 +339,8 @@ def configured_step_seconds(method_name: str,
                             steps: int,
                             coeffs: LinkCoefficients = DEFAULT_ICI_COEFFS,
                             dtype_groups: "int | None" = None,
-                            wire_format=None) -> float:
+                            wire_format=None,
+                            wire_layout: str = "slab") -> float:
     """Alpha-beta exchange seconds per STEP of one (method,
     exchange_every) configuration: the deep round's cost spread over
     the ``steps`` steps it feeds — :func:`temporal_step_exchange_seconds`
@@ -317,7 +348,8 @@ def configured_step_seconds(method_name: str,
     with MEASURED coefficients to prune the sweep before timing."""
     messages, nbytes = exchange_round_model(
         method_name, shard_interior_zyx, radius, counts, elem_sizes,
-        steps, dtype_groups, wire_format=wire_format)
+        steps, dtype_groups, wire_format=wire_format,
+        wire_layout=wire_layout)
     return coeffs.seconds(messages, nbytes) / steps
 
 

@@ -43,6 +43,7 @@ WIRE_COLLECTIVES = ("collective_permute", "all_gather", "all_reduce",
 # StableHLO element type -> bytes (the types the framework can emit)
 _MLIR_ELEM_BYTES = {
     "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8E4M3FN": 1, "f8E5M2": 1,
     "i64": 8, "i32": 4, "i16": 2, "i8": 1, "i1": 1,
     "ui64": 8, "ui32": 4, "ui16": 2, "ui8": 1,
     "c64": 8, "c128": 16,

@@ -42,9 +42,9 @@ loudly, :class:`PrecisionGateError` — unless
 :func:`certify_wire_format` proves the built program safe.  The
 per-hop error bound is analytic: round-to-nearest narrowing to a
 p-bit significand perturbs each halo element by a relative error of
-at most ``2**-p`` (bf16: ``2**-8``), and ``wire_format="f32"`` is the
-bitwise identity path (bound 0.0) — both pinned by the Jacobi
-fused-vs-stepwise tests.
+at most ``2**-p`` (bf16: ``2**-8``; fp8 e4m3: ``2**-4``; fp8 e5m2:
+``2**-3``), and ``wire_format="f32"`` is the bitwise identity path
+(bound 0.0) — both pinned by the Jacobi fused-vs-stepwise tests.
 
 Like every checker here the pass is trace-only (``jax.make_jaxpr``
 over ``ShapeDtypeStruct``s): no FLOPs, no devices, seconds on a
@@ -173,14 +173,18 @@ def declared_pairs_for(wire: Optional[Dict[str, str]],
                        extra: Sequence[Tuple[str, str]] = ()
                        ) -> frozenset:
     """The set of (src, dst) narrowing conversions the declarations
-    name: each bf16 wire axis declares float32 -> bfloat16 (the send
-    boundary; the widen back is lossless and needs no declaration),
-    and a storage/compute split declares compute -> storage (the
-    store-back of an MHD-style bf16-storage / f32-compute model)."""
+    name: each narrowing wire axis declares float32 -> its wire dtype
+    (``parallel.exchange.WIRE_DTYPE_NAMES`` — bf16/e4m3/e5m2; the
+    send boundary only, the widen back is lossless and needs no
+    declaration), and a storage/compute split declares compute ->
+    storage (the store-back of an MHD-style bf16-storage /
+    f32-compute model)."""
+    from ..parallel.exchange import WIRE_DTYPE_NAMES
+
     pairs = set(tuple(p) for p in extra)
     for fmt in (wire or {}).values():
-        if fmt == "bf16":
-            pairs.add(("float32", "bfloat16"))
+        if fmt != "f32" and fmt in WIRE_DTYPE_NAMES:
+            pairs.add(("float32", WIRE_DTYPE_NAMES[fmt]))
     if storage_dtype is not None and compute_dtype is not None \
             and _is_float(storage_dtype) and _is_float(compute_dtype) \
             and _nmant(storage_dtype) < _nmant(compute_dtype):
@@ -517,12 +521,13 @@ def _certify(name: str, closed: ClosedJaxpr, spec: PrecisionSpec
         ctx.fail(f"silent convert: {src} -> {dst} ({n}x) is a lossy "
                  f"narrowing named by no wire/compute declaration")
     if ctx.wire is not None:
+        from ..parallel.exchange import WIRE_DTYPE_NAMES
+
         for ax, fmt in sorted(ctx.wire.items()):
             if fmt != "f32" and link_classes.get(ax) != "self":
                 ctx.max_bound = max(
                     ctx.max_bound,
-                    rel_error_bound("bfloat16" if fmt == "bf16"
-                                    else fmt))
+                    rel_error_bound(WIRE_DTYPE_NAMES.get(fmt, fmt)))
     narrowest = None
     for dtn in ctx.accum_dtypes:
         narrowest = (dtn if narrowest is None

@@ -657,6 +657,211 @@ def _hlo_from_collective(build, allow=("collective_permute",)) -> HloSpec:
     return HloSpec(fn=cs.fn, args=cs.args, allow=tuple(allow))
 
 
+# ---------------------------------------------------------------------------
+# irredundant wire-layout targets: the packed layout (parallel/
+# packing.py) keeps the slab engine's collective bill — 2 ppermutes
+# per active radius direction — but each sweep ships only the rows no
+# earlier sweep already delivered, so every halo cell crosses the wire
+# exactly once. Each registered slab config gets an irredundant twin
+# under the same three gates (ppermute bijection, collective-permute-
+# only lowering, analytic-vs-HLO byte equality), with the byte
+# expectation additionally pinned STRICTLY below the slab bill for
+# every config carrying a diagonal (edge/corner) ride-along.
+# tests/fixtures/lint/bad_packing.py (a fat slab program sold under
+# the irredundant byte model) is the negative control.
+
+
+def _irr_bytes(shard_padded_zyx, radius, counts, elem_size,
+               wire_format=None, alloc_radius=None) -> int:
+    from .costmodel import sweep_wire_bytes
+
+    return sum(sweep_wire_bytes(shard_padded_zyx, radius, counts,
+                                elem_size, wire_format=wire_format,
+                                layout="irredundant",
+                                alloc_radius=alloc_radius).values())
+
+
+def _exchange_irr_spec(radius_kind: str) -> CollectiveSpec:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.exchange import exchange_shard
+    from ..parallel.mesh import mesh_dim
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = _exchange_radius(radius_kind)
+
+    def shard(p):
+        return exchange_shard(p, radius, counts,
+                              wire_layout="irredundant")
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    return CollectiveSpec(fn=sm, args=(_f32(_EXCHANGE_GLOBAL),),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _exchange_irr_hlo(radius_kind: str) -> HloSpec:
+    cs = _exchange_irr_spec(radius_kind)
+    # the layout shrinks messages, never their count: same ppermute
+    # bill as the slab engine (one per nonzero radius direction)
+    n = {"r1": 6, "r3": 6, "asym": 3}[radius_kind]
+    return HloSpec(fn=cs.fn, args=cs.args,
+                   allow=("collective_permute",),
+                   exact_counts={"collective_permute": n})
+
+
+def _exchange_irr_cost(radius_kind: str) -> CostModelSpec:
+    from ..geometry import Dim3
+
+    cs = _exchange_irr_spec(radius_kind)
+    counts = Dim3(*_EXCHANGE_MESH)
+    radius = _exchange_radius(radius_kind)
+    expected = _irr_bytes(_exchange_shard_shape(), radius, counts, 4)
+    # the layout's contract, pinned: strictly below the slab bill
+    # (every registered config has a diagonal carry to shed)
+    assert expected < _sweep_bytes(_exchange_shard_shape(), radius,
+                                   counts, 4)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _exchange_packed_irr_uneven_spec() -> CollectiveSpec:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Dim3, Radius
+    from ..parallel.exchange import exchange_shard_packed
+    from ..parallel.mesh import mesh_dim
+
+    mesh = _mesh((2, 2, 2))
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+    rem = Dim3(1, 1, 1)
+
+    def shard(fields):
+        return exchange_shard_packed(fields, radius, counts, rem=rem,
+                                     wire_layout="irredundant")
+
+    spec = {"a": P("z", "y", "x"), "b": P("z", "y", "x")}
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=(spec,),
+                       out_specs=spec, check_vma=False)
+    fields = {"a": _f32((20, 20, 20)),
+              "b": jax.ShapeDtypeStruct((20, 20, 20), jnp.bfloat16)}
+    return CollectiveSpec(fn=sm, args=(fields,),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _packed_irr_uneven_cost() -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+
+    cs = _exchange_packed_irr_uneven_spec()
+    r = Radius.constant(1)
+    counts = Dim3(2, 2, 2)
+    # capacity shard (10,10,10); static irredundant boxes — a short
+    # shard's overhang rows are dead slack or halo rows a later sweep
+    # rewrites, so uneven remainders change nothing on the wire
+    expected = (_irr_bytes((10, 10, 10), r, counts, 4)
+                + _irr_bytes((10, 10, 10), r, counts, 2))
+    assert expected < (_sweep_bytes((10, 10, 10), r, counts, 4)
+                       + _sweep_bytes((10, 10, 10), r, counts, 2))
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _temporal_irr_spec(s: int = 2) -> CollectiveSpec:
+    """The temporal-blocking fused group on irredundant wire boxes —
+    where the layout's win is largest: the deep slab's diagonal carry
+    grows with s^2 while the irredundant boxes grow only with s."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Radius
+    from ..ops.stencil_kernels import jacobi7
+    from ..parallel.mesh import mesh_dim
+    from ..parallel.methods import Method
+    from ..parallel.temporal import temporal_shard_steps
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+
+    def upd(blocks, dims, off, k):
+        return {"q": jacobi7(blocks["q"], radius, dims)}
+
+    def shard(p):
+        return temporal_shard_steps({"q": p}, radius, counts,
+                                    Method.PpermuteSlab, upd, s,
+                                    wire_layout="irredundant")["q"]
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    side = (8 + 2 * s)
+    g = tuple(side * m for m in _EXCHANGE_MESH)
+    return CollectiveSpec(fn=sm, args=(_f32(g),),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _temporal_irr_cost(s: int = 2) -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+    from .costmodel import deep_exchange_bytes_per_shard
+
+    cs = _temporal_irr_spec(s)
+    expected = deep_exchange_bytes_per_shard(
+        (8, 8, 8), Radius.constant(1), Dim3(*_EXCHANGE_MESH), 4, s,
+        wire_layout="irredundant")
+    assert expected < deep_exchange_bytes_per_shard(
+        (8, 8, 8), Radius.constant(1), Dim3(*_EXCHANGE_MESH), 4, s)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
+def _deep_tail_irr_spec() -> CollectiveSpec:
+    """The partial-depth tail exchange, irredundant: wire-radius boxes
+    on the DEEP allocation — extension spans sized by the wire radius,
+    so the tail sheds the deep slab's fat cross-sections entirely."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Radius
+    from ..parallel.exchange import exchange_shard
+    from ..parallel.mesh import mesh_dim
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+
+    def shard(p):
+        return exchange_shard(p, radius, counts,
+                              alloc_radius=radius.deepened(2),
+                              wire_layout="irredundant")
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    g = tuple(12 * m for m in _EXCHANGE_MESH)
+    return CollectiveSpec(fn=sm, args=(_f32(g),),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _deep_tail_irr_cost() -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+
+    cs = _deep_tail_irr_spec()
+    r = Radius.constant(1)
+    expected = _irr_bytes((12, 12, 12), r, Dim3(*_EXCHANGE_MESH), 4,
+                          alloc_radius=r.deepened(2))
+    assert expected < _sweep_bytes((12, 12, 12), r,
+                                   Dim3(*_EXCHANGE_MESH), 4)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
 def _rdma_hlo_spec() -> HloSpec:
     """The PallasDMA exchange method: off-TPU the checker records a
     capability-gate skip (pallas_call cannot lower there); on a TPU
@@ -688,14 +893,16 @@ def _plan_depths():
     return DEFAULT_DEPTHS
 
 
-def _plan_exchange_spec(method_name: str, s: int) -> CollectiveSpec:
+def _plan_exchange_spec(method_name: str, s: int,
+                        layout: str = "slab") -> CollectiveSpec:
     from ..geometry import Radius
     from ..parallel.exchange import make_exchange
     from ..parallel.methods import Method
 
     mesh = _mesh(_EXCHANGE_MESH)
     deep = Radius.constant(1).deepened(s)
-    ex = make_exchange(mesh, deep, Method[method_name])
+    ex = make_exchange(mesh, deep, Method[method_name],
+                       wire_layout=layout)
     side = _PLAN_INTERIOR + 2 * s
     g = tuple(side * m for m in _EXCHANGE_MESH)
     return CollectiveSpec(fn=ex, args=({"q": _f32(g)},),
@@ -703,21 +910,25 @@ def _plan_exchange_spec(method_name: str, s: int) -> CollectiveSpec:
                           expect_ppermute=(method_name != "AllGather"))
 
 
-def _plan_exchange_hlo(method_name: str, s: int) -> HloSpec:
+def _plan_exchange_hlo(method_name: str, s: int,
+                       layout: str = "slab") -> HloSpec:
     allow = (("all_gather",) if method_name == "AllGather"
              else ("collective_permute",))
     return _hlo_from_collective(
-        lambda: _plan_exchange_spec(method_name, s), allow=allow)
+        lambda: _plan_exchange_spec(method_name, s, layout),
+        allow=allow)
 
 
-def _plan_exchange_cost(method_name: str, s: int) -> CostModelSpec:
+def _plan_exchange_cost(method_name: str, s: int,
+                        layout: str = "slab") -> CostModelSpec:
     from ..geometry import Dim3, Radius
+    from .costmodel import sweep_wire_bytes
 
-    cs = _plan_exchange_spec(method_name, s)
+    cs = _plan_exchange_spec(method_name, s, layout)
     side = _PLAN_INTERIOR + 2 * s
-    expected = _sweep_bytes((side, side, side),
-                            Radius.constant(1).deepened(s),
-                            Dim3(*_EXCHANGE_MESH), 4)
+    expected = sum(sweep_wire_bytes(
+        (side, side, side), Radius.constant(1).deepened(s),
+        Dim3(*_EXCHANGE_MESH), 4, layout=layout).values())
     return CostModelSpec(fn=cs.fn, args=cs.args,
                          expected_bytes_per_shard=expected)
 
@@ -734,6 +945,18 @@ def _plan_targets() -> List[Target]:
         targets.append(CostModelTarget(
             f"tuning.plan[{method},s={s},cost]",
             lambda m=method, d=s: _plan_exchange_cost(m, d)))
+    # the tuner's wire-layout axis (candidate keys
+    # ``...,layout=irredundant``): one audited irredundant plan per
+    # ppermute method, at a representative depth each
+    for method, s in (("PpermuteSlab", 2), ("PpermutePacked", 4)):
+        targets.append(HloTarget(
+            f"tuning.plan[{method},s={s},layout=irredundant,hlo]",
+            lambda m=method, d=s: _plan_exchange_hlo(
+                m, d, "irredundant")))
+        targets.append(CostModelTarget(
+            f"tuning.plan[{method},s={s},layout=irredundant,cost]",
+            lambda m=method, d=s: _plan_exchange_cost(
+                m, d, "irredundant")))
     # the RDMA plan path (emittable on TPU only) — same audited spec
     # as parallel.pallas_exchange.exchange_shard_pallas[hlo]
     targets.append(HloTarget("tuning.plan[PallasDMA,s=1,hlo]",
@@ -1136,6 +1359,18 @@ def _linkmap_exchange_spec(radius_kind: str) -> LinkmapSpec:
     traffic = sweep_traffic(_exchange_shard_shape(),
                             _exchange_radius(radius_kind),
                             Dim3(*_EXCHANGE_MESH), (4,))
+    return LinkmapSpec(fn=cs.fn, args=cs.args, traffic=traffic)
+
+
+def _linkmap_exchange_irr_spec(radius_kind: str) -> LinkmapSpec:
+    from ..geometry import Dim3
+    from ..observatory.linkmap import sweep_traffic
+
+    cs = _exchange_irr_spec(radius_kind)
+    traffic = sweep_traffic(_exchange_shard_shape(),
+                            _exchange_radius(radius_kind),
+                            Dim3(*_EXCHANGE_MESH), (4,),
+                            layout="irredundant")
     return LinkmapSpec(fn=cs.fn, args=cs.args, traffic=traffic)
 
 
@@ -1578,6 +1813,84 @@ def _wire_exchange_cost(method_name: str) -> CostModelSpec:
     full = _sweep_bytes((10, 10, 10), Radius.constant(1),
                         Dim3(*_EXCHANGE_MESH), 4)
     assert expected * 2 == full
+    return CostModelSpec(fn=fn, args=args,
+                         expected_bytes_per_shard=expected)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_exchange_layout_entry(method_name: str):
+    """The jitted orchestrator under the irredundant wire layout —
+    the exact engine ``DistributedDomain.realize`` deploys when
+    ``wire_layout="irredundant"`` is set or a tuned plan carries it."""
+    from ..geometry import Radius
+    from ..parallel.exchange import make_exchange
+    from ..parallel.methods import Method
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    ex = make_exchange(mesh, Radius.constant(1), Method[method_name],
+                       wire_layout="irredundant")
+    return ex, ({"q": _f32((20, 20, 20))},)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_exchange_fp8_entry(method_name: str = "PpermuteSlab",
+                             layout: str = "slab"):
+    """The certified fp8 (e4m3) wire path, optionally composed with
+    the irredundant layout: building this entry IS the gate — exactly
+    as for bf16, make_exchange refuses unless the precision checker
+    certifies the narrowing safe."""
+    from ..geometry import Radius
+    from ..parallel.exchange import make_exchange
+    from ..parallel.methods import Method
+
+    mesh = _mesh(_EXCHANGE_MESH)
+    fs = {"q": _f32((20, 20, 20))}
+    ex = make_exchange(mesh, Radius.constant(1), Method[method_name],
+                       wire_format="e4m3", fields_spec=fs,
+                       wire_layout=layout)
+    return ex, (dict(fs),)
+
+
+def _layout_exchange_hlo(method_name: str) -> HloSpec:
+    fn, args = _make_exchange_layout_entry(method_name)
+    return HloSpec(fn=fn, args=args, allow=("collective_permute",))
+
+
+def _layout_exchange_cost(method_name: str) -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+
+    fn, args = _make_exchange_layout_entry(method_name)
+    counts = Dim3(*_EXCHANGE_MESH)
+    expected = _irr_bytes((10, 10, 10), Radius.constant(1), counts, 4)
+    assert expected < _sweep_bytes((10, 10, 10), Radius.constant(1),
+                                   counts, 4)
+    return CostModelSpec(fn=fn, args=args,
+                         expected_bytes_per_shard=expected)
+
+
+def _fp8_exchange_hlo(method_name: str = "PpermuteSlab",
+                      layout: str = "slab") -> HloSpec:
+    fn, args = _make_exchange_fp8_entry(method_name, layout)
+    return HloSpec(fn=fn, args=args, allow=("collective_permute",))
+
+
+def _fp8_exchange_cost(method_name: str = "PpermuteSlab",
+                       layout: str = "slab") -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+    from .costmodel import sweep_wire_bytes
+
+    fn, args = _make_exchange_fp8_entry(method_name, layout)
+    counts = Dim3(*_EXCHANGE_MESH)
+    r = Radius.constant(1)
+    expected = sum(sweep_wire_bytes(
+        (10, 10, 10), r, counts, 4, wire_format="e4m3",
+        layout=layout).values())
+    # the fp8 headline, pinned: wire bytes exactly ONE QUARTER of the
+    # f32 bill under the same layout (the HLO cross-check then proves
+    # the lowered program pays this figure)
+    full = sum(sweep_wire_bytes((10, 10, 10), r, counts, 4,
+                                layout=layout).values())
+    assert expected * 4 == full
     return CostModelSpec(fn=fn, args=args,
                          expected_bytes_per_shard=expected)
 
@@ -2099,8 +2412,11 @@ def _precision_spec(entry, wire=None, counts=None):
 
 
 def _wire_format_targets() -> List[Target]:
-    """The bf16 wire format's lowering contract: collective-permute-
-    only, with HLO-observed wire bytes exactly half the f32 bill."""
+    """The narrow-wire / packed-layout lowering contracts:
+    collective-permute-only, with HLO-observed wire bytes exactly half
+    (bf16) or exactly a quarter (fp8 e4m3) of the f32 bill, and the
+    irredundant layout's bytes strictly below slab — separately and
+    composed."""
     out: List[Target] = []
     for m in ("PpermuteSlab", "PpermutePacked"):
         out.append(HloTarget(
@@ -2109,12 +2425,39 @@ def _wire_format_targets() -> List[Target]:
         out.append(CostModelTarget(
             f"parallel.exchange.make_exchange[{m},wire=bf16,bytes]",
             lambda m=m: _wire_exchange_cost(m)))
+        out.append(HloTarget(
+            f"parallel.exchange.make_exchange"
+            f"[{m},layout=irredundant,hlo]",
+            lambda m=m: _layout_exchange_hlo(m)))
+        out.append(CostModelTarget(
+            f"parallel.exchange.make_exchange"
+            f"[{m},layout=irredundant,bytes]",
+            lambda m=m: _layout_exchange_cost(m)))
+    out += [
+        HloTarget(
+            "parallel.exchange.make_exchange"
+            "[PpermuteSlab,wire=e4m3,hlo]",
+            lambda: _fp8_exchange_hlo("PpermuteSlab")),
+        CostModelTarget(
+            "parallel.exchange.make_exchange"
+            "[PpermuteSlab,wire=e4m3,bytes]",
+            lambda: _fp8_exchange_cost("PpermuteSlab")),
+        HloTarget(
+            "parallel.exchange.make_exchange"
+            "[PpermuteSlab,wire=e4m3,layout=irredundant,hlo]",
+            lambda: _fp8_exchange_hlo("PpermuteSlab", "irredundant")),
+        CostModelTarget(
+            "parallel.exchange.make_exchange"
+            "[PpermuteSlab,wire=e4m3,layout=irredundant,bytes]",
+            lambda: _fp8_exchange_cost("PpermuteSlab", "irredundant")),
+    ]
     return out
 
 
 def _precision_targets() -> List[Target]:
     w32 = {"x": "f32", "y": "f32", "z": "f32"}
     wbf = {"x": "bf16", "y": "bf16", "z": "bf16"}
+    wf8 = {"x": "e4m3", "y": "e4m3", "z": "e4m3"}
     targets: List[Target] = []
     for m in ("PpermuteSlab", "PpermutePacked"):
         targets.append(PrecisionTarget(
@@ -2126,6 +2469,28 @@ def _precision_targets() -> List[Target]:
             f"make_exchange[{m},wire=bf16]",
             lambda m=m: _precision_spec(
                 lambda: _make_exchange_wire_entry(m), wire=wbf)))
+        # the irredundant layout's pack/unpack must not perturb the
+        # dtype flow: full-precision certificate on the packed boxes
+        targets.append(PrecisionTarget(
+            f"analysis.precision.parallel.exchange."
+            f"make_exchange[{m},layout=irredundant]",
+            lambda m=m: _precision_spec(
+                lambda: _make_exchange_layout_entry(m), wire=w32)))
+    # the fp8 wire certificates — slab and composed with the
+    # irredundant layout (the certified-safe customer the quarter-
+    # bytes HLO targets ride on)
+    targets.append(PrecisionTarget(
+        "analysis.precision.parallel.exchange."
+        "make_exchange[PpermuteSlab,wire=e4m3]",
+        lambda: _precision_spec(
+            lambda: _make_exchange_fp8_entry("PpermuteSlab"),
+            wire=wf8)))
+    targets.append(PrecisionTarget(
+        "analysis.precision.parallel.exchange."
+        "make_exchange[PpermuteSlab,wire=e4m3,layout=irredundant]",
+        lambda: _precision_spec(
+            lambda: _make_exchange_fp8_entry(
+                "PpermuteSlab", "irredundant"), wire=wf8)))
     targets += [
         PrecisionTarget("analysis.precision.models.jacobi.step_n",
                         lambda: _precision_spec(_jacobi_step_entry)),
@@ -2265,6 +2630,57 @@ def default_targets() -> List[Target]:
         CostModelTarget("parallel.exchange.exchange_shard[deep-tail,cost]",
                         _deep_tail_exchange_cost),
     ]
+    # irredundant wire-layout twins of the registered exchange
+    # configs: same ppermute ring, packed boxes — collective bijection,
+    # ppermute-only lowering (count pinned UNCHANGED vs slab), and
+    # HLO-exact bytes strictly below the slab bill (see the block
+    # comment at the builders)
+    targets += [
+        CollectiveTarget("parallel.exchange.exchange_shard[r1,irr]",
+                         lambda: _exchange_irr_spec("r1")),
+        CollectiveTarget("parallel.exchange.exchange_shard[r3,irr]",
+                         lambda: _exchange_irr_spec("r3")),
+        CollectiveTarget("parallel.exchange.exchange_shard[asym,irr]",
+                         lambda: _exchange_irr_spec("asym")),
+        CollectiveTarget(
+            "parallel.exchange.exchange_shard_packed[uneven,irr]",
+            _exchange_packed_irr_uneven_spec),
+        CollectiveTarget(
+            "parallel.temporal.temporal_shard_steps[s=2,irr]",
+            lambda: _temporal_irr_spec(2)),
+        CollectiveTarget(
+            "parallel.exchange.exchange_shard[deep-tail,irr]",
+            _deep_tail_irr_spec),
+        HloTarget("parallel.exchange.exchange_shard[r1,irr,hlo]",
+                  lambda: _exchange_irr_hlo("r1")),
+        HloTarget("parallel.exchange.exchange_shard[asym,irr,hlo]",
+                  lambda: _exchange_irr_hlo("asym")),
+        HloTarget(
+            "parallel.exchange.exchange_shard_packed[uneven,irr,hlo]",
+            lambda: _hlo_from_collective(
+                _exchange_packed_irr_uneven_spec)),
+        HloTarget("parallel.temporal.temporal_shard_steps[s=2,irr,hlo]",
+                  lambda: _hlo_from_collective(
+                      lambda: _temporal_irr_spec(2))),
+        HloTarget("parallel.exchange.exchange_shard[deep-tail,irr,hlo]",
+                  lambda: _hlo_from_collective(_deep_tail_irr_spec)),
+        CostModelTarget("parallel.exchange.exchange_shard[r1,irr,cost]",
+                        lambda: _exchange_irr_cost("r1")),
+        CostModelTarget("parallel.exchange.exchange_shard[r3,irr,cost]",
+                        lambda: _exchange_irr_cost("r3")),
+        CostModelTarget(
+            "parallel.exchange.exchange_shard[asym,irr,cost]",
+            lambda: _exchange_irr_cost("asym")),
+        CostModelTarget(
+            "parallel.exchange.exchange_shard_packed[uneven,irr,cost]",
+            _packed_irr_uneven_cost),
+        CostModelTarget(
+            "parallel.temporal.temporal_shard_steps[s=2,irr,cost]",
+            lambda: _temporal_irr_cost(2)),
+        CostModelTarget(
+            "parallel.exchange.exchange_shard[deep-tail,irr,cost]",
+            _deep_tail_irr_cost),
+    ]
     # every exchange configuration the autotuner can emit (Method.Auto)
     targets += _plan_targets()
     # ensemble serving: the batched member axis rides existing
@@ -2342,6 +2758,10 @@ def default_targets() -> List[Target]:
                       lambda: _linkmap_exchange_spec("r3")),
         LinkmapTarget("observatory.linkmap.exchange[asym]",
                       lambda: _linkmap_exchange_spec("asym")),
+        LinkmapTarget("observatory.linkmap.exchange[r1,irr]",
+                      lambda: _linkmap_exchange_irr_spec("r1")),
+        LinkmapTarget("observatory.linkmap.exchange[r3,irr]",
+                      lambda: _linkmap_exchange_irr_spec("r3")),
         LinkmapTarget("observatory.linkmap.packed[uneven]",
                       _linkmap_packed_uneven_spec),
         LinkmapTarget("observatory.linkmap.plan[PpermuteSlab,s=2]",

@@ -37,6 +37,8 @@ from .local_domain import (LocalDomain, get_exterior as _dom_exterior,
                            get_interior as _dom_interior, raw_size, zyx_shape)
 from .parallel.exchange import (exchanged_bytes_per_sweep, make_exchange,
                                 normalize_wire_format)
+from .parallel.packing import (irredundant_bytes_per_sweep,
+                               normalize_wire_layout)
 from .parallel.mesh import make_mesh, mesh_dim
 from .parallel.methods import Method, pick_method
 from .numerics import div_ceil
@@ -71,6 +73,11 @@ class DistributedDomain:
         # make_exchange refuses to build unless the precision checker
         # proves the program safe (analysis/precision.py)
         self.wire_format = "f32"
+        # halo wire layout ("slab" | "irredundant"): "irredundant"
+        # sends every halo cell exactly once (parallel/packing.py) —
+        # corner/edge cells ride the first sweep that can carry them
+        # instead of every fattened slab that overlaps them
+        self.wire_layout = "slab"
         # hierarchical DCN tier (set_dcn_axis); populated by realize()
         self._dcn_requested = False
         self._dcn_axis_req: Optional[int] = None
@@ -168,6 +175,19 @@ class DistributedDomain:
         normalize_wire_format(fmt)  # validate eagerly, fail at the call
         self.wire_format = fmt
 
+    def set_wire_layout(self, layout: str) -> None:
+        """Halo wire message layout: ``"slab"`` (the default — each
+        sweep ships the full fattened cross-section, so corner and
+        edge cells transit the wire up to three times) or
+        ``"irredundant"`` (each direction ships one packed box sized
+        so every halo cell crosses the wire exactly once; see
+        ``parallel/packing.py``). Same 6 collectives either way —
+        only the per-message extent shrinks. Supported by the
+        PpermuteSlab/PpermutePacked methods only."""
+        assert self.mesh is None, "set_wire_layout before realize()"
+        normalize_wire_layout(layout)  # validate eagerly
+        self.wire_layout = layout
+
     def set_dcn_axis(self, axis: Union[int, str, None] = None,
                      groups=None) -> None:
         """Enable the hierarchical node/slice tier (the NodePartition
@@ -199,7 +219,7 @@ class DistributedDomain:
                  force: bool = False, cache_path=None,
                  max_measurements: int = 4, depths=None,
                  overlap_options=(False,), topology_path=None,
-                 wire_formats=("f32",)):
+                 wire_formats=("f32",), wire_layouts=("slab",)):
         """Measure the live mesh and adopt the fastest exchange plan
         (the measured per-pair transport routing of the reference,
         src/stencil.cu:371-458, as a whole-program decision). Runs the
@@ -228,7 +248,8 @@ class DistributedDomain:
             depths=DEFAULT_DEPTHS if depths is None else depths,
             overlap_options=overlap_options,
             max_measurements=max_measurements,
-            topology_path=topology_path, wire_formats=wire_formats)
+            topology_path=topology_path, wire_formats=wire_formats,
+            wire_layouts=wire_layouts)
         self.apply_plan(plan)
         return plan
 
@@ -245,6 +266,9 @@ class DistributedDomain:
         wf = getattr(plan.config, "wire_format", "f32")
         if wf != self.wire_format:
             self.set_wire_format(wf)
+        wl = getattr(plan.config, "wire_layout", "slab")
+        if wl != self.wire_layout:
+            self.set_wire_layout(wl)
         self.plan = plan
 
     @property
@@ -349,6 +373,12 @@ class DistributedDomain:
                 f"wire_format {self.wire_format!r} narrows the halo "
                 f"wire, supported only by the PpermuteSlab and "
                 f"PpermutePacked methods")
+        wire_layout = normalize_wire_layout(self.wire_layout)
+        if wire_layout != "slab" and pick_method(self.methods) not in \
+                (Method.PpermuteSlab, Method.PpermutePacked):
+            raise NotImplementedError(
+                f"wire_layout {self.wire_layout!r} is supported only "
+                f"by the PpermuteSlab and PpermutePacked methods")
 
         t0 = time.perf_counter()
         # --- DCN tier + partition: choose the subdomain grid -----------
@@ -427,14 +457,21 @@ class DistributedDomain:
                     for q in self._names})
         self._exchange_fn = make_exchange(
             self.mesh, self.alloc_radius, self.methods, rem=self.rem,
-            nonperiodic=self.boundary == Boundary.NONE, **wire_kw)
+            nonperiodic=self.boundary == Boundary.NONE,
+            wire_layout=wire_layout, **wire_kw)
         counts = mesh_dim(self.mesh)
         self._bytes_per_axis = {"x": 0, "y": 0, "z": 0}
         for q in self._names:
-            b = exchanged_bytes_per_sweep(zyx_shape(padded_local),
-                                          self.alloc_radius, counts,
-                                          self._dtypes[q].itemsize,
-                                          wire_format=self.wire_format)
+            if wire_layout == "irredundant":
+                b = irredundant_bytes_per_sweep(
+                    zyx_shape(padded_local), self.alloc_radius, counts,
+                    self._dtypes[q].itemsize,
+                    wire_format=self.wire_format)
+            else:
+                b = exchanged_bytes_per_sweep(
+                    zyx_shape(padded_local), self.alloc_radius, counts,
+                    self._dtypes[q].itemsize,
+                    wire_format=self.wire_format)
             for k in b:
                 self._bytes_per_axis[k] += b[k]
         self.setup_seconds["plan"] = time.perf_counter() - t0
@@ -552,8 +589,9 @@ class DistributedDomain:
     # ------------------------------------------------------------------
     def exchange_bytes_per_axis(self) -> Dict[str, int]:
         """Bytes one shard puts on the ICI per exchange, per mesh axis
-        (the per-method byte-counter analog). Wire-format aware: a
-        bf16 axis reports its on-wire (halved) bytes."""
+        (the per-method byte-counter analog). Wire-format and
+        wire-layout aware: a bf16 axis reports its on-wire (halved)
+        bytes; the irredundant layout reports its slimmer boxes."""
         return dict(self._bytes_per_axis)
 
     @property
@@ -614,6 +652,7 @@ class DistributedDomain:
                 f.write(f"plan config: {self.plan.config.key()}\n")
                 f.write(f"plan measurements: {self.plan.measurements}\n")
             f.write(f"exchange_every: {self.exchange_every}\n")
+            f.write(f"wire_layout: {self.wire_layout}\n")
             f.write(f"quantities: {self._names}\n")
             for i in range(n):
                 idx = self.placement.part.dimensionize(i)

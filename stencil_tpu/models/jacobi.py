@@ -49,7 +49,8 @@ def sphere_geometry(gsize: Dim3):
 def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
                       gsize: Dim3, origin_xyz, method: Method,
                       kernel: str = "xla", rem: Dim3 = Dim3(0, 0, 0),
-                      nonperiodic: bool = False, wire_format=None):
+                      nonperiodic: bool = False, wire_format=None,
+                      wire_layout=None):
     """One fused Jacobi step on one shard: exchange + 7-point update +
     Dirichlet sphere sources. ``origin_xyz`` is the shard's global
     origin (traced axis_index-derived inside shard_map, or static
@@ -57,12 +58,16 @@ def jacobi_shard_step(p, radius: Radius, counts: Dim3, local: Dim3,
     ``kernel``: "xla" (fused slicing) or "pallas" (z-plane-pipelined
     VMEM kernel, ops/pallas_stencil.py). ``wire_format`` narrows the
     halo WIRE only (send-boundary convert, widen on arrival —
-    parallel/exchange.py); the update math runs at storage dtype."""
+    parallel/exchange.py); the update math runs at storage dtype.
+    ``wire_layout`` picks the wire message geometry ("slab" or
+    "irredundant" — parallel/packing.py); interiors are bitwise
+    identical either way."""
     hot_c, cold_c, sph_r = sphere_geometry(gsize)
 
     p = dispatch_exchange({"temp": p}, radius, counts, method,
                           rem=rem, nonperiodic=nonperiodic,
-                          wire_format=wire_format)["temp"]
+                          wire_format=wire_format,
+                          wire_layout=wire_layout)["temp"]
     if kernel == "pallas":
         from ..ops.pallas_stencil import jacobi7_pallas
         new = jacobi7_pallas(p, radius, local)
@@ -270,7 +275,8 @@ class Jacobi3D:
                  kernel: str = "auto", overlap: bool = False,
                  dcn_axis=None, dcn_groups=None,
                  exchange_every: Optional[int] = None,
-                 boundary=None, wire_format=None) -> None:
+                 boundary=None, wire_format=None,
+                 wire_layout=None) -> None:
         self.dd = DistributedDomain(x, y, z, devices=devices)
         self.dd.set_radius(1)
         self.dd.set_methods(methods)
@@ -289,6 +295,9 @@ class Jacobi3D:
             # halo wire narrowing (send-boundary bf16, widen on
             # arrival); realize() below runs the precision gate
             self.dd.set_wire_format(wire_format)
+        if wire_layout is not None:
+            # wire message geometry (slab / irredundant packed boxes)
+            self.dd.set_wire_layout(wire_layout)
         if dcn_axis is not None or dcn_groups is not None:
             self.dd.set_dcn_axis(dcn_axis, dcn_groups)
         if placement is not None:
@@ -426,9 +435,12 @@ class Jacobi3D:
         nonper = dd.boundary == Boundary.NONE
         s_every = dd.exchange_every
         from ..parallel.exchange import normalize_wire_format
+        from ..parallel.packing import normalize_wire_layout
         wire = dd.wire_format
         wire_narrows = any(v != "f32"
                            for v in normalize_wire_format(wire).values())
+        layout = getattr(dd, "wire_layout", "slab")
+        irr_layout = normalize_wire_layout(layout) == "irredundant"
         # single-chip fast path: periodic wrap fused INTO the stencil
         # kernel (no halo storage, no exchange program) — the TPU-native
         # answer to the reference's same-GPU PeerAccessSender shortcut.
@@ -443,7 +455,8 @@ class Jacobi3D:
         # (+-1) z/y shards supported via the kernel's interior-length
         # overlay (x is never sharded here, so rem.x is always 0)
         halo_ok = (counts.x == 1 and not self._overlap and radius_ok
-                   and not nonper and not wire_narrows)
+                   and not nonper and not wire_narrows
+                   and not irr_layout)
         # the overlapped fast path: in-kernel RDMA slab exchange hidden
         # behind the interior compute (ops/pallas_overlap.py) — the
         # reference's interior/exchange/exterior choreography as one
@@ -454,7 +467,7 @@ class Jacobi3D:
                       and rem == Dim3(0, 0, 0) and radius_ok
                       and local.z >= 4 and local.y >= 2
                       and not nonper and s_every == 1
-                      and not wire_narrows)
+                      and not wire_narrows and not irr_layout)
         from ..ops.pallas_stencil import on_tpu
         from ..utils.logging import LOG_INFO
         # explicit kernel='halo' with overlap opts into the RDMA overlap
@@ -533,7 +546,7 @@ class Jacobi3D:
                                origin, method, kernel, nonper)
             return step_fn(p, radius, counts, local, gsize,
                            origin, method, kernel, rem, nonper,
-                           wire_format=wire)
+                           wire_format=wire, wire_layout=layout)
 
         spec = P("z", "y", "x")
         sm = jax.shard_map(shard_step, mesh=dd.mesh, in_specs=spec,
@@ -570,6 +583,7 @@ class Jacobi3D:
         s = dd.exchange_every
         nonper = dd.boundary == Boundary.NONE
         overlap = self._overlap
+        layout = getattr(dd, "wire_layout", "slab")
         hot_c, cold_c, sph_r = sphere_geometry(gsize)
         validate_temporal(radius, local, s, rem)
 
@@ -592,7 +606,7 @@ class Jacobi3D:
                 return temporal_shard_steps(
                     {"temp": q}, radius, counts, method, upd, depth,
                     alloc_steps=s, rem=rem, overlap=ovl,
-                    nonperiodic=nonper)["temp"]
+                    nonperiodic=nonper, wire_layout=layout)["temp"]
 
             p = lax.fori_loop(0, n // s, lambda _, q: group(q, s, overlap), p)
             return lax.fori_loop(0, n % s,
@@ -613,7 +627,7 @@ class Jacobi3D:
                 {"temp": p}, radius, counts, method, upd, c,
                 alloc_steps=s, rem=rem,
                 overlap=(overlap and c == s),
-                nonperiodic=nonper)["temp"]
+                nonperiodic=nonper, wire_layout=layout)["temp"]
 
         self._set_segment_builder(shard_advance, stride=s)
 

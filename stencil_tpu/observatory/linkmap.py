@@ -216,7 +216,10 @@ def _cross_section_classes(axis: int, padded_zyx: Sequence[int],
 def sweep_traffic(shard_padded_zyx: Sequence[int], radius: Radius,
                   counts: Dim3, elem_sizes: Sequence[int],
                   pads_included: bool = True,
-                  reverse: bool = False) -> TrafficMatrix:
+                  reverse: bool = False,
+                  layout: str = "slab",
+                  alloc_radius: Optional[Radius] = None
+                  ) -> TrafficMatrix:
     """The sequential-sweep engines' traffic matrix (PpermuteSlab /
     PpermutePacked / PallasDMA — packing changes launches, not
     payload): per active axis, one message per direction per quantity,
@@ -230,19 +233,48 @@ def sweep_traffic(shard_padded_zyx: Sequence[int], radius: Radius,
     ``reverse=True`` is the halo-ACCUMULATE adjoint (the PIC deposit's
     reduction): same messages, opposite flow — src/dst swap.
     ``pads_included=False`` prices un-padded slabs (the all-gather
-    engine's whole-interior contribution)."""
+    engine's whole-interior contribution).
+
+    ``layout="irredundant"`` prices the each-cell-once wire layout
+    (``parallel.packing``): per other axis the cross-section spans the
+    interior plus — for axes the sweep order already visited — the
+    ``r_lo + r_hi`` halo extension rows (the only pad rows the
+    irredundant box carries), summing per direction to exactly
+    ``packing.irredundant_bytes_per_sweep``. The edge/corner shares
+    then count just those extension rows. ``alloc_radius`` locates the
+    interior inside deeper allocation pads (defaults to ``radius``)."""
+    from ..parallel.packing import normalize_wire_layout
+
     counts = Dim3.of(counts)
     tm = TrafficMatrix(counts)
     lo, hi = radius.pad_lo(), radius.pad_hi()
+    irredundant = normalize_wire_layout(layout) == "irredundant"
+    if irredundant and not pads_included:
+        raise ValueError("layout='irredundant' prices padded sweep "
+                         "messages (pads_included=True)")
+    ar = alloc_radius if alloc_radius is not None else radius
+    alo, ahi = ar.pad_lo(), ar.pad_hi()
+    interiors = [int(shard_padded_zyx[2 - a]) - alo[a] - ahi[a]
+                 for a in range(3)]
     for a in range(3):
         if counts[a] <= 1:
             continue  # in-core wrap: no wire traffic
-        other = 1
-        for d in range(3):
-            if d != 2 - a:
-                other *= int(shard_padded_zyx[d])
-        classes = _cross_section_classes(a, shard_padded_zyx, lo, hi,
-                                         pads_included)
+        if irredundant:
+            # axes swept before `a` carry their halo extension; axes
+            # still pending span the bare interior
+            dims = [(interiors[j], (lo[j] + hi[j]) if j < a else 0)
+                    for j in range(3) if j != a]
+            (i1, e1), (i2, e2) = dims
+            other = (i1 + e1) * (i2 + e2)
+            classes = {"face": i1 * i2, "edge": i1 * e2 + e1 * i2,
+                       "corner": e1 * e2}
+        else:
+            other = 1
+            for d in range(3):
+                if d != 2 - a:
+                    other *= int(shard_padded_zyx[d])
+            classes = _cross_section_classes(a, shard_padded_zyx, lo,
+                                             hi, pads_included)
         for side in (1, -1):
             rows = radius.face(a, -side)
             if rows == 0:
@@ -310,11 +342,13 @@ def migration_traffic(counts: Dim3, n_fields: int, budget: int,
 def method_traffic(method_name: str,
                    shard_interior_zyx: Sequence[int], radius: Radius,
                    counts: Dim3, elem_sizes: Sequence[int],
-                   steps: int = 1) -> TrafficMatrix:
+                   steps: int = 1,
+                   wire_layout: str = "slab") -> TrafficMatrix:
     """The per-method matrix of one DEEP exchange round — the linkmap
     twin of ``analysis.costmodel.exchange_round_model``, sharing its
     geometry conventions (deepened radius, deep padded
-    cross-sections)."""
+    cross-sections; ``wire_layout`` prices the irredundant packing on
+    the sweep engines, a no-op for the all-gather control)."""
     deep = radius.deepened(max(int(steps), 1))
     lo, hi = deep.pad_lo(), deep.pad_hi()
     z, y, x = shard_interior_zyx
@@ -322,7 +356,8 @@ def method_traffic(method_name: str,
     if method_name == "AllGather":
         return allgather_traffic(shard_interior_zyx, deep, counts,
                                  elem_sizes)
-    return sweep_traffic(padded, deep, counts, elem_sizes)
+    return sweep_traffic(padded, deep, counts, elem_sizes,
+                         layout=wire_layout)
 
 
 def pic_traffic(shard_interior_zyx: Sequence[int], radius: Radius,
@@ -497,7 +532,9 @@ def link_attribution_for(dd) -> Optional[Dict]:
         s = max(int(dd.exchange_every), 1)
         tm = method_traffic(pick_method(dd.methods).name,
                             (local.z, local.y, local.x), dd.radius,
-                            counts, elem_sizes, steps=s)
+                            counts, elem_sizes, steps=s,
+                            wire_layout=getattr(dd, "wire_layout",
+                                                "slab"))
         if not tm.edges:
             return None
         devices = None

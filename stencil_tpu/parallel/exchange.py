@@ -51,9 +51,21 @@ AXIS_NAME = {0: "x", 1: "y", 2: "z"}
 #: the identity path — full storage precision on the wire; "bf16"
 #: narrows float32 slabs to bfloat16 for the ppermute and widens on
 #: arrival, so halo math runs unchanged at storage precision while
-#: wire bytes exactly halve. Narrower storage dtypes are never
-#: re-narrowed, and non-float lanes always ride at full width.
-WIRE_FORMATS = ("f32", "bf16")
+#: wire bytes exactly halve; "e4m3"/"e5m2" narrow to the fp8 dtypes
+#: (quarter bytes — certificate-gated like bf16, with the coarser
+#: ``max_rel_error_bound`` from their 3-/2-bit mantissas). Narrower
+#: storage dtypes are never re-narrowed, and non-float lanes always
+#: ride at full width.
+WIRE_FORMATS = ("f32", "bf16", "e4m3", "e5m2")
+
+#: wire format -> numpy dtype NAME of the on-wire element type for a
+#: float32 lane — the single naming source the precision certifier
+#: (analysis/precision.py) and the cost model both consume.
+WIRE_DTYPE_NAMES = {"f32": "float32", "bf16": "bfloat16",
+                    "e4m3": "float8_e4m3fn", "e5m2": "float8_e5m2"}
+
+#: on-wire byte width of a 4-byte float32 element per wire format
+_WIRE_F32_BYTES = {"f32": 4, "bf16": 2, "e4m3": 1, "e5m2": 1}
 
 
 def normalize_wire_format(wire_format) -> Dict[str, str]:
@@ -83,18 +95,20 @@ def normalize_wire_format(wire_format) -> Dict[str, str]:
 
 def wire_dtype(dtype, fmt: str):
     """The on-wire dtype of a slab stored as ``dtype`` under wire
-    format ``fmt`` — only float32 narrows (to bfloat16); everything
-    else ships at storage width."""
-    if fmt == "bf16" and np.dtype(dtype) == np.dtype(np.float32):
-        return jnp.bfloat16
+    format ``fmt`` — only float32 narrows (to the format's dtype,
+    ``WIRE_DTYPE_NAMES``); everything else ships at storage width."""
+    if fmt != "f32" and np.dtype(dtype) == np.dtype(np.float32):
+        return {"bf16": jnp.bfloat16, "e4m3": jnp.float8_e4m3fn,
+                "e5m2": jnp.float8_e5m2}[fmt]
     return dtype
 
 
 def wire_elem_size(elem_size: int, fmt: str) -> int:
     """Byte width of one element on the wire (the cost-model twin of
-    :func:`wire_dtype`): a 4-byte element under "bf16" ships as 2."""
-    if fmt == "bf16" and int(elem_size) == 4:
-        return 2
+    :func:`wire_dtype`): a 4-byte element ships as 2 under "bf16" and
+    as 1 under the fp8 formats."""
+    if int(elem_size) == 4:
+        return _WIRE_F32_BYTES[fmt]
     return int(elem_size)
 
 
@@ -169,13 +183,46 @@ def _edge_masked(recv, side: int, axis_name: str, n_dev: int):
     return jnp.where(edge, jnp.zeros_like(recv), recv)
 
 
+def _box_starts(spans, Ls):
+    """Per-array-dim (z,y,x) start indices of a DirectionPlan box —
+    static ints except the two ``plus_L`` placements, which add the
+    traced interior length of their grid axis. When any start is
+    traced (uneven shards), ALL are cast to int32: dynamic_slice
+    demands one index dtype, and an x64-enabled session would promote
+    the static Python ints to int64 otherwise."""
+    starts = []
+    for d in range(3):
+        s = spans[2 - d]  # grid axis of array dim d
+        starts.append(s.base + Ls[2 - d] if s.plus_L else s.base)
+    if not all(isinstance(st, (int, np.integer)) for st in starts):
+        starts = [jnp.asarray(st, jnp.int32) for st in starts]
+    return tuple(starts)
+
+
+def _box_take(arr, spans, Ls):
+    sizes = tuple(spans[2 - d].size for d in range(3))
+    return lax.dynamic_slice(arr, _box_starts(spans, Ls), sizes)
+
+
+def _box_put(arr, box, spans, Ls):
+    return lax.dynamic_update_slice(arr, box, _box_starts(spans, Ls))
+
+
+def _shard_interiors(arr, alloc_r: Radius) -> Tuple[int, int, int]:
+    """Per-grid-axis interior capacity of one padded shard block."""
+    return tuple(arr.shape[AXIS_TO_DIM[a]]
+                 - alloc_r.face(a, -1) - alloc_r.face(a, 1)
+                 for a in range(3))
+
+
 def exchange_shard(arr: jnp.ndarray, radius: Radius,
                    mesh_counts: Dim3,
                    axis_order: Tuple[int, ...] = (0, 1, 2),
                    rem: Dim3 = Dim3(0, 0, 0),
                    alloc_radius: "Radius | None" = None,
                    nonperiodic: bool = False,
-                   wire_format=None) -> jnp.ndarray:
+                   wire_format=None,
+                   wire_layout=None) -> jnp.ndarray:
     """Fill all halo regions of one padded shard via sequential axis
     sweeps. Must be traced inside ``shard_map`` over mesh axes
     ('x','y','z') when the corresponding mesh_counts entry is > 1.
@@ -204,9 +251,40 @@ def exchange_shard(arr: jnp.ndarray, radius: Radius,
     slab at the wire boundary, one ppermute later widens it back to the
     storage dtype on arrival; halo math is unchanged. Single-device
     axes are local copies and always stay at full precision.
+    ``wire_layout``: "slab" (default — full-allocation cross-sections)
+    or "irredundant" (each wire-halo cell ships exactly once; see
+    :mod:`.packing`). Same collective bill, smaller payload; the live
+    window (interior + wire-radius shell) is bitwise identical.
     """
     alloc_r = alloc_radius if alloc_radius is not None else radius
     wf = normalize_wire_format(wire_format)
+    from .packing import normalize_wire_layout, plan_sweep
+    if normalize_wire_layout(wire_layout) == "irredundant":
+        interiors = _shard_interiors(arr, alloc_r)
+        plans = plan_sweep(radius, alloc_r, interiors, tuple(axis_order))
+        Ls = [shard_interior_len(a, interiors[a], rem) for a in range(3)]
+        for a in axis_order:
+            if radius.wire_rows(a) == 0:
+                continue
+            assert (alloc_r.face(a, -1) >= radius.face(a, -1)
+                    and alloc_r.face(a, 1) >= radius.face(a, 1)), \
+                (f"axis {a}: wire depth exceeds allocation pads")
+            name = AXIS_NAME[a]
+            n_dev = mesh_counts[a]
+            narrow = n_dev > 1 and wf[name] != "f32"
+            for side, shift in ((1, _shift_from_plus),
+                                (-1, _shift_from_minus)):
+                plan = plans.get((a, side))
+                if plan is None:
+                    continue
+                src = _box_take(arr, plan.src, Ls)
+                if narrow:
+                    src = _to_wire(src, wf[name])
+                recv = _from_wire(shift(src, name, n_dev), arr.dtype)
+                if nonperiodic:
+                    recv = _edge_masked(recv, side, name, n_dev)
+                arr = _box_put(arr, recv, plan.dst, Ls)
+        return arr
     for a in axis_order:
         r_lo = radius.face(a, -1)
         r_hi = radius.face(a, 1)
@@ -443,7 +521,8 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                           rem: Dim3 = Dim3(0, 0, 0),
                           alloc_radius: "Radius | None" = None,
                           nonperiodic: bool = False,
-                          wire_format=None
+                          wire_format=None,
+                          wire_layout=None
                           ) -> Dict[str, jnp.ndarray]:
     """Multi-quantity exchange with per-direction packing: all
     quantities' slabs for one axis-direction are flattened and
@@ -463,15 +542,19 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
     shapes stay static (capacity-sized slabs), so one program serves
     every shard.
 
-    ``alloc_radius``/``nonperiodic``/``wire_format``: same contract as
-    :func:`exchange_shard` (deep-carry allocations for temporal
-    blocking; zero-Dirichlet exterior for ``Boundary.NONE``; per-axis
-    halo wire narrowing — here the whole packed per-dtype-group buffer
-    narrows once before its single ppermute and widens once on
-    arrival).
+    ``alloc_radius``/``nonperiodic``/``wire_format``/``wire_layout``:
+    same contract as :func:`exchange_shard` (deep-carry allocations for
+    temporal blocking; zero-Dirichlet exterior for ``Boundary.NONE``;
+    per-axis halo wire narrowing — here the whole packed
+    per-dtype-group buffer narrows once before its single ppermute and
+    widens once on arrival; "irredundant" packs each quantity's
+    minimal box instead of its fat slab, see :mod:`.packing`).
     """
+    from .packing import normalize_wire_layout, plan_direction
+
     alloc_r = alloc_radius if alloc_radius is not None else radius
     wf = normalize_wire_format(wire_format)
+    irredundant = normalize_wire_layout(wire_layout) == "irredundant"
     names = sorted(arrs.keys())  # sorted so both endpoints agree on
     # layout (reference sorts messages by size, src/packer.cu:69,182-183)
     out = {k: v for k, v in arrs.items()}
@@ -500,8 +583,20 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
             for dt, qs in groups.items():
                 slabs = []
                 shapes = []
+                unpacks = []  # irredundant: (DirectionPlan, Ls) per q
                 for q in qs:
                     arr = out[q]
+                    if irredundant:
+                        interiors = _shard_interiors(arr, alloc_r)
+                        plan = plan_direction(a, side, radius, alloc_r,
+                                              tuple(axis_order), interiors)
+                        Ls = [shard_interior_len(b, interiors[b], rem)
+                              for b in range(3)]
+                        src = _box_take(arr, plan.src, Ls)
+                        unpacks.append((plan, Ls))
+                        shapes.append(src.shape)
+                        slabs.append(src.reshape(-1))
+                        continue
                     alloc = arr.shape[dim]
                     interior = alloc - p_lo - p_hi
                     L = shard_interior_len(a, interior, rem)
@@ -527,12 +622,16 @@ def exchange_shard_packed(arrs: Dict[str, jnp.ndarray], radius: Radius,
                     moved = _edge_masked(moved, side, name, n_dev)
                 # unpack
                 off = 0
-                for q, shp in zip(qs, shapes):
+                for i, (q, shp) in enumerate(zip(qs, shapes)):
                     cnt = int(np.prod(shp))
                     recv = lax.dynamic_slice_in_dim(moved, off, cnt, axis=0
                                                     ).reshape(shp)
                     off += cnt
                     arr = out[q]
+                    if irredundant:
+                        plan, Ls = unpacks[i]
+                        out[q] = _box_put(arr, recv, plan.dst, Ls)
+                        continue
                     alloc = arr.shape[dim]
                     interior = alloc - p_lo - p_hi
                     if side == 1:
@@ -597,29 +696,36 @@ def dispatch_exchange(fields: Dict[str, jnp.ndarray], radius: Radius,
                       rem: Dim3 = Dim3(0, 0, 0),
                       alloc_radius: "Radius | None" = None,
                       nonperiodic: bool = False,
-                      wire_format=None) -> Dict[str, jnp.ndarray]:
+                      wire_format=None,
+                      wire_layout=None) -> Dict[str, jnp.ndarray]:
     """Route a multi-quantity shard exchange to the selected strategy —
     the single dispatch point shared by the orchestrator and the fused
     model steps (the Method-routing analog of src/stencil.cu:371-458).
 
-    ``alloc_radius``/``nonperiodic``/``wire_format`` (ppermute methods
-    only): deep-carry allocations for temporal blocking, the
-    zero-Dirichlet exterior of ``Boundary.NONE``, and per-axis halo
-    wire narrowing — see :func:`exchange_shard`."""
+    ``alloc_radius``/``nonperiodic``/``wire_format``/``wire_layout``
+    (ppermute methods only): deep-carry allocations for temporal
+    blocking, the zero-Dirichlet exterior of ``Boundary.NONE``,
+    per-axis halo wire narrowing, and the irredundant wire layout —
+    see :func:`exchange_shard`."""
+    from .packing import normalize_wire_layout
+
     uneven = rem != Dim3(0, 0, 0)
     wf = normalize_wire_format(wire_format)
     narrows = any(v != "f32" for v in wf.values())
+    layout = normalize_wire_layout(wire_layout)
     if uneven and method not in (Method.PpermuteSlab,
                                  Method.PpermutePacked):
         raise NotImplementedError(
             f"uneven (+-1 remainder) subdomains are only supported by "
             f"the PpermuteSlab and PpermutePacked methods, not {method}")
-    if ((alloc_radius is not None or nonperiodic or narrows)
+    if ((alloc_radius is not None or nonperiodic or narrows
+         or layout != "slab")
             and method not in (Method.PpermuteSlab, Method.PpermutePacked)):
         raise NotImplementedError(
-            f"deep-carry allocations, non-periodic boundaries, and "
-            f"narrow wire formats are only supported by the "
-            f"PpermuteSlab and PpermutePacked methods, not {method}")
+            f"deep-carry allocations, non-periodic boundaries, narrow "
+            f"wire formats, and non-slab wire layouts are only "
+            f"supported by the PpermuteSlab and PpermutePacked "
+            f"methods, not {method}")
     if method == Method.PallasDMA:
         from .pallas_exchange import exchange_shard_pallas
         return {k: exchange_shard_pallas(v, radius, mesh_counts, axis_order)
@@ -627,12 +733,12 @@ def dispatch_exchange(fields: Dict[str, jnp.ndarray], radius: Radius,
     if method == Method.PpermutePacked:
         return exchange_shard_packed(fields, radius, mesh_counts,
                                      axis_order, rem, alloc_radius,
-                                     nonperiodic, wf)
+                                     nonperiodic, wf, layout)
     if method == Method.AllGather:
         return {k: exchange_shard_allgather(v, radius, mesh_counts, axis_order)
                 for k, v in fields.items()}
     return {k: exchange_shard(v, radius, mesh_counts, axis_order, rem,
-                              alloc_radius, nonperiodic, wf)
+                              alloc_radius, nonperiodic, wf, layout)
             for k, v in fields.items()}
 
 
@@ -641,7 +747,8 @@ def make_exchange(mesh: Mesh, radius: Radius,
                   axis_order: Tuple[int, ...] = (0, 1, 2),
                   rem: Dim3 = Dim3(0, 0, 0),
                   nonperiodic: bool = False,
-                  wire_format=None, fields_spec=None):
+                  wire_format=None, fields_spec=None,
+                  wire_layout=None):
     """Build a jitted multi-quantity halo exchange over ``mesh``.
 
     Returns ``exchange(fields: dict[str, Array]) -> dict[str, Array]``
@@ -667,19 +774,26 @@ def make_exchange(mesh: Mesh, radius: Radius,
     >= f32, exactly the declared wire dtype per link class, no double
     quantization — and an unsafe certificate raises
     ``PrecisionGateError`` instead of realizing. The returned callable
-    carries ``wire_format``, ``precision_declaration``, and
-    ``precision_certificate`` attributes.
+    carries ``wire_format``, ``wire_layout``, ``precision_declaration``,
+    and ``precision_certificate`` attributes.
+
+    ``wire_layout`` selects the message shape ("slab" | "irredundant",
+    see :mod:`.packing`) — orthogonal to ``wire_format`` and composed
+    with it (pack -> narrow -> ship -> widen -> unpack).
     """
+    from .packing import normalize_wire_layout
+
     method = pick_method(methods)
     counts = Dim3(mesh.shape["x"], mesh.shape["y"], mesh.shape["z"])
     spec = P("z", "y", "x")
     wf = normalize_wire_format(wire_format)
     narrows = any(v != "f32" for v in wf.values())
+    layout = normalize_wire_layout(wire_layout)
 
     def shard_fn(fields: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
         return dispatch_exchange(fields, radius, counts, method, axis_order,
                                  rem, nonperiodic=nonperiodic,
-                                 wire_format=wf)
+                                 wire_format=wf, wire_layout=layout)
 
     sm = jax.shard_map(shard_fn, mesh=mesh,
                        in_specs=spec, out_specs=spec, check_vma=False)
@@ -708,6 +822,7 @@ def make_exchange(mesh: Mesh, radius: Radius,
                 f"safe — refusing to realize: "
                 + "; ".join(cert.reasons))
     ex.wire_format = dict(wf)
+    ex.wire_layout = layout
     ex.precision_declaration = {"wire": {ax: fmt for ax, fmt in wf.items()},
                                 "compute": "float32"}
     ex.precision_certificate = cert
@@ -798,7 +913,9 @@ def exchanged_bytes_per_sweep(shard_padded_shape_zyx: Sequence[int],
     src/stencil.cu:516-637). Counts only shifts that cross devices
     (n_dev > 1); same-device wraps are local copies. A narrowing
     ``wire_format`` axis prices its elements at the on-wire width
-    (4-byte lanes exactly halve under "bf16")."""
+    (4-byte lanes exactly halve under "bf16", quarter under fp8).
+    Prices the SLAB layout; the irredundant twin is
+    :func:`..parallel.packing.irredundant_bytes_per_sweep`."""
     out = {"x": 0, "y": 0, "z": 0}
     shape = list(shard_padded_shape_zyx)
     wf = normalize_wire_format(wire_format)

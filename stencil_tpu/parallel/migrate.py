@@ -32,8 +32,17 @@ stencil-lint registry targets):
   particles without any added collective.
 
 The wire record is ``n_fields + RECORD_EXTRA_ROWS`` rows of the field
-dtype per particle slot (the SoA fields, the three remaining offset
-components, and the validity flag), so modeled migration bytes are
+dtype per particle slot: the SoA fields plus the packed control
+row(s) — ``RECORD_EXTRA_ROWS`` is the single constant both this
+packer and the byte model
+(``analysis/costmodel.migration_record_rows``) derive from, so the
+prose can never go stale against the code. Today that is ONE row: the
+three remaining {-1, 0, +1} offset components and the validity flag
+are base-3/flag-bit encoded into a single small integer
+(``code = (ox+1) + 3*(oy+1) + 9*(oz+1) + 27*valid``, in [0, 53] —
+exact in every supported float dtype, bf16 included), the canonical-
+record analog of the irredundant halo layout (``parallel/packing.py``).
+Modeled migration bytes are
 ``2 x active_axes x record_rows x budget x itemsize`` — priced by
 ``analysis/costmodel.migration_wire_bytes_per_shard`` and cross-checked
 EXACTLY against the lowered HLO. ``capacity`` and ``budget`` are the
@@ -50,17 +59,40 @@ import jax.numpy as jnp
 from ..geometry import Dim3
 from .exchange import AXIS_NAME, _shift_from_minus, _shift_from_plus
 
-#: wire-record rows beyond the SoA fields: the three (remaining)
-#: destination offset components + the validity flag. The cost model
-#: (analysis/costmodel.migration_record_rows) derives from this — one
-#: constant, no drift.
-RECORD_EXTRA_ROWS = 4
+#: wire-record rows beyond the SoA fields. The three (remaining)
+#: destination offset components and the validity flag pack into ONE
+#: base-3/flag-bit coded row (see :func:`_encode_record_code`). The
+#: cost model (analysis/costmodel.migration_record_rows) derives from
+#: this — one constant, no drift.
+RECORD_EXTRA_ROWS = 1
 
 
 def migration_record_rows(n_fields: int) -> int:
-    """Rows of one migration wire record: the SoA fields plus offsets
-    and validity (see :data:`RECORD_EXTRA_ROWS`)."""
+    """Rows of one migration wire record: the SoA fields plus the
+    packed control row(s) (see :data:`RECORD_EXTRA_ROWS`)."""
     return int(n_fields) + RECORD_EXTRA_ROWS
+
+
+def _encode_record_code(comps, sent):
+    """Pack three {-1, 0, +1} offset components plus the validity flag
+    into one integer code in [0, 53]: ``(c0+1) + 3*(c1+1) + 9*(c2+1)
+    + 27*sent``. Codes this small are exact in every supported float
+    dtype (bf16's 8 mantissa bits cover integers to 256), so the code
+    rides the wire as a field-dtype row."""
+    code = 27 * sent.astype(jnp.int32)
+    for k, c in enumerate(comps):
+        code = code + (3 ** k) * (c + 1)
+    return code
+
+
+def _decode_record_code(row):
+    """Invert :func:`_encode_record_code` on a received field-dtype
+    row: returns ``(comps, valid)`` with int32 components."""
+    code = jnp.round(row).astype(jnp.int32)
+    valid = code >= 27
+    base = jnp.where(valid, code - 27, code)
+    comps = [base % 3 - 1, base // 3 % 3 - 1, base // 9 - 1]
+    return comps, valid
 
 
 def migrate_shard(fields: Dict[str, jnp.ndarray], valid: jnp.ndarray,
@@ -121,11 +153,14 @@ def migrate_shard(fields: Dict[str, jnp.ndarray], valid: jnp.ndarray,
             overflow = overflow + jnp.maximum(
                 jnp.sum(leave) - budget, 0).astype(jnp.float32)
             rows = [work[q][idx] for q in names]
-            # the record's offset rows: this axis is CONSUMED by the
-            # hop (arrivals are home along it); the others ride on
-            rows += [jnp.zeros_like(offs[b][idx]) if b == a
-                     else offs[b][idx] for b in range(3)]
-            rows.append(sent.astype(dt))
+            # the packed control row: this axis's offset is CONSUMED by
+            # the hop (arrivals are home along it); the others ride on,
+            # coded together with the validity flag in one row
+            comps = [jnp.zeros((budget,), jnp.int32) if b == a
+                     else jnp.clip(jnp.round(offs[b][idx]
+                                             ).astype(jnp.int32), -1, 1)
+                     for b in range(3)]
+            rows.append(_encode_record_code(comps, sent).astype(dt))
             buf = jnp.stack(rows)  # (record_rows, budget)
             moved = (_shift_from_minus(buf, name, n_dev) if side == 1
                      else _shift_from_plus(buf, name, n_dev))
@@ -136,8 +171,8 @@ def migrate_shard(fields: Dict[str, jnp.ndarray], valid: jnp.ndarray,
         buf = jnp.concatenate(incoming, axis=1)  # (rows, 2*budget)
         inc_fields = {q: buf[i] for i, q in enumerate(names)}
         nf = len(names)
-        inc_offs = [buf[nf + b] for b in range(3)]
-        inc_valid = buf[nf + 3] > jnp.asarray(0.5, dt)
+        inc_comps, inc_valid = _decode_record_code(buf[nf])
+        inc_offs = [c.astype(dt) for c in inc_comps]
         free_order = jnp.argsort(valid)  # invalid slots first, stable
         free_count = capacity - jnp.sum(valid)
         rank = jnp.cumsum(inc_valid) - 1

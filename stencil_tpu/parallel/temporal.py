@@ -129,7 +129,9 @@ def temporal_shard_steps(fields: Dict[str, jnp.ndarray], radius: Radius,
                          rem: Dim3 = ZERO,
                          exchange_keys: Optional[Sequence[str]] = None,
                          overlap: bool = False,
-                         nonperiodic: bool = False
+                         nonperiodic: bool = False,
+                         wire_format=None,
+                         wire_layout=None
                          ) -> Dict[str, jnp.ndarray]:
     """One ``steps``-deep blocked group on one shard: a single
     depth-``steps*r`` exchange, then ``steps`` applications of
@@ -148,6 +150,10 @@ def temporal_shard_steps(fields: Dict[str, jnp.ndarray], radius: Radius,
     ``overlap``: split sub-step 0 into the pre-exchange deep-interior
     block plus post-exchange shells so the deep exchange hides behind
     compute (even shards only).
+    ``wire_format``/``wire_layout``: the deep exchange's halo wire
+    format and message layout (see ``parallel.exchange``) — the
+    irredundant layout's win is largest here, where slab
+    cross-sections grow with ``steps`` but the wire shell does not.
     """
     alloc_steps = steps if alloc_steps is None else alloc_steps
     if not 1 <= steps <= alloc_steps:
@@ -170,7 +176,9 @@ def temporal_shard_steps(fields: Dict[str, jnp.ndarray], radius: Radius,
     exchanged = dispatch_exchange({q: fields[q] for q in keys}, wire,
                                   mesh_counts, method, rem=rem,
                                   alloc_radius=alloc_r,
-                                  nonperiodic=nonperiodic)
+                                  nonperiodic=nonperiodic,
+                                  wire_format=wire_format,
+                                  wire_layout=wire_layout)
     out = dict(fields)
     out.update(exchanged)
 

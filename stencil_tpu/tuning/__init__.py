@@ -74,7 +74,8 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
                  overlap_options: Sequence[bool] = (False,),
                  max_measurements: int = 4,
                  runnable=None, topology: "Dict | None" = None,
-                 wire_formats: Sequence[str] = ("f32",)) -> Plan:
+                 wire_formats: Sequence[str] = ("f32",),
+                 wire_layouts: Sequence[str] = ("slab",)) -> Plan:
     """The core search (timer injected — deterministic under
     :class:`FakeTimer`): cache lookup, alpha-beta calibration,
     model-ranked pruning, measurement of the survivors, plan store.
@@ -96,6 +97,11 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
     configurations — the calibrated model prices their halved wire
     bytes, and realize() only accepts the winner behind a ``safe``
     :class:`~stencil_tpu.analysis.precision.PrecisionCertificate`).
+
+    ``wire_layouts``: halo wire message layouts to enumerate (default
+    slab-only; add ``"irredundant"`` to rank the each-cell-once
+    layout — the calibrated model prices its slimmer per-direction
+    boxes, ``parallel.packing``).
     """
     fp = fingerprint(inputs)
     if read_cache:
@@ -134,7 +140,8 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
     cands = candidate_space(geom, depths=depths,
                             overlap_options=overlap_options,
                             runnable=runnable,
-                            wire_formats=wire_formats)
+                            wire_formats=wire_formats,
+                            wire_layouts=wire_layouts)
     if not cands:
         raise ValueError("no feasible exchange configuration for this "
                          "geometry (shards smaller than the radius?)")
@@ -143,7 +150,8 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
                                    geom.radius, geom.counts,
                                    geom.elem_sizes, c.exchange_every,
                                    coeffs, geom.dtype_groups,
-                                   wire_format=c.wire_format)
+                                   wire_format=c.wire_format,
+                                   wire_layout=c.wire_layout)
         for c in cands}
     ranked = sorted(cands, key=lambda c: predicted[c])
 
@@ -233,7 +241,8 @@ def inputs_from_domain(dd, dim) -> Dict:
         mesh_shape=list(dim), grid=list(dd.size), radius=dd.radius,
         quantities={q: str(dd._dtypes[q]) for q in dd._names},
         boundary=dd.boundary.name, n_slices=dd.n_slices,
-        wire_format=getattr(dd, "wire_format", "f32"))
+        wire_format=getattr(dd, "wire_format", "f32"),
+        wire_layout=getattr(dd, "wire_layout", "slab"))
 
 
 def autotune_domain(dd, timer=None, use_cache: bool = True,
@@ -242,7 +251,8 @@ def autotune_domain(dd, timer=None, use_cache: bool = True,
                     overlap_options: Sequence[bool] = (False,),
                     max_measurements: int = 4,
                     topology_path=None,
-                    wire_formats: Sequence[str] = ("f32",)) -> Plan:
+                    wire_formats: Sequence[str] = ("f32",),
+                    wire_layouts: Sequence[str] = ("slab",)) -> Plan:
     """Autotune a configured ``DistributedDomain`` (called by
     ``DistributedDomain.autotune()`` — use that). Chooses the partition
     the orchestrator will use, builds the real :class:`MeshTimer` over
@@ -320,4 +330,5 @@ def autotune_domain(dd, timer=None, use_cache: bool = True,
                         write_cache=use_cache, cache_path=cache_path,
                         depths=depths, overlap_options=overlap_options,
                         max_measurements=max_measurements,
-                        topology=topology, wire_formats=wire_formats)
+                        topology=topology, wire_formats=wire_formats,
+                        wire_layouts=wire_layouts)

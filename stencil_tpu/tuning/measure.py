@@ -77,7 +77,8 @@ class FakeTimer:
         messages, nbytes = exchange_round_model(
             cand.method, geom.shard_interior_zyx, geom.radius,
             geom.counts, geom.elem_sizes, cand.exchange_every,
-            geom.dtype_groups, wire_format=cand.wire_format)
+            geom.dtype_groups, wire_format=cand.wire_format,
+            wire_layout=cand.wire_layout)
         t = self.coeffs.seconds(messages, nbytes)
         t *= self.scale.get(cand.method, 1.0)
         if cand.overlap:
@@ -184,7 +185,7 @@ class MeshTimer:
                           for i, dt in enumerate(self.dtypes)})
         ex = make_exchange(self.mesh, deep, Method[cand.method],
                            rem=self.rem, nonperiodic=self.nonperiodic,
-                           **kw)
+                           wire_layout=cand.wire_layout, **kw)
         sharding = NamedSharding(self.mesh, P("z", "y", "x"))
         make = {i: jax.jit(lambda dt=dt: jnp.zeros(gshape, dt),
                            out_shardings=sharding)

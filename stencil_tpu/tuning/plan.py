@@ -47,16 +47,21 @@ _PPERMUTE = ("PpermuteSlab", "PpermutePacked")
 class Candidate:
     """One point of the configuration space the tuner sweeps.
 
-    ``wire_format`` is the halo wire dtype choice ("f32" | "bf16",
-    ``parallel.exchange.WIRE_FORMATS``): "f32" is the identity wire,
-    "bf16" halves the wire bytes on the ppermute engines and only
-    realizes behind a safe :class:`~stencil_tpu.analysis.precision.
-    PrecisionCertificate` (the ``make_exchange`` gate)."""
+    ``wire_format`` is the halo wire dtype choice
+    (``parallel.exchange.WIRE_FORMATS``): "f32" is the identity wire;
+    the narrowing formats ("bf16", "e4m3", "e5m2") shrink the wire
+    bytes on the ppermute engines and only realize behind a safe
+    :class:`~stencil_tpu.analysis.precision.PrecisionCertificate`
+    (the ``make_exchange`` gate). ``wire_layout`` is the message
+    layout ("slab" | "irredundant", ``parallel.packing.WIRE_LAYOUTS``):
+    "irredundant" sends every halo cell exactly once on the ppermute
+    engines (corner/edge cells stop transiting multiple sweeps)."""
 
     method: str
     exchange_every: int = 1
     overlap: bool = False
     wire_format: str = "f32"
+    wire_layout: str = "slab"
 
     def key(self) -> str:
         tag = f"{self.method}[s={self.exchange_every}"
@@ -64,6 +69,8 @@ class Candidate:
             tag += ",overlap"
         if self.wire_format != "f32":
             tag += f",wire={self.wire_format}"
+        if self.wire_layout != "slab":
+            tag += f",layout={self.wire_layout}"
         return tag + "]"
 
     @staticmethod
@@ -73,10 +80,13 @@ class Candidate:
         parts = rest.split(",")
         s = int(parts[0].split("=")[1])
         wire = "f32"
+        layout = "slab"
         for p in parts[1:]:
             if p.startswith("wire="):
                 wire = p.split("=", 1)[1]
-        return Candidate(method, s, "overlap" in parts[1:], wire)
+            elif p.startswith("layout="):
+                layout = p.split("=", 1)[1]
+        return Candidate(method, s, "overlap" in parts[1:], wire, layout)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,9 +127,11 @@ def candidate_feasible(cand: Candidate, geom: TuneGeometry) -> bool:
             return False
         if cand.overlap:
             return False
-        # narrow wire formats ride the send-boundary convert of the
+        # narrow wire formats and the irredundant layout ride the
         # ppermute engines only (parallel.methods.WIRE_CAPABLE)
         if cand.wire_format != "f32":
+            return False
+        if cand.wire_layout != "slab":
             return False
     if cand.exchange_every < 1:
         return False
@@ -138,7 +150,8 @@ def candidate_space(geom: TuneGeometry,
                     depths: Sequence[int] = DEFAULT_DEPTHS,
                     overlap_options: Sequence[bool] = (False,),
                     runnable: Optional[Callable] = None,
-                    wire_formats: Sequence[str] = ("f32",)
+                    wire_formats: Sequence[str] = ("f32",),
+                    wire_layouts: Sequence[str] = ("slab",)
                     ) -> List[Candidate]:
     """Every feasible, runnable configuration, in deterministic
     tie-break order (method priority x depth ascending x overlap off
@@ -148,7 +161,9 @@ def candidate_space(geom: TuneGeometry,
     ``parallel.methods.method_runnable``. ``wire_formats`` is opt-in:
     the default sweeps only the identity "f32" wire; pass
     ``("f32", "bf16")`` to also rank the certified half-width wire on
-    the ppermute engines."""
+    the ppermute engines. ``wire_layouts`` is likewise opt-in: pass
+    ``("slab", "irredundant")`` to also rank the each-cell-once
+    message layout (``parallel.packing``)."""
     from ..parallel.methods import Method, method_runnable
 
     if runnable is None:
@@ -160,9 +175,11 @@ def candidate_space(geom: TuneGeometry,
         for s in sorted(set(int(d) for d in depths)):
             for ovl in overlap_options:
                 for wf in wire_formats:
-                    cand = Candidate(name, s, bool(ovl), str(wf))
-                    if candidate_feasible(cand, geom):
-                        out.append(cand)
+                    for wl in wire_layouts:
+                        cand = Candidate(name, s, bool(ovl), str(wf),
+                                         str(wl))
+                        if candidate_feasible(cand, geom):
+                            out.append(cand)
     return out
 
 
@@ -382,12 +399,14 @@ def fingerprint_inputs(platform: str, device_count: int,
                        quantities: Dict[str, str],
                        boundary: str, n_slices: int = 1,
                        library_version: Optional[str] = None,
-                       wire_format: str = "f32") -> Dict:
+                       wire_format: str = "f32",
+                       wire_layout: str = "slab") -> Dict:
     """The identity a plan is valid for (see module docstring).
     ``quantities`` maps name -> numpy dtype string. ``wire_format``
-    is part of the identity: a plan tuned for the f32 wire must never
-    replay onto a bf16-wire domain (the measured coefficients price a
-    different byte bill)."""
+    and ``wire_layout`` are part of the identity: a plan tuned for
+    the f32 slab wire must never replay onto a bf16 or irredundant
+    wire domain (the measured coefficients price a different byte
+    bill)."""
     if library_version is None:
         from .. import __version__ as library_version
     return {
@@ -401,6 +420,7 @@ def fingerprint_inputs(platform: str, device_count: int,
         "n_slices": int(n_slices),
         "library_version": str(library_version),
         "wire_format": str(wire_format),
+        "wire_layout": str(wire_layout),
     }
 
 
@@ -444,7 +464,8 @@ class Plan:
             config=Candidate(str(cfg["method"]),
                              int(cfg["exchange_every"]),
                              bool(cfg.get("overlap", False)),
-                             str(cfg.get("wire_format", "f32"))),
+                             str(cfg.get("wire_format", "f32")),
+                             str(cfg.get("wire_layout", "slab"))),
             fingerprint=str(rec["fingerprint"]),
             coefficients=dict(rec.get("coefficients", {})),
             costs=dict(rec.get("costs", {})),

@@ -1,0 +1,50 @@
+"""Negative control for the irredundant wire-layout byte contract.
+
+The redundancy regression the costmodel checker must catch: an
+exchange program that still ships the fat SLAB cross-sections (every
+edge/corner cell transiting the wire up to three times) while its
+declared byte model claims the irredundant packed layout. The HLO
+moves more bytes than the irredundant contract — exactly what a
+half-reverted packing plan or a silently dropped ``wire_layout=``
+plumb would look like. ``python -m stencil_tpu.analysis
+tests/fixtures/lint/bad_packing.py`` MUST exit nonzero.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from stencil_tpu.analysis import CostModelSpec, CostModelTarget
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.parallel.exchange import exchange_shard
+from stencil_tpu.parallel.mesh import make_mesh
+from stencil_tpu.parallel.packing import irredundant_bytes_per_sweep
+
+
+def _slab_sold_as_irredundant() -> CostModelSpec:
+    """The program runs the default slab exchange; the declared model
+    prices the irredundant layout. Corner and edge cells of the r=1
+    halo shell ride the wire three/two times in the lowered HLO, so
+    the measured bytes exceed the irredundant contract and the
+    analytic cross-check must flag the mismatch."""
+    mesh = make_mesh((2, 2, 2), jax.devices()[:8])
+    counts = Dim3(2, 2, 2)
+    radius = Radius.constant(1)
+
+    def step(x):
+        # wire_layout defaults to "slab" — the redundant fat slabs
+        return exchange_shard(x, radius, counts)
+
+    sm = jax.shard_map(step, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    expected = sum(irredundant_bytes_per_sweep(
+        (10, 10, 10), radius, counts, 4).values())
+    return CostModelSpec(
+        fn=sm, args=(jax.ShapeDtypeStruct((20, 20, 20), jnp.float32),),
+        expected_bytes_per_shard=expected)
+
+
+TARGETS = [
+    CostModelTarget("fixture.slab_bytes_sold_as_irredundant",
+                    _slab_sold_as_irredundant),
+]

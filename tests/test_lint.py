@@ -682,7 +682,8 @@ def test_cli_only_accepts_target_globs(tmp_path):
                                      "bad_linkmap.py",
                                      "bad_segment_carry.py",
                                      "bad_schedule.py",
-                                     "bad_precision.py"])
+                                     "bad_precision.py",
+                                     "bad_packing.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""
@@ -691,7 +692,8 @@ def test_cli_nonzero_on_every_fixture(fixture):
     if fixture in ("bad_hlo.py", "bad_plan.py", "bad_probe.py",
                    "bad_probe_metrics.py", "bad_megastep.py",
                    "bad_donation.py", "bad_migration.py",
-                   "bad_linkmap.py", "bad_segment_carry.py"):
+                   "bad_linkmap.py", "bad_segment_carry.py",
+                   "bad_packing.py"):
         from stencil_tpu.analysis.hlo import lowering_supported
 
         if not lowering_supported():

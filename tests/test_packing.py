@@ -1,0 +1,292 @@
+"""Irredundant halo wire layout (parallel/packing.py).
+
+The planner's telescoping property (every wire-halo cell rides exactly
+one message), the byte model against the slab twin, and the data-plane
+guarantee the layout ships under: bitwise equality with the slab
+exchange on the whole live window — periodic and zero-Dirichlet
+boundaries, even and uneven (+-1 remainder) shards, radius 1 and 3,
+full-precision and bf16 wire — plus the blocked (temporal) path and
+the PIC packed migration records that ride the same PR.
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from stencil_tpu.distributed import DistributedDomain
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.local_domain import raw_size
+from stencil_tpu.models.jacobi import Jacobi3D
+from stencil_tpu.parallel.packing import (WIRE_LAYOUTS,
+                                          irredundant_bytes_per_sweep,
+                                          normalize_wire_layout,
+                                          pack_layout_report, plan_sweep)
+from stencil_tpu.topology import Boundary
+
+MESH222 = (2, 2, 2)
+
+
+# ---------------------------------------------------------------------------
+# planner: the telescoping tiling property
+
+
+def _dst_cells(plan, interiors):
+    """The receiver cells one direction's box writes, with the two
+    traced ``plus_L`` placements resolved at the even-shard length."""
+    rngs = []
+    for j, s in enumerate(plan.dst):
+        start = s.base + (interiors[j] if s.plus_L else 0)
+        rngs.append(range(start, start + s.size))
+    return [(x, y, z) for x in rngs[0] for y in rngs[1] for z in rngs[2]]
+
+
+def _shell(radius, interiors):
+    """Every cell of the wire-radius halo shell: the padded window
+    minus the interior box (alloc pad == wire radius here)."""
+    win, inner = [], []
+    for a in range(3):
+        lo, hi = radius.face(a, -1), radius.face(a, 1)
+        win.append(range(0, lo + interiors[a] + hi))
+        inner.append(range(lo, lo + interiors[a]))
+    inner_set = {(x, y, z) for x in inner[0] for y in inner[1]
+                 for z in inner[2]}
+    return {(x, y, z) for x in win[0] for y in win[1] for z in win[2]
+            if (x, y, z) not in inner_set}
+
+
+@pytest.mark.parametrize("radius", [
+    Radius.constant(1), Radius.constant(2),
+    Radius.face_edge_corner(2, 1, 1),
+], ids=["r1", "r2", "fec211"])
+def test_dst_boxes_tile_halo_shell_exactly_once(radius):
+    """The layout's defining invariant: the six direction boxes tile
+    the wire-radius halo shell — every shell cell written by exactly
+    one message, no interior cell written, nothing missed."""
+    interiors = (6, 5, 4)
+    plans = plan_sweep(radius, None, interiors)
+    counts = Counter()
+    for plan in plans.values():
+        counts.update(_dst_cells(plan, interiors))
+    assert set(counts) == _shell(radius, interiors)
+    assert set(counts.values()) == {1}
+
+
+def test_asymmetric_radius_drops_zero_directions():
+    """Zero-radius directions ship no message; the surviving boxes
+    still tile exactly the (asymmetric) shell once."""
+    r = Radius.constant(0)
+    r.set_dir((1, 0, 0), 2)
+    r.set_dir((-1, 0, 0), 1)
+    r.set_dir((0, 1, 0), 1)
+    interiors = (5, 5, 5)
+    plans = plan_sweep(r, None, interiors)
+    assert set(plans) == {(0, 1), (0, -1), (1, 1)}
+    counts = Counter()
+    for plan in plans.values():
+        counts.update(_dst_cells(plan, interiors))
+    assert set(counts) == _shell(r, interiors)
+    assert set(counts.values()) == {1}
+
+
+def test_normalize_wire_layout():
+    assert normalize_wire_layout(None) == "slab"
+    for lay in WIRE_LAYOUTS:
+        assert normalize_wire_layout(lay) == lay
+    with pytest.raises(ValueError):
+        normalize_wire_layout("fat-slab")
+
+
+# ---------------------------------------------------------------------------
+# byte model: strictly below the slab twin wherever a diagonal carries
+
+
+def test_bytes_strictly_below_slab_with_diagonals():
+    from stencil_tpu.parallel.exchange import exchanged_bytes_per_sweep
+
+    counts = Dim3(*MESH222)
+    for padded, r in (((16, 16, 16), Radius.constant(1)),
+                      ((20, 20, 20), Radius.constant(3))):
+        slab = sum(exchanged_bytes_per_sweep(padded, r, counts, 4)
+                   .values())
+        irr = sum(irredundant_bytes_per_sweep(padded, r, counts, 4)
+                  .values())
+        assert 0 < irr < slab, (padded, irr, slab)
+
+
+def test_pack_layout_report_is_the_ci_artifact():
+    """Every canonical config saves bytes, and the report's figures
+    are exactly the model's (the registry pins the model against HLO,
+    so the artifact chain is report == model == wire)."""
+    rep = pack_layout_report()
+    assert rep
+    for name, row in rep.items():
+        assert row["irredundant_bytes"] < row["slab_bytes"], name
+        assert 0.0 < row["saved_fraction"] < 1.0, name
+    assert rep["exchange[r1]"]["irredundant_bytes"] == 5408
+    assert rep["exchange[r1]"]["slab_bytes"] == 6144
+
+
+def test_costmodel_sweep_matches_packing_model():
+    """analysis/costmodel.py's layout="irredundant" branch IS this
+    planner's model — one source of truth for the checker and tuner."""
+    from stencil_tpu.analysis.costmodel import sweep_wire_bytes
+
+    got = sweep_wire_bytes((16, 16, 16), Radius.constant(1),
+                           Dim3(*MESH222), 4, layout="irredundant")
+    want = irredundant_bytes_per_sweep((16, 16, 16), Radius.constant(1),
+                                       Dim3(*MESH222), 4)
+    assert got == want
+
+
+# ---------------------------------------------------------------------------
+# data plane: slab == irredundant BITWISE on the whole live window
+
+
+def _ripple_grid(n):
+    g = np.arange(n)
+    r = g + np.asarray([3.0, 7.0, 1.0, 5.0])[g % 4]
+    return (r[:, None, None] * 100.0 + r[None, :, None] * 10.0
+            + r[None, None, :]).astype(np.float32)
+
+
+def _exchanged_block(n, radius, boundary, wire, layout):
+    dd = DistributedDomain(n, n, n)
+    dd.set_mesh_shape(MESH222)
+    dd.set_radius(radius)
+    dd.set_boundary(boundary)
+    if wire is not None:
+        dd.set_wire_format(wire)
+    dd.set_wire_layout(layout)
+    dd.add_data("q", np.float32)
+    dd.realize()
+    dd.set_interior("q", _ripple_grid(n))
+    dd.exchange()
+    return np.asarray(dd.curr["q"]), dd
+
+
+@pytest.mark.parametrize("wire", [None, "bf16"], ids=["f32", "bf16"])
+@pytest.mark.parametrize("radius", [1, 3], ids=["r1", "r3"])
+@pytest.mark.parametrize("n", [16, 17], ids=["even16", "uneven17"])
+@pytest.mark.parametrize("boundary",
+                         [Boundary.PERIODIC, Boundary.NONE],
+                         ids=["periodic", "none"])
+def test_exchange_bitwise_matrix(boundary, n, radius, wire):
+    """The full guarantee matrix: after one exchange the two layouts
+    agree BITWISE on every shard's live window (interior plus the
+    wire-radius shell; beyond it lies a short shard's dead slack,
+    which no consumer reads). bf16 rides the same certificate-gated
+    narrowing either way, so even the rounded halos match exactly."""
+    slab, dd = _exchanged_block(n, radius, boundary, wire, "slab")
+    irr, _ = _exchanged_block(n, radius, boundary, wire, "irredundant")
+    pr = raw_size(dd.local_size, dd.radius)
+    lo, hi = dd.radius.pad_lo(), dd.radius.pad_hi()
+    dim = dd.placement.dim()
+    for bz in range(dim.z):
+        for by in range(dim.y):
+            for bx in range(dim.x):
+                sz = dd.placement.subdomain_size(Dim3(bx, by, bz))
+                live = np.s_[bz * pr.z:bz * pr.z + lo.z + sz.z + hi.z,
+                             by * pr.y:by * pr.y + lo.y + sz.y + hi.y,
+                             bx * pr.x:bx * pr.x + lo.x + sz.x + hi.x]
+                np.testing.assert_array_equal(slab[live], irr[live])
+
+
+def test_irredundant_rejected_after_realize():
+    dd = DistributedDomain(16, 16, 16)
+    dd.set_mesh_shape(MESH222)
+    dd.set_radius(1)
+    dd.add_data("q", np.float32)
+    dd.realize()
+    with pytest.raises(AssertionError):
+        dd.set_wire_layout("irredundant")
+
+
+# ---------------------------------------------------------------------------
+# blocked (temporal) path: fused == stepwise under the new layout
+
+
+def test_jacobi_irredundant_matches_slab_bitwise_uneven():
+    """End-to-end consumption: 6 Jacobi steps on uneven 17^3 shards
+    read every halo cell the exchange delivered; the two layouts'
+    temperatures are bitwise identical."""
+    out = {}
+    for layout in WIRE_LAYOUTS:
+        j = Jacobi3D(17, 8, 8, mesh_shape=MESH222, dtype=np.float64,
+                     kernel="xla", wire_layout=layout)
+        assert j.dd.rem == Dim3(1, 0, 0)
+        j.init()
+        j.run(6)
+        out[layout] = j.temperature()
+    np.testing.assert_array_equal(out["slab"], out["irredundant"])
+
+
+def test_jacobi_blocked_bitwise_irredundant_uneven():
+    """s-blocked == step-by-step BITWISE under the irredundant layout
+    (the deep exchange ships packed boxes at the deepened radius); 5
+    iterations so s=2 exercises a partial tail group."""
+    base = Jacobi3D(17, 8, 8, mesh_shape=MESH222, dtype=np.float64,
+                    kernel="xla", wire_layout="irredundant")
+    base.init()
+    base.run(5)
+    ref = base.temperature()
+    for s in (2, 4):
+        j = Jacobi3D(17, 8, 8, mesh_shape=MESH222, dtype=np.float64,
+                     kernel="xla", wire_layout="irredundant",
+                     exchange_every=s)
+        j.init()
+        j.run(5)
+        assert j.kernel_path == f"xla-temporal[s={s}]"
+        np.testing.assert_array_equal(j.temperature(), ref)
+
+
+def test_jacobi_irredundant_disables_pallas_fast_paths():
+    """The halo/overlap Pallas kernels run their own slab exchange, so
+    an EXPLICIT kernel='halo' request with the irredundant layout must
+    fail loudly instead of silently shipping slab bytes — and the auto
+    pick must route around the fast path."""
+    with pytest.raises(ValueError):
+        Jacobi3D(16, 16, 16, mesh_shape=MESH222, dtype=np.float32,
+                 kernel="halo", wire_layout="irredundant")
+    j = Jacobi3D(16, 16, 16, mesh_shape=MESH222, dtype=np.float32,
+                 kernel="auto", wire_layout="irredundant")
+    assert j.kernel_path.startswith("xla")
+
+
+# ---------------------------------------------------------------------------
+# PIC: packed migration records (one offset+validity row) on uneven mesh
+
+
+def test_pic_charge_conservation_packed_records_uneven():
+    """Total deposited charge is BITWISE-preserved across migrations on
+    an uneven 9^3 / 2x2x2 partition with the PACKED record layout: the
+    three per-axis offset rows and the validity flag ride ONE base-3
+    coded row, so record rows are n_fields + 1 and the migration's
+    collective bill (2 per crossing mesh axis) is unchanged."""
+    import jax
+
+    from stencil_tpu.models.pic import PARTICLE_FIELDS, Pic
+    from stencil_tpu.parallel.migrate import (RECORD_EXTRA_ROWS,
+                                              migration_messages,
+                                              migration_record_rows)
+
+    assert RECORD_EXTRA_ROWS == 1
+    nf = len(PARTICLE_FIELDS)
+    assert migration_record_rows(nf) == nf + 1
+    assert migration_messages(Dim3(*MESH222)) == 6
+
+    rng = np.random.default_rng(11)
+    n = 48
+    p = Pic(9, 9, 9, n, mesh_shape=MESH222, dtype=np.float64, dt=0.25,
+            deposition="ngp", capacity=24, devices=jax.devices()[:8])
+    assert p.dd.rem == Dim3(1, 1, 1)
+    p.set_particles({
+        "x": rng.uniform(0, 9, n), "y": rng.uniform(0, 9, n),
+        "z": rng.uniform(0, 9, n),
+        "vx": rng.uniform(-1, 1, n), "vy": rng.uniform(-1, 1, n),
+        "vz": rng.uniform(-1, 1, n), "q": np.ones(n),
+    })
+    for _ in range(5):
+        p.step()
+        assert p.total_charge() == float(n)
+    assert p.overflow_total() == 0

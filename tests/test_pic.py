@@ -162,7 +162,7 @@ def test_migration_messages_and_record_rows():
     assert migration_messages(Dim3(2, 2, 2)) == 6
     assert migration_messages(Dim3(1, 2, 1)) == 2
     assert migration_messages(Dim3(1, 1, 1)) == 0
-    assert migration_record_rows(7) == 11
+    assert migration_record_rows(7) == 8  # 7 fields + 1 packed control row
 
 
 # ----------------------------------------------------------------------
@@ -531,9 +531,9 @@ def test_migration_bytes_model_identity():
         migration_wire_bytes_per_shard)
 
     assert migration_wire_bytes_per_shard(7, 8, Dim3(2, 2, 2), 4) \
-        == 6 * 11 * 8 * 4
+        == 6 * 8 * 8 * 4
     assert migration_wire_bytes_per_shard(7, 8, Dim3(1, 1, 2), 4) \
-        == 2 * 11 * 8 * 4
+        == 2 * 8 * 8 * 4
 
 
 # ----------------------------------------------------------------------
@@ -595,5 +595,5 @@ def test_migration_stats_surface():
     p = _pic(8, 8, 8, n=24, capacity=16, budget=4)
     st = p.migration_stats()
     assert st["capacity"] == 16 and st["budget"] == 4
-    assert st["record_bytes"] == (len(PARTICLE_FIELDS) + 4) * 8
-    assert st["migration_bytes_per_shard"] == 6 * 11 * 4 * 8
+    assert st["record_bytes"] == (len(PARTICLE_FIELDS) + 1) * 8
+    assert st["migration_bytes_per_shard"] == 6 * 8 * 4 * 8
