@@ -29,11 +29,44 @@ from _common import (add_bench_record_flags, add_device_flags,
 
 
 def _parse_depths(text: str):
-    toks = [t.strip() for t in text.split(",")]
-    depths = sorted({int(t) for t in toks if t})
-    if not depths or any(s < 1 for s in depths):
+    """Comma list of depths to sweep. Plain integers are uniform
+    depths; ``axis=value`` tokens (``z=4,y=1,x=1``) merge into ONE
+    per-axis asymmetric candidate appended after the uniform sweep."""
+    ints = set()
+    axes = {}
+    for t in (t.strip() for t in text.split(",")):
+        if not t:
+            continue
+        if "=" in t:
+            k, v = t.split("=", 1)
+            k = k.strip().lower()
+            if k not in ("x", "y", "z"):
+                raise SystemExit(f"--exchange-every axis token wants "
+                                 f"x=/y=/z=, got {t!r}")
+            axes[k] = int(v)
+        else:
+            ints.add(int(t))
+    depths = sorted(ints)
+    if axes:
+        depths.append(axes)
+    bad = any(s < 1 for s in ints) or any(v < 1 for v in axes.values())
+    if not depths or bad:
         raise SystemExit(f"--exchange-every wants depths >= 1, got {text!r}")
     return depths
+
+
+def _depth_max(s) -> int:
+    return max(s.values()) if isinstance(s, dict) else int(s)
+
+
+def _depth_label(s) -> str:
+    """Stable config label: uniform depths keep the bare integer (the
+    historical trajectory key); per-axis depths read ``x.y.z``."""
+    if isinstance(s, dict):
+        from stencil_tpu.geometry import normalize_depths
+        d = normalize_depths(s)
+        return f"{d.x}.{d.y}.{d.z}"
+    return str(s)
 
 
 def main() -> None:
@@ -48,7 +81,9 @@ def main() -> None:
     ap.add_argument("--iters", "-n", type=int, default=30)
     ap.add_argument("--exchange-every", default="1", metavar="S[,S...]",
                     help="temporal-blocking depths to sweep (comma "
-                         "list; 1 = the classic per-step exchange)")
+                         "list; 1 = the classic per-step exchange; "
+                         "axis=value tokens like z=4,y=1,x=1 merge "
+                         "into one per-axis asymmetric candidate)")
     ap.add_argument("--wire-layout", default="slab", metavar="L[,L...]",
                     help="halo wire message layouts (comma list of "
                          "slab,irredundant): the first is the sweep's "
@@ -119,11 +154,11 @@ def main() -> None:
         (``_common.grouped_steps_per_s``)."""
         j = Jacobi3D(gx, gy, gz, mesh_shape=mesh_shape,
                      dtype=np.float32, kernel="xla", methods=methods,
-                     exchange_every=s if s > 1 else None,
+                     exchange_every=s if _depth_max(s) > 1 else None,
                      wire_layout=layout)
         j.init()
         n, dt, sps = grouped_steps_per_s(j.run, j.block, args.iters,
-                                         group=s)
+                                         group=_depth_max(s))
         return n, dt, sps, j
 
     def make_domain(layout=primary_layout, s=1):
@@ -132,7 +167,7 @@ def main() -> None:
         dd.set_radius(Radius.face_edge_corner(args.fr, args.er, args.cr))
         dd.set_methods(methods_from_args(args))
         dd.set_wire_layout(layout)
-        if s > 1:
+        if _depth_max(s) > 1:
             dd.set_exchange_every(s)
         for i in range(args.fields):
             dd.add_data(f"q{i}", np.float32)
@@ -151,7 +186,7 @@ def main() -> None:
         tm = stats.trimean()
         print(csv_line("bench_exchange", dd.methods, ndev,
                        args.x, args.y, args.z, args.fr, args.er, args.cr,
-                       args.fields, s, per_ex,
+                       args.fields, _depth_label(s), per_ex,
                        f"{tm:.6e}", f"{per_ex / tm:.6e}"))
 
         # honest steps/s: the REAL blocked hot path (deep exchange +
@@ -159,8 +194,14 @@ def main() -> None:
         # Jacobi model's radius-1 run loop on the same grid
         n, dt, _, j = jacobi_steps_per_s(methods_from_args(args), s)
         xs = j.exchange_stats()
+        row_extra = {}
+        if isinstance(s, dict):
+            d = j.dd.exchange_depths
+            row_extra["depths"] = [d.x, d.y, d.z]
         results.append({
-            "exchange_every": s,
+            "exchange_every": (s if isinstance(s, int)
+                               else _depth_label(s)),
+            **row_extra,
             "steps": n,
             "seconds": dt,
             "steps_per_s": n / dt,
@@ -171,8 +212,9 @@ def main() -> None:
             "jacobi_bytes_per_step_model": xs["bytes_per_iteration"],
             "trimean_exchange_s": tm,
         })
-        print(f"bench_exchange steps: s={s} steps/s={n / dt:.3f} "
-              f"(jacobi blocked loop) rounds/step={1.0 / s:.3f} "
+        print(f"bench_exchange steps: s={_depth_label(s)} "
+              f"steps/s={n / dt:.3f} (jacobi blocked loop) "
+              f"rounds/step={xs['rounds_per_iteration']:.3f} "
               f"amortized={dd.exchange_bytes_amortized_per_step():.0f}"
               f"B/step (model)", file=sys.stderr)
 
@@ -195,7 +237,7 @@ def main() -> None:
                     f"{axis}/{klass}": {
                         "bytes_per_step": b,
                         "share": b / total,
-                        "utilization": (b * s / tm)
+                        "utilization": (b * _depth_max(s) / tm)
                         / link["peak_bytes_per_s"].get(axis, 1e30),
                     }
                     for (axis, klass), b

@@ -156,9 +156,11 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ] && [ -f pack_layout_report.json ]; then
 fi
 # the link observatory artifact: the modeled per-link traffic matrix
 # (whose per-method totals the linkmap checker just pinned HLO-exactly
-# above) plus the placement-quality report — QAP placement cost must
-# not lose to trivial placement on any registered mesh (ROADMAP item
-# 3's gate, exit nonzero on failure)
+# above) plus the placement-quality report — both the QAP hill-climb
+# AND the placement make_placement(mode="auto") actually DEPLOYS (the
+# new default: QAP on non-uniform fabrics, trivial on uniform ones)
+# must not lose to trivial placement on any registered mesh (ROADMAP
+# item 3's gate, exit nonzero on failure)
 python -m stencil_tpu.observatory linkmap --placement-report \
   --json stencil_linkmap.json > /dev/null
 if [ -n "${CI_ARTIFACT_DIR:-}" ] && [ -f stencil_linkmap.json ]; then
@@ -258,10 +260,16 @@ TUNE_CACHE="$(mktemp -t tune_cache.XXXXXX.json)"; rm -f "$TUNE_CACHE"
 # runs append their versioned records to it; the observatory stage (9)
 # validates it, gates it, and proves a synthetic regression fails
 OBS_LEDGER="$(mktemp -t obs_ledger.XXXXXX.jsonl)"; rm -f "$OBS_LEDGER"
+# the exchange-every sweep carries the per-axis asymmetric leg
+# (z=4,y=1,x=1: deep temporal blocking on z only — the DCN-crossing
+# axis on hierarchical fabrics — while x/y refresh every step); its
+# record must land in the ledger with the config.depths stamp the
+# observatory keys asymmetric trajectories by
 ( cd apps
   STENCIL_BENCH_LEDGER="$OBS_LEDGER" \
   python bench_exchange.py --x 8 --y 8 --z 8 --iters 20 --fake-cpu 8 \
-        --exchange-every 1,4 --autotune --tune-cache "$TUNE_CACHE" \
+        --exchange-every 1,4,z=4,y=1,x=1 --autotune \
+        --tune-cache "$TUNE_CACHE" \
         --fuse-segments --check-every 8 \
         --wire-layout slab,irredundant \
         --json-out "$BENCH_JSON" --metrics-json "$BENCH_METRICS" )
@@ -341,11 +349,22 @@ assert d["wire_layout"] == "slab", d["wire_layout"]
 race = d["wire_layout_race"]["races"]["irredundant"]
 assert 0 < race["bytes_ratio"] < 1, race
 assert race["steps_per_s"] > 0, race
+# asymmetric-depth leg: the z=4,y=1,x=1 config must exist in the
+# sweep with its per-axis depths surfaced, and its ledger record must
+# carry the config.depths stamp (stamped post-fingerprint so uniform
+# trajectories never fork; the observatory groups asym runs by it)
+asym = [c for c in d["configs"] if c["exchange_every"] == "1.1.4"]
+assert asym and asym[0].get("depths") == [1, 1, 4], d["configs"]
+assert asym[0]["steps_per_s"] > 0, asym
 led = [json.loads(l) for l in open(os.environ["OBS_LEDGER"])
        if l.strip()]
 mine = [r for r in led if r.get("bench") == "bench_exchange"]
 assert mine and mine[-1]["config"].get("wire_layout") == "slab", \
     "ledger record missing config.wire_layout stamp"
+led_asym = [r for r in mine
+            if r["config"].get("exchange_every") == "1.1.4"]
+assert led_asym and led_asym[-1]["config"].get("depths") == [1, 1, 4], \
+    "asymmetric-depth ledger record missing config.depths stamp"
 print(f"bench smoke OK: rounds/step x{1/rounds['4']:.0f} fewer, "
       f"steps/s ratio {speed['4']:.2f}, tuned/default "
       f"x{at['tuned_over_default']:.2f} "

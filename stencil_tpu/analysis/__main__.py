@@ -18,23 +18,10 @@ under ``tests/fixtures/lint/``.
 from __future__ import annotations
 
 import argparse
-import fnmatch
 import sys
 from typing import List, Optional
 
-
-def _match(name: str, pattern: str) -> bool:
-    """fnmatch with literal-bracket tolerance: registry names like
-    ``analysis.tiling.jacobi_halo[512]`` collide with fnmatch's
-    character classes, so try the raw pattern first (old ``?512?``
-    spellings keep working) and then a variant with every ``[``
-    escaped to the ``[[]`` character class — ``--only
-    'analysis.schedule.*[k=4]'`` just works."""
-    if fnmatch.fnmatchcase(name, pattern):
-        return True
-    if "[" in pattern:
-        return fnmatch.fnmatchcase(name, pattern.replace("[", "[[]"))
-    return False
+from ..utils.naming import glob_match as _match
 
 
 def _setup_backend() -> None:

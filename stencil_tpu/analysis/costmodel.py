@@ -260,6 +260,39 @@ class LinkCoefficients:
 DEFAULT_ICI_COEFFS = LinkCoefficients(alpha_s=20e-6,
                                       beta_bytes_per_s=4.5e10)
 
+#: assumed DCN (inter-slice) constants: ~10x the ICI launch+hop latency
+#: and ~a quarter of its sustained rate — coarse on purpose, replaced
+#: by the measured topology fingerprint's "dcn" link when available
+DEFAULT_DCN_COEFFS = LinkCoefficients(alpha_s=200e-6,
+                                      beta_bytes_per_s=1.25e10)
+
+AXIS_NAMES = ("x", "y", "z")
+
+
+def resolve_link_coeffs(coeffs, axis: "int | None" = None,
+                        dcn: bool = False) -> LinkCoefficients:
+    """The :class:`LinkCoefficients` pricing one mesh axis's exchange.
+
+    ``coeffs`` may be None (assumed defaults: :data:`DEFAULT_DCN_COEFFS`
+    for a DCN-blocked axis, :data:`DEFAULT_ICI_COEFFS` otherwise), one
+    ``LinkCoefficients`` applied to every link, or a dict keyed by link
+    name — per-axis ``"x"``/``"y"``/``"z"``, the ``"dcn"`` tier, and an
+    ``"ici"`` catch-all (the shape ``observatory.linkmap.
+    topology_coefficients`` produces from a measured fingerprint)."""
+    if coeffs is None:
+        return DEFAULT_DCN_COEFFS if dcn else DEFAULT_ICI_COEFFS
+    if isinstance(coeffs, LinkCoefficients):
+        return coeffs
+    if dcn and "dcn" in coeffs:
+        return coeffs["dcn"]
+    if axis is not None and AXIS_NAMES[axis] in coeffs:
+        return coeffs[AXIS_NAMES[axis]]
+    if "ici" in coeffs:
+        return coeffs["ici"]
+    if dcn:
+        return DEFAULT_DCN_COEFFS
+    return next(iter(coeffs.values()), DEFAULT_ICI_COEFFS)
+
 
 def exchange_round_model(method_name: str,
                          shard_interior_zyx: Sequence[int], radius,
@@ -333,24 +366,173 @@ def exchange_round_model(method_name: str,
     return messages, nbytes
 
 
-def configured_step_seconds(method_name: str,
+def per_axis_round_model(method_name: str,
+                         shard_interior_zyx: Sequence[int], radius,
+                         counts, elem_sizes: Sequence[int],
+                         steps=1,
+                         dtype_groups: "int | None" = None,
+                         wire_format=None,
+                         wire_layout: str = "slab"
+                         ) -> Dict[str, Tuple[int, int]]:
+    """:func:`exchange_round_model` split per mesh axis: analytic
+    ``{axis: (messages, wire_bytes)}`` ONE shard contributes per
+    full-depth refresh of that axis. ``steps`` may be per-axis
+    (``geometry.normalize_depths``): axis ``a``'s refresh ships
+    ``s_a * r`` rows over the full deepened cross-sections — under
+    asymmetric blocking the axis refreshes ``max(s) / s_a`` times per
+    group, so its per-STEP price is this entry over ``s_a`` (see
+    :func:`asymmetric_step_seconds`). Summing axes at uniform depth
+    reproduces :func:`exchange_round_model` exactly."""
+    from ..geometry import normalize_depths
+
+    depths = normalize_depths(steps)
+    deep = radius.deepened(depths)
+    lo, hi = deep.pad_lo(), deep.pad_hi()
+    z, y, x = shard_interior_zyx
+    padded = (z + lo.z + hi.z, y + lo.y + hi.y, x + lo.x + hi.x)
+    wire_capable = method_name in ("PpermuteSlab", "PpermutePacked")
+    wf = wire_format if wire_capable else None
+    layout = wire_layout if wire_capable else "slab"
+    if method_name == "PpermutePacked":
+        groups = (int(dtype_groups) if dtype_groups
+                  else len(set(elem_sizes)))
+    else:
+        groups = len(elem_sizes)
+    per_axis_bytes = [sweep_wire_bytes(padded, deep, counts, esize,
+                                       wire_format=wf, layout=layout)
+                      for esize in elem_sizes]
+    out: Dict[str, Tuple[int, int]] = {}
+    for a, name in ((0, "x"), (1, "y"), (2, "z")):
+        directions = 0
+        if counts[a] > 1:
+            for side in (-1, 1):
+                if deep.face(a, side) > 0:
+                    directions += 1
+        nbytes = 0
+        for b in per_axis_bytes:
+            v = b[name]
+            if method_name == "AllGather":
+                v *= max(counts[a] - 1, 1)
+            nbytes += v
+        out[name] = (directions * groups, nbytes)
+    return out
+
+
+def asymmetric_group_bytes_per_shard(shard_interior_zyx: Sequence[int],
+                                     radius, counts, elem_size: int,
+                                     depths,
+                                     wire_layout: str = "slab") -> int:
+    """Wire bytes ONE shard puts on the fabric per ``max(depths)``-step
+    temporal group under per-axis depths: the sub-step-0 full exchange
+    plus every mid-group refresh — axis ``a`` ships its deep slab
+    ``max(s) / s_a`` times (``parallel.temporal.refresh_axes``). The
+    HLO expectation for the asymmetric group registry targets; uniform
+    depths collapse to :func:`deep_exchange_bytes_per_shard`."""
+    from ..geometry import normalize_depths
+
+    depths = normalize_depths(depths)
+    s = max(depths)
+    per_axis = per_axis_round_model(
+        "PpermuteSlab", shard_interior_zyx, radius, counts, [elem_size],
+        depths, wire_layout=wire_layout)
+    return sum(per_axis[AXIS_NAMES[a]][1] * (s // depths[a])
+               for a in range(3))
+
+
+def asymmetric_step_seconds(method_name: str,
                             shard_interior_zyx: Sequence[int], radius,
                             counts, elem_sizes: Sequence[int],
-                            steps: int,
-                            coeffs: LinkCoefficients = DEFAULT_ICI_COEFFS,
+                            depths, coeffs=None,
+                            dcn_axis: "int | None" = None,
                             dtype_groups: "int | None" = None,
                             wire_format=None,
                             wire_layout: str = "slab") -> float:
+    """Per-link alpha-beta exchange seconds per STEP under per-axis
+    temporal depths: axis ``a`` pays its refresh price
+    ``coeffs[link(a)].seconds(messages_a, bytes_a)`` once per ``s_a``
+    steps — deep blocking across a DCN axis divides that axis's
+    (expensive) launch count by ``s_a`` while the ICI axes keep their
+    cheap per-step refreshes. ``coeffs``/``dcn_axis`` route through
+    :func:`resolve_link_coeffs`."""
+    from ..geometry import normalize_depths
+
+    depths = normalize_depths(depths)
+    per_axis = per_axis_round_model(
+        method_name, shard_interior_zyx, radius, counts, elem_sizes,
+        depths, dtype_groups, wire_format=wire_format,
+        wire_layout=wire_layout)
+    total = 0.0
+    for a in range(3):
+        m, b = per_axis[AXIS_NAMES[a]]
+        c = resolve_link_coeffs(coeffs, axis=a, dcn=a == dcn_axis)
+        total += c.seconds(m, b) / depths[a]
+    return total
+
+
+def predict_exchange_depths(shard_interior_zyx: Sequence[int], radius,
+                            counts, elem_size: int, coeffs=None,
+                            dcn_axis: "int | None" = None,
+                            candidates: Sequence = (1, 2, 4, 8)
+                            ) -> Tuple[Tuple[int, int, int],
+                                       Dict[Tuple[int, int, int], float]]:
+    """:func:`predict_exchange_every` generalized to per-axis depths
+    priced per link: each candidate (an int or a per-axis spec) is
+    scored with :func:`asymmetric_step_seconds`; geometry-infeasible
+    depths are skipped. Returns ``(best, {depths_xyz: seconds})``."""
+    from ..geometry import normalize_depths
+
+    z, y, x = shard_interior_zyx
+    interior_xyz = (x, y, z)
+    costs: Dict[Tuple[int, int, int], float] = {}
+    for cand in candidates:
+        d = normalize_depths(cand)
+        if any(d[a] * max(radius.face(a, -1), radius.face(a, 1))
+               > interior_xyz[a] for a in range(3)):
+            continue
+        costs[tuple(d)] = asymmetric_step_seconds(
+            "PpermuteSlab", shard_interior_zyx, radius, counts,
+            [elem_size], d, coeffs=coeffs, dcn_axis=dcn_axis)
+    if not costs:
+        raise ValueError(f"no candidate depth fits shards "
+                         f"{shard_interior_zyx} with radius {radius}")
+    return min(costs, key=costs.get), costs
+
+
+def configured_step_seconds(method_name: str,
+                            shard_interior_zyx: Sequence[int], radius,
+                            counts, elem_sizes: Sequence[int],
+                            steps,
+                            coeffs=DEFAULT_ICI_COEFFS,
+                            dtype_groups: "int | None" = None,
+                            wire_format=None,
+                            wire_layout: str = "slab",
+                            dcn_axis: "int | None" = None) -> float:
     """Alpha-beta exchange seconds per STEP of one (method,
     exchange_every) configuration: the deep round's cost spread over
     the ``steps`` steps it feeds — :func:`temporal_step_exchange_seconds`
     generalized across exchange strategies. The autotuner calls this
-    with MEASURED coefficients to prune the sweep before timing."""
-    messages, nbytes = exchange_round_model(
+    with MEASURED coefficients to prune the sweep before timing.
+
+    ``steps`` may be per-axis and ``coeffs`` a per-link dict (with
+    ``dcn_axis`` naming the slice-blocked axis) — those route through
+    :func:`asymmetric_step_seconds`; the uniform single-link case keeps
+    the original one-term arithmetic exactly."""
+    from ..geometry import normalize_depths
+
+    depths = normalize_depths(steps)
+    uniform = depths.x == depths.y == depths.z
+    if uniform and isinstance(coeffs, LinkCoefficients) \
+            and dcn_axis is None:
+        messages, nbytes = exchange_round_model(
+            method_name, shard_interior_zyx, radius, counts, elem_sizes,
+            depths.x, dtype_groups, wire_format=wire_format,
+            wire_layout=wire_layout)
+        return coeffs.seconds(messages, nbytes) / depths.x
+    return asymmetric_step_seconds(
         method_name, shard_interior_zyx, radius, counts, elem_sizes,
-        steps, dtype_groups, wire_format=wire_format,
+        depths, coeffs=coeffs, dcn_axis=dcn_axis,
+        dtype_groups=dtype_groups, wire_format=wire_format,
         wire_layout=wire_layout)
-    return coeffs.seconds(messages, nbytes) / steps
 
 
 @dataclasses.dataclass

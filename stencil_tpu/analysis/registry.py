@@ -530,6 +530,61 @@ def _temporal_group_cost(s: int = 2) -> CostModelSpec:
                          expected_bytes_per_shard=expected)
 
 
+#: the per-axis depth vector the asymmetric-group targets pin:
+#: deep z (the DCN-friendly axis), shallow x/y — Dim3(1, 1, 2)
+_ASYM_DEPTHS = ((1, 1, 2),)
+
+
+def _temporal_group_asym_spec(depths=None) -> CollectiveSpec:
+    """The PER-AXIS (asymmetric) temporal group: one exchange shipping
+    each axis at its own depth, then ``max(depths)`` sub-steps with
+    mid-group refreshes of the shallow axes (``refresh_axes``). Audited
+    like the uniform group — ppermute bijections, collective-permute-
+    only lowering, and the asymmetric byte model matching the HLO."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..geometry import Radius, normalize_depths
+    from ..ops.stencil_kernels import jacobi7
+    from ..parallel.mesh import mesh_dim
+    from ..parallel.methods import Method
+    from ..parallel.temporal import temporal_shard_steps
+
+    d = normalize_depths(depths if depths is not None
+                         else _ASYM_DEPTHS[0])
+    mesh = _mesh(_EXCHANGE_MESH)
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+
+    def upd(blocks, dims, off, k):
+        return {"q": jacobi7(blocks["q"], radius, dims)}
+
+    def shard(p):
+        return temporal_shard_steps({"q": p}, radius, counts,
+                                    Method.PpermuteSlab, upd, d)["q"]
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    # 8^3 interiors + per-axis deep pads, (z, y, x) layout
+    sides = (8 + 2 * d.z, 8 + 2 * d.y, 8 + 2 * d.x)
+    g = tuple(side * m for side, m in zip(sides, _EXCHANGE_MESH))
+    return CollectiveSpec(fn=sm, args=(_f32(g),),
+                          axis_sizes=dict(mesh.shape),
+                          expect_ppermute=True)
+
+
+def _temporal_group_asym_cost(depths=None) -> CostModelSpec:
+    from ..geometry import Dim3, Radius
+    from .costmodel import asymmetric_group_bytes_per_shard
+
+    d = depths if depths is not None else _ASYM_DEPTHS[0]
+    cs = _temporal_group_asym_spec(d)
+    expected = asymmetric_group_bytes_per_shard(
+        (8, 8, 8), Radius.constant(1), Dim3(*_EXCHANGE_MESH), 4, d)
+    return CostModelSpec(fn=cs.fn, args=cs.args,
+                         expected_bytes_per_shard=expected)
+
+
 def _deep_tail_exchange_spec() -> CollectiveSpec:
     """The partial-depth exchange on a deep-carry allocation (the tail
     steps of a blocked loop): wire depth r on s*r pads."""
@@ -1396,6 +1451,70 @@ def _linkmap_plan_spec(method_name: str, s: int) -> LinkmapSpec:
         method_name, (_PLAN_INTERIOR,) * 3, Radius.constant(1),
         Dim3(*_EXCHANGE_MESH), (4,), steps=s)
     return LinkmapSpec(fn=cs.fn, args=cs.args, traffic=traffic)
+
+
+def _linkmap_temporal_asym_spec(depths=None) -> LinkmapSpec:
+    """The asymmetric temporal group's traffic matrix: the group
+    matrix carries axis ``a``'s deep slab ``max(s) / s_a`` times (the
+    mid-group refreshes), and its per-shard row sum must equal the
+    group program's HLO wire bytes exactly."""
+    from ..geometry import Dim3, Radius, normalize_depths
+    from ..observatory.linkmap import method_traffic
+
+    d = depths if depths is not None else _ASYM_DEPTHS[0]
+    cs = _temporal_group_asym_spec(d)
+    traffic = method_traffic("PpermuteSlab", (8, 8, 8),
+                             Radius.constant(1), Dim3(*_EXCHANGE_MESH),
+                             (4,), steps=normalize_depths(d))
+    return LinkmapSpec(fn=cs.fn, args=cs.args, traffic=traffic)
+
+
+@functools.lru_cache(maxsize=None)
+def _hier_dcn_domain():
+    """The hierarchical partition planner's actual deployment on a
+    DCN-blocked fake mesh: 2 fake slices of 4 devices each, mesh shape
+    and slice axis chosen by ``_plan_dcn_partition`` (per-link priced),
+    placement by the ``auto`` default."""
+    import jax
+    import numpy as np
+
+    from ..distributed import DistributedDomain
+
+    devs = jax.devices()[:8]
+    dd = DistributedDomain(32, 16, 16, devices=devs)
+    dd.set_radius(1)
+    dd.add_data("q", np.float32)
+    dd.set_dcn_axis(groups=[devs[:4], devs[4:]])
+    dd.realize()
+    return dd
+
+
+def _linkmap_hier_dcn_spec() -> LinkmapSpec:
+    """The hierarchical partition's per-link byte split, HLO-exact on
+    the DCN-blocked mesh — plus the deployed placement payload: the
+    assignment realize() shipped must cost no more than trivial device
+    order under the NodeAware objective on the two-tier fabric."""
+    from ..observatory.linkmap import sweep_traffic
+    from ..parallel.mesh import mesh_dim
+
+    dd = _hier_dcn_domain()
+    local = dd.local_size
+    lo, hi = dd.alloc_radius.pad_lo(), dd.alloc_radius.pad_hi()
+    counts = mesh_dim(dd.mesh)
+    traffic = sweep_traffic((local.z + lo.z + hi.z,
+                             local.y + lo.y + hi.y,
+                             local.x + lo.x + hi.x), dd.radius,
+                            counts, (4,), alloc_radius=dd.alloc_radius)
+    placement = {
+        "counts": tuple(counts),
+        "grid": tuple(dd.size),
+        "assignment": list(dd.placement.assignment),
+        "radius": dd.radius,
+        "dcn_axis": dd.dcn_axis,
+        "n_slices": dd.n_slices,
+    }
+    return LinkmapSpec(fn=dd._exchange_fn, args=(dict(dd.curr),),
+                       traffic=traffic, placement=placement)
 
 
 def _linkmap_allgather_spec() -> LinkmapSpec:
@@ -2568,6 +2687,8 @@ def default_targets() -> List[Target]:
                          lambda: _temporal_group_spec(2)),
         CollectiveTarget("parallel.temporal.temporal_shard_steps[s=4]",
                          lambda: _temporal_group_spec(4)),
+        CollectiveTarget("parallel.temporal.temporal_shard_steps[s=1.1.2]",
+                         _temporal_group_asym_spec),
         CollectiveTarget("parallel.exchange.exchange_shard[deep-tail]",
                          _deep_tail_exchange_spec),
     ]
@@ -2597,6 +2718,9 @@ def default_targets() -> List[Target]:
         HloTarget("parallel.temporal.temporal_shard_steps[s=2,hlo]",
                   lambda: _hlo_from_collective(
                       lambda: _temporal_group_spec(2))),
+        HloTarget("parallel.temporal.temporal_shard_steps[s=1.1.2,hlo]",
+                  lambda: _hlo_from_collective(
+                      _temporal_group_asym_spec)),
         HloTarget("parallel.exchange.exchange_shard[deep-tail,hlo]",
                   lambda: _hlo_from_collective(_deep_tail_exchange_spec)),
     ]
@@ -2627,6 +2751,9 @@ def default_targets() -> List[Target]:
                         lambda: _temporal_group_cost(2)),
         CostModelTarget("parallel.temporal.temporal_shard_steps[s=4,cost]",
                         lambda: _temporal_group_cost(4)),
+        CostModelTarget(
+            "parallel.temporal.temporal_shard_steps[s=1.1.2,cost]",
+            _temporal_group_asym_cost),
         CostModelTarget("parallel.exchange.exchange_shard[deep-tail,cost]",
                         _deep_tail_exchange_cost),
     ]
@@ -2768,6 +2895,10 @@ def default_targets() -> List[Target]:
                       lambda: _linkmap_plan_spec("PpermuteSlab", 2)),
         LinkmapTarget("observatory.linkmap.plan[PpermutePacked,s=4]",
                       lambda: _linkmap_plan_spec("PpermutePacked", 4)),
+        LinkmapTarget("observatory.linkmap.plan[PpermuteSlab,s=1.1.2]",
+                      _linkmap_temporal_asym_spec),
+        LinkmapTarget("observatory.linkmap.hierarchical[dcn]",
+                      _linkmap_hier_dcn_spec),
         LinkmapTarget("observatory.linkmap.allgather",
                       _linkmap_allgather_spec),
         LinkmapTarget("observatory.linkmap.migrate",

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .geometry import Dim3, Dim3Like, Radius, Rect3
+from .geometry import (DepthsLike, Dim3, Dim3Like, Radius, Rect3,
+                       normalize_depths)
 from .local_domain import (LocalDomain, get_exterior as _dom_exterior,
                            get_interior as _dom_interior, raw_size, zyx_shape)
 from .parallel.exchange import (exchanged_bytes_per_sweep, make_exchange,
@@ -42,8 +43,10 @@ from .parallel.packing import (irredundant_bytes_per_sweep,
 from .parallel.mesh import make_mesh, mesh_dim
 from .parallel.methods import Method, pick_method
 from .numerics import div_ceil
-from .partition import RankPartition, partition_dims_even
-from .placement import Placement, PlacementStrategy, make_placement
+from .partition import (RankPartition, exact_partition_candidates,
+                        partition_dims_even)
+from .placement import (Placement, PlacementStrategy, make_placement,
+                        normalize_placement_mode)
 from .topology import Boundary, Topology
 from .utils.logging import LOG_INFO
 
@@ -66,7 +69,15 @@ class DistributedDomain:
         # temporal blocking: one depth-(s*r) exchange per s steps
         # (communication avoidance; parallel/temporal.py). The
         # allocation pads deepen to s*r so the deep slabs have a home.
+        # Depths may be per-axis (exchange_depths) — deep blocking
+        # across a DCN axis, per-step exchange on ICI; exchange_every
+        # stays the group length max(depths) for the step loop.
         self.exchange_every = 1
+        self.exchange_depths = Dim3(1, 1, 1)
+        # placement mode: "auto" deploys the QAP assignment on
+        # non-uniform fabrics, "trivial"/"qap" force one side
+        # (placement.make_placement)
+        self.placement_mode = "auto"
         self.alloc_radius = self.radius
         # halo wire format ("f32" | "bf16" | per-axis dict): a
         # narrowing format is certificate-gated at realize() —
@@ -81,6 +92,7 @@ class DistributedDomain:
         # hierarchical DCN tier (set_dcn_axis); populated by realize()
         self._dcn_requested = False
         self._dcn_axis_req: Optional[int] = None
+        self._dcn_axis_planned: Optional[int] = None
         self._dcn_groups = None
         self.dcn_axis: Optional[int] = None
         self.n_slices: int = 1
@@ -123,8 +135,17 @@ class DistributedDomain:
     def set_methods(self, m: Method) -> None:
         self.methods = m
 
-    def set_placement(self, s: PlacementStrategy) -> None:
-        self.strategy = s
+    def set_placement(self, s: Union[PlacementStrategy, str]) -> None:
+        """A :class:`~stencil_tpu.placement.PlacementStrategy` selects
+        the placement family (NodeAware/Trivial/IntraNodeRandom); a
+        string ``"auto"`` | ``"qap"`` | ``"trivial"`` sets the
+        NodeAware placement MODE instead — whether the QAP assignment
+        deploys (``"auto"``: only on non-uniform fabrics, the default;
+        see ``placement.make_placement``)."""
+        if isinstance(s, PlacementStrategy):
+            self.strategy = s
+        else:
+            self.placement_mode = normalize_placement_mode(s)
 
     def set_mesh_shape(self, shape: Dim3Like) -> None:
         """Explicit subdomain-grid shape (the set_gpus analog —
@@ -138,7 +159,7 @@ class DistributedDomain:
     def set_boundary(self, b: Boundary) -> None:
         self.boundary = b
 
-    def set_exchange_every(self, s: int) -> None:
+    def set_exchange_every(self, s: DepthsLike) -> None:
         """Temporal blocking depth: ``exchange()`` ships a depth-
         ``s * r`` halo once per ``s`` steps instead of a depth-``r``
         halo every step (communication avoidance — ``s``x fewer
@@ -148,6 +169,15 @@ class DistributedDomain:
         application) owns calling ``exchange()`` every ``s``-th step
         and consuming one radius ring per sub-step.
 
+        ``s`` may be PER-AXIS (``{"z": 4, "y": 1, "x": 1}``, a
+        3-tuple, or a Dim3; see ``geometry.normalize_depths``): deep
+        blocking across a slow (DCN) axis while cheap ICI axes keep
+        per-step refreshes — the temporal engine exchanges axis ``a``
+        every ``s_a`` sub-steps of the ``max(s)``-step group
+        (``parallel/temporal.py``). ``exchange_every`` stays the group
+        length ``max(s)``. Asymmetric (non-uniform) depths require the
+        slab wire layout and the XLA temporal path.
+
         Note: allocations deepen (and the min-shard feasibility check
         tightens) even if a Pallas fast path later takes the blocking
         depth in-kernel and never runs this deep exchange — the cost
@@ -156,9 +186,11 @@ class DistributedDomain:
             raise RuntimeError("set_exchange_every before realize() — "
                                "the allocation pads and the exchange "
                                "program are already built")
-        if int(s) < 1:
+        if isinstance(s, int) and s < 1:
             raise ValueError(f"exchange_every must be >= 1, got {s}")
-        self.exchange_every = int(s)
+        depths = normalize_depths(s)
+        self.exchange_depths = depths
+        self.exchange_every = max(depths)
 
     def set_wire_format(self, fmt) -> None:
         """Per-axis halo wire format: ``"f32"`` (identity, the
@@ -261,8 +293,14 @@ class DistributedDomain:
         (``Jacobi3D``/``Astaroth`` ``overlap=``) — the orchestrator's
         own exchange program has no overlap variant."""
         self.methods = Method[plan.config.method]
-        if plan.config.exchange_every != self.exchange_every:
+        depths = getattr(plan.config, "depths", None)
+        if depths is not None:
+            self.set_exchange_every(tuple(depths))
+        elif plan.config.exchange_every != self.exchange_every:
             self.set_exchange_every(plan.config.exchange_every)
+        mode = getattr(plan, "placement", "auto")
+        if mode != self.placement_mode:
+            self.placement_mode = normalize_placement_mode(mode)
         wf = getattr(plan.config, "wire_format", "f32")
         if wf != self.wire_format:
             self.set_wire_format(wf)
@@ -300,20 +338,24 @@ class DistributedDomain:
             if dim.flatten() != n:
                 raise ValueError(f"mesh shape {dim} != device count {n}")
         elif self._dcn_requested and self.n_slices > 1:
-            # two-level interface-minimizing split: slices (DCN tier) x
-            # devices-per-slice (ICI tier)
-            from .partition import NodePartition
-            npart = NodePartition(self.size, self.radius, self.n_slices,
-                                  n // self.n_slices)
-            dim = npart.dim()
-            if self.size % dim != Dim3(0, 0, 0):
-                # XLA wants equal shards; fall back to an exact split,
-                # else the greedy +-1 split (same ladder as the flat
-                # path below)
-                try:
-                    dim = partition_dims_even(self.size, n)
-                except ValueError:
-                    dim = RankPartition(self.size, n).dim()
+            # hierarchical DCN-minimizing split: price every exact
+            # (mesh shape x slice-blocked axis) candidate with the
+            # per-link cost model so the largest halo cross-sections
+            # land on ICI axes and only slice-boundary faces cross DCN
+            dim = self._plan_dcn_partition(n)
+            if dim is None:
+                # no exact candidate admits the slice blocking: the
+                # two-level interface-minimizing split (the reference's
+                # NodePartition), then the same ladder as the flat path
+                from .partition import NodePartition
+                npart = NodePartition(self.size, self.radius,
+                                      self.n_slices, n // self.n_slices)
+                dim = npart.dim()
+                if self.size % dim != Dim3(0, 0, 0):
+                    try:
+                        dim = partition_dims_even(self.size, n)
+                    except ValueError:
+                        dim = RankPartition(self.size, n).dim()
         else:
             try:
                 dim = partition_dims_even(self.size, n)
@@ -323,6 +365,45 @@ class DistributedDomain:
                 dim = RankPartition(self.size, n).dim()
         if self._dcn_requested:
             self.dcn_axis = self._pick_dcn_axis(dim)
+        return dim
+
+    def _plan_dcn_partition(self, n: int) -> Optional[Dim3]:
+        """The hierarchical partition planner: enumerate every exact
+        subdomain-grid factorization of the device count times every
+        slice-admissible DCN axis, price each candidate's per-step
+        exchange with the per-link alpha-beta model (the configured
+        per-axis temporal depths included — deep blocking across the
+        DCN axis divides its launch count), and keep the cheapest.
+        Returns None when no exact candidate admits the slice blocking
+        (``dim[axis] % n_slices == 0``); the chosen axis lands in
+        ``_dcn_axis_planned`` for ``_pick_dcn_axis``."""
+        from .analysis.costmodel import asymmetric_step_seconds
+        elem_sizes = ([self._dtypes[q].itemsize for q in self._names]
+                      or [4])
+        method = pick_method(self.methods).name
+        best = None
+        for dim in exact_partition_candidates(self.size, n):
+            axes = ([self._dcn_axis_req] if self._dcn_axis_req is not None
+                    else range(3))
+            for a in axes:
+                if dim[a] % self.n_slices != 0:
+                    continue
+                local = self.size // dim
+                seconds = asymmetric_step_seconds(
+                    method, (local.z, local.y, local.x), self.radius,
+                    dim, elem_sizes, self.exchange_depths, dcn_axis=a,
+                    wire_format=self.wire_format,
+                    wire_layout=self.wire_layout)
+                # deterministic tie-break: cheapest, then most cube-like
+                # grid, then lowest axis
+                key = (seconds, tuple(sorted(tuple(dim), reverse=True)),
+                       tuple(dim), a)
+                if best is None or key < best[0]:
+                    best = (key, dim, a)
+        if best is None:
+            return None
+        _, dim, axis = best
+        self._dcn_axis_planned = axis
         return dim
 
     def _choose_placement(self, dim: Dim3, groups) -> Placement:
@@ -347,7 +428,10 @@ class DistributedDomain:
                                            groups=groups)
             return Placement(part, order)
         return make_placement(self.strategy, part, self._devices,
-                              self.radius, elem_sizes)
+                              self.radius, elem_sizes,
+                              mode=self.placement_mode,
+                              dcn_axis=self.dcn_axis,
+                              n_slices=self.n_slices)
 
     # ------------------------------------------------------------------
     # realize (reference: src/stencil.cu:241-850)
@@ -396,15 +480,22 @@ class DistributedDomain:
                 f"subdomains, supported only by the PpermuteSlab and "
                 f"PpermutePacked methods")
         # temporal blocking: allocations and the exchange depth come
-        # from the DEEPENED radius (one depth-(s*r) exchange feeds s
-        # steps); s == 1 collapses to the base radius
-        self.alloc_radius = self.radius.deepened(self.exchange_every)
+        # from the DEEPENED radius (one depth-(s_a*r) exchange per axis
+        # feeds s_a steps); s == 1 collapses to the base radius
+        self.alloc_radius = self.radius.deepened(self.exchange_depths)
         if self.exchange_every > 1 and pick_method(self.methods) not in \
                 (Method.PpermuteSlab, Method.PpermutePacked):
             raise NotImplementedError(
                 f"exchange_every > 1 is supported by the PpermuteSlab "
                 f"and PpermutePacked methods, not "
                 f"{pick_method(self.methods)}")
+        d = self.exchange_depths
+        if not d.x == d.y == d.z and wire_layout != "slab":
+            raise NotImplementedError(
+                f"asymmetric temporal depths {tuple(d)} decline "
+                f"wire_layout {self.wire_layout!r}: the irredundant "
+                f"dedup plan assumes one group-wide exchange (see "
+                f"parallel/temporal.py)")
         min_local = [self.local_size[a] - (1 if self.rem[a] else 0)
                      for a in range(3)]
         if any(m < 1 for m in min_local):
@@ -496,6 +587,11 @@ class DistributedDomain:
                 raise ValueError(f"dcn axis {a} has {dim[a]} mesh rows, "
                                  f"not divisible by {ns} slices")
             return a
+        if self._dcn_axis_planned is not None \
+                and (ns <= 1 or dim[self._dcn_axis_planned] % ns == 0):
+            # the hierarchical planner already priced the axis jointly
+            # with the mesh shape
+            return self._dcn_axis_planned
         cands = [a for a in range(3) if ns <= 1 or dim[a] % ns == 0]
         if not cands:
             raise ValueError(f"no mesh axis of {dim} divisible by "
@@ -611,9 +707,17 @@ class DistributedDomain:
     def exchange_bytes_amortized_per_step(self) -> float:
         """Whole-mesh wire bytes per STEP under temporal blocking: the
         deep exchange's bytes spread over the ``exchange_every`` steps
-        it feeds (== ``exchange_bytes_total()`` when s == 1). The
-        runtime face of the amortized model in analysis/costmodel.py."""
-        return self.exchange_bytes_total() / self.exchange_every
+        it feeds (== ``exchange_bytes_total()`` when s == 1). Per-axis
+        depths amortize each axis over ITS OWN refresh period — axis
+        ``a`` re-ships its deep slab every ``s_a`` steps
+        (``parallel.temporal.refresh_axes``). The runtime face of the
+        amortized model in analysis/costmodel.py."""
+        d = self.exchange_depths
+        if d.x == d.y == d.z:
+            return self.exchange_bytes_total() / self.exchange_every
+        counts = mesh_dim(self.mesh)
+        return sum(self._bytes_per_axis[name] * counts.flatten() / d[a]
+                   for a, name in ((0, "x"), (1, "y"), (2, "z")))
 
     def exchange_bytes_dcn(self) -> int:
         """Bytes per exchange crossing the DCN tier, whole mesh: along
@@ -652,6 +756,10 @@ class DistributedDomain:
                 f.write(f"plan config: {self.plan.config.key()}\n")
                 f.write(f"plan measurements: {self.plan.measurements}\n")
             f.write(f"exchange_every: {self.exchange_every}\n")
+            d = self.exchange_depths
+            if not d.x == d.y == d.z:
+                f.write(f"exchange_depths: {d.x}.{d.y}.{d.z}\n")
+            f.write(f"placement mode: {self.placement_mode}\n")
             f.write(f"wire_layout: {self.wire_layout}\n")
             f.write(f"quantities: {self._names}\n")
             for i in range(n):

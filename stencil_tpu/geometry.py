@@ -24,6 +24,9 @@ import operator
 from typing import Dict, Iterator, List, NamedTuple, Tuple, Union
 
 Dim3Like = Union["Dim3", Tuple[int, int, int]]
+# temporal-blocking depth spec: uniform int, per-axis dict
+# ({"z": 4, "y": 1, "x": 1}), or a 3-tuple/Dim3 (see normalize_depths)
+DepthsLike = Union[int, "Dim3", Tuple[int, int, int], Dict[str, int]]
 
 
 def _as_component(name: str, v) -> int:
@@ -334,20 +337,33 @@ class Radius:
         ``parallel.exchange.exchanged_bytes_per_sweep``)."""
         return self.face(axis, -1) + self.face(axis, 1)
 
-    def deepened(self, steps: int) -> "Radius":
+    def deepened(self, steps: DepthsLike) -> "Radius":
         """Halo geometry for ``steps``-step temporal blocking
         (communication avoidance): every per-direction radius scaled by
         ``steps``, so ONE exchange delivers enough halo depth to run
         ``steps`` stencil applications locally — each sub-step consumes
         one base-radius ring. ``steps == 1`` returns an equal copy.
         Asymmetric and edge/corner radii deepen independently, keeping
-        the per-direction contract the exchange plan prices."""
-        steps = _as_component("steps", steps)
-        if steps < 1:
-            raise ValueError(f"temporal depth must be >= 1, got {steps}")
+        the per-direction contract the exchange plan prices.
+
+        ``steps`` may be per-axis (dict / tuple / Dim3, see
+        :func:`normalize_depths`): each FACE deepens by its own axis's
+        depth (the exchange for axis ``a`` ships ``s_a * r`` rows once
+        per ``s_a`` sub-steps), while edge/corner/center directions
+        deepen by the max depth over their involved axes — a
+        conservative allocation bound; the asymmetric temporal engine
+        itself is face-slab only."""
+        steps = normalize_depths(steps)
         out = Radius()
+        if steps.x == steps.y == steps.z:
+            s = steps.x
+            for d in all_directions(include_zero=True):
+                out._m[d] = self._m[d] * s
+            return out
+        s_max = max(steps)
         for d in all_directions(include_zero=True):
-            out._m[d] = self._m[d] * steps
+            involved = [steps[a] for a in range(3) if d[a] != 0]
+            out._m[d] = self._m[d] * (max(involved) if involved else s_max)
         return out
 
     def max_side(self, axis: int, side: int) -> int:
@@ -368,8 +384,47 @@ class Radius:
                 f"{self.z(-1)},{self.z(1)}])")
 
 
-def deepened(radius: Radius, steps: int) -> Radius:
+def deepened(radius: Radius, steps: "DepthsLike") -> Radius:
     """Module-level spelling of :meth:`Radius.deepened` — the deep-halo
     geometry one exchange ships to cover ``steps`` fused stencil steps
     (see ``parallel/temporal.py``)."""
     return radius.deepened(steps)
+
+
+def normalize_depths(steps: "DepthsLike") -> Dim3:
+    """Per-axis temporal-blocking depths as a ``Dim3`` ``(s_x, s_y,
+    s_z)``. Accepts an int (uniform depth, the classic
+    ``exchange_every``), an ``{"x": ..., "y": ..., "z": ...}`` dict
+    (missing axes default to 1 — e.g. ``{"z": 4}`` is deep blocking
+    across z only), or a 3-tuple/Dim3. Each depth must be >= 1 and
+    must divide the max depth: the temporal group runs ``max(steps)``
+    sub-steps and refreshes axis ``a`` every ``s_a`` of them, so a
+    non-divisor would leave a partially-consumed ring at the group
+    boundary (see ``parallel/temporal.py``)."""
+    orig = steps
+    if isinstance(steps, Dim3):
+        pass
+    elif isinstance(steps, dict):
+        unknown = set(steps) - {"x", "y", "z"}
+        if unknown:
+            raise ValueError(f"unknown depth axes {sorted(unknown)} in "
+                             f"{orig!r} (expected 'x'/'y'/'z')")
+        steps = Dim3(_as_component("x", steps.get("x", 1)),
+                     _as_component("y", steps.get("y", 1)),
+                     _as_component("z", steps.get("z", 1)))
+    elif isinstance(steps, (tuple, list)):
+        steps = Dim3.of(tuple(steps))
+    else:
+        s = _as_component("steps", steps)
+        steps = Dim3(s, s, s)
+    if steps.any_lt(1):
+        raise ValueError(f"temporal depth must be >= 1, got {orig}")
+    s_max = max(steps)
+    for a in range(3):
+        if s_max % steps[a] != 0:
+            raise ValueError(
+                f"per-axis temporal depth {'xyz'[a]}={steps[a]} does "
+                f"not divide the max depth {s_max} (in {orig!r}): the "
+                f"deep group runs {s_max} sub-steps and must refresh "
+                f"axis {'xyz'[a]} on a whole number of them")
+    return steps

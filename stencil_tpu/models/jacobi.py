@@ -284,11 +284,19 @@ class Jacobi3D:
         # STENCIL_WRAP_STEPS default); an explicit s pins the depth —
         # deep-carry allocations + one deep exchange per s steps on the
         # XLA path (parallel/temporal.py), the in-kernel step count on
-        # the Pallas wrap/halo paths (s == 1 forces per-step exchange)
-        self._exchange_every = 0 if exchange_every is None \
-            else max(int(exchange_every), 1)
+        # the Pallas wrap/halo paths (s == 1 forces per-step exchange).
+        # Per-axis specs ({"z": 4}, (1, 1, 4)) deepen only the named
+        # axes — the XLA temporal engine only; the Pallas fast paths
+        # decline them loudly below
+        if exchange_every is None:
+            self._exchange_every = 0
+        elif isinstance(exchange_every, int):
+            self._exchange_every = max(int(exchange_every), 1)
+        else:
+            from ..geometry import normalize_depths
+            self._exchange_every = max(normalize_depths(exchange_every))
         if self._exchange_every > 1:
-            self.dd.set_exchange_every(self._exchange_every)
+            self.dd.set_exchange_every(exchange_every)
         if boundary is not None:
             self.dd.set_boundary(boundary)
         if wire_format is not None:
@@ -434,6 +442,21 @@ class Jacobi3D:
         from ..topology import Boundary
         nonper = dd.boundary == Boundary.NONE
         s_every = dd.exchange_every
+        depths = dd.exchange_depths
+        asym = not (depths.x == depths.y == depths.z)
+        if asym and self._overlap:
+            raise NotImplementedError(
+                "asymmetric temporal depths (per-axis exchange_every) "
+                "are not supported with overlap=True — the overlap "
+                "composition assumes one symmetric deep exchange per "
+                "group (parallel/temporal.py declines it too)")
+        if asym and kernel in ("wrap", "halo", "pallas"):
+            raise NotImplementedError(
+                f"asymmetric temporal depths "
+                f"(exchange_every={tuple(depths)}) are not supported "
+                f"with kernel={kernel!r} — the Pallas in-kernel "
+                f"multi-step paths have one step count, not one per "
+                f"axis; use kernel='xla' or 'auto'")
         from ..parallel.exchange import normalize_wire_format
         from ..parallel.packing import normalize_wire_layout
         wire = dd.wire_format
@@ -449,14 +472,15 @@ class Jacobi3D:
         radius_ok = all(radius.face(a, s) == 1
                         for a in range(3) for s in (-1, 1))
         wrap_ok = (counts == Dim3(1, 1, 1) and rem == Dim3(0, 0, 0)
-                   and not self._overlap and radius_ok and not nonper)
+                   and not self._overlap and radius_ok and not nonper
+                   and not asym)
         # the multi-device fast path: interior-resident shards + slab
         # exchange + fused halo kernel (ops/pallas_halo.py); uneven
         # (+-1) z/y shards supported via the kernel's interior-length
         # overlay (x is never sharded here, so rem.x is always 0)
         halo_ok = (counts.x == 1 and not self._overlap and radius_ok
                    and not nonper and not wire_narrows
-                   and not irr_layout)
+                   and not irr_layout and not asym)
         # the overlapped fast path: in-kernel RDMA slab exchange hidden
         # behind the interior compute (ops/pallas_overlap.py) — the
         # reference's interior/exchange/exterior choreography as one
@@ -522,7 +546,9 @@ class Jacobi3D:
                     "a narrowing wire_format is not supported with "
                     "exchange_every > 1 (the temporal deep exchange "
                     "has no wire-narrowing variant yet)")
-            self.kernel_path = (f"xla-temporal[s={s_every}]"
+            tag = (f"s={depths.x}.{depths.y}.{depths.z}" if asym
+                   else f"s={s_every}")
+            self.kernel_path = (f"xla-temporal[{tag}]"
                                 + ("-overlap" if self._overlap else ""))
             self._build_temporal_step()
             from ..utils.logging import LOG_INFO
@@ -581,11 +607,12 @@ class Jacobi3D:
         method = pick_method(dd.methods)
         rem = dd.rem
         s = dd.exchange_every
+        depths = dd.exchange_depths  # per-axis; == (s, s, s) when uniform
         nonper = dd.boundary == Boundary.NONE
         overlap = self._overlap
         layout = getattr(dd, "wire_layout", "slab")
         hot_c, cold_c, sph_r = sphere_geometry(gsize)
-        validate_temporal(radius, local, s, rem)
+        validate_temporal(radius, local, depths, rem)
 
         def make_update(origin):
             ox, oy, oz = origin
@@ -605,10 +632,11 @@ class Jacobi3D:
             def group(q, depth, ovl):
                 return temporal_shard_steps(
                     {"temp": q}, radius, counts, method, upd, depth,
-                    alloc_steps=s, rem=rem, overlap=ovl,
+                    alloc_steps=depths, rem=rem, overlap=ovl,
                     nonperiodic=nonper, wire_layout=layout)["temp"]
 
-            p = lax.fori_loop(0, n // s, lambda _, q: group(q, s, overlap), p)
+            p = lax.fori_loop(0, n // s,
+                              lambda _, q: group(q, depths, overlap), p)
             return lax.fori_loop(0, n % s,
                                  lambda _, q: group(q, 1, False), p)
 
@@ -620,12 +648,14 @@ class Jacobi3D:
             lambda p: sm(p, jnp.asarray(1, jnp.int32)), donate_argnums=0)
 
         def shard_advance(p, c):
-            # one temporal group of c steps (c == s) or a depth-1 tail
-            # step — the same bodies the fused run loop iterates
+            # one temporal group of c steps (c == s, run at the
+            # configured per-axis depths) or a depth-1 tail step — the
+            # same bodies the fused run loop iterates
             upd = make_update(shard_origin(local, rem))
             return temporal_shard_steps(
-                {"temp": p}, radius, counts, method, upd, c,
-                alloc_steps=s, rem=rem,
+                {"temp": p}, radius, counts, method, upd,
+                depths if c == s else c,
+                alloc_steps=depths, rem=rem,
                 overlap=(overlap and c == s),
                 nonperiodic=nonper, wire_layout=layout)["temp"]
 
@@ -946,10 +976,21 @@ class Jacobi3D:
                     "bytes_per_iteration":
                         per_shard * n / cfg["per_iter_div"],
                     "rounds_per_iteration": 1.0 / cfg["per_iter_div"]}
+        d = self.dd.exchange_depths
+        s = self.dd.exchange_every
+        if d.x == d.y == d.z:
+            rounds = 1.0 / s
+        else:
+            # asymmetric group: the deep exchange at sub-step 0 plus a
+            # mid-group refresh at every k where some axis's cadence
+            # divides k (parallel.temporal.refresh_axes)
+            rounds = (1 + sum(1 for k in range(1, s)
+                              if any(k % d[a] == 0
+                                     for a in range(3)))) / s
         return {"path": path,
                 "bytes_per_iteration":
                     float(self.dd.exchange_bytes_amortized_per_step()),
-                "rounds_per_iteration": 1.0 / self.dd.exchange_every}
+                "rounds_per_iteration": rounds}
 
     def measure_exchange_seconds(self, reps: int = 10) -> float:
         """Estimated exchange seconds per ITERATION of the built path,

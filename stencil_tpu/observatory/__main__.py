@@ -82,6 +82,34 @@ def _parse_dim3(text: str, what: str):
     return tuple(toks)
 
 
+def _parse_exchange_every(text: str):
+    """``--exchange-every``: one integer (uniform depth), or
+    ``axis=value`` tokens (``z=4,y=1,x=1``) normalized to a per-axis
+    ``Dim3`` depth vector — the same syntax the bench CLI sweeps."""
+    toks = [t for t in (t.strip() for t in str(text).split(",")) if t]
+    axes = {}
+    for t in toks:
+        if "=" not in t:
+            continue
+        k, v = t.split("=", 1)
+        k = k.strip().lower()
+        if k not in ("x", "y", "z") or int(v) < 1:
+            raise SystemExit(f"--exchange-every axis token wants "
+                             f"x=/y=/z= with depth >= 1, got {t!r}")
+        axes[k] = int(v)
+    if axes:
+        if len(axes) != len(toks):
+            raise SystemExit(f"--exchange-every mixes axis tokens and "
+                             f"bare integers: {text!r}")
+        from ..geometry import normalize_depths
+        return normalize_depths(axes)
+    try:
+        return max(int(toks[0]), 1) if toks else 1
+    except ValueError:
+        raise SystemExit(f"--exchange-every wants an integer or "
+                         f"axis=value tokens, got {text!r}")
+
+
 def _cmd_linkmap(args) -> int:
     """The ``linkmap`` subcommand: modeled traffic matrix + link-class
     summary, and the placement-quality QAP gate (artifact-facing —
@@ -105,20 +133,24 @@ def _cmd_linkmap(args) -> int:
     elem_sizes = (4,) * max(int(args.fields), 1)
     dcn_axis = ({"x": 0, "y": 1, "z": 2}[args.dcn_axis]
                 if args.dcn_axis else None)
-    s = max(int(args.exchange_every), 1)
+    depths = _parse_exchange_every(args.exchange_every)
+    uniform = isinstance(depths, int)
+    s = depths if uniform else max(depths)
+    s_label = (s if uniform
+               else f"{depths.x}.{depths.y}.{depths.z}")
     tm = method_traffic(args.method, (shard[2], shard[1], shard[0]),
-                        radius, counts, elem_sizes, steps=s)
+                        radius, counts, elem_sizes, steps=depths)
     summary = classify(tm, dcn_axis=dcn_axis,
                        n_slices=int(args.n_slices),
                        rounds_per_step=1.0 / s)
-    print(f"linkmap: {args.method}[s={s}] on mesh "
+    print(f"linkmap: {args.method}[s={s_label}] on mesh "
           f"{counts.x}x{counts.y}x{counts.z}, grid {grid}, radius "
           f"{args.radius}, {args.fields} f32 field(s)")
     print(render_heatmap(tm))
     print(render_summary(summary))
 
     payload = {"schema": 1, "kind": "linkmap",
-               "method": args.method, "exchange_every": s,
+               "method": args.method, "exchange_every": s_label,
                "mesh": list(counts), "grid": list(grid),
                "radius": int(args.radius), "fields": int(args.fields),
                "matrix": tm.matrix().tolist(),
@@ -133,18 +165,19 @@ def _cmd_linkmap(args) -> int:
             print(f"  {verdict} placement {row['name']:<10} "
                   f"qap/trivial x{row['qap_over_trivial']:.3f} "
                   f"(trivial {row['trivial_cost']:.3e}, qap "
-                  f"{row['qap_cost']:.3e}"
+                  f"{row['qap_cost']:.3e}, deployed "
+                  f"{row['deployed_cost']:.3e}"
                   + (f", dcn {row['dcn_axis']}x{row['n_slices']}"
                      if row["dcn_axis"] else "") + ")")
         if report["ok"]:
-            print(f"observatory: placement gate OK — QAP placement "
-                  f"cost <= trivial on all {len(report['meshes'])} "
-                  f"registered meshes")
+            print(f"observatory: placement gate OK — QAP and "
+                  f"deployed (auto-mode) placement cost <= trivial on "
+                  f"all {len(report['meshes'])} registered meshes")
         else:
             bad = [r["name"] for r in report["meshes"] if not r["ok"]]
             print(f"observatory: placement gate FAILED on {bad} — "
-                  f"QAP placement would move MORE modeled bytes than "
-                  f"trivial device order")
+                  f"the QAP or deployed placement would move MORE "
+                  f"modeled bytes than trivial device order")
             rc = 1
     if args.json:
         with open(args.json, "w", encoding="utf-8") as fh:
@@ -178,7 +211,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_diff.add_argument("--bench", default=None,
                         help="single-ledger mode: diff this bench's "
                              "newest group instead of the newest "
-                             "record's")
+                             "record's (glob; literal [ ] in bench "
+                             "ids are matched as-is)")
 
     p_gate = sub.add_parser("gate", help="fail on same-fingerprint "
                                          "steps/s regressions")
@@ -187,7 +221,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="max tolerated relative steps/s drop "
                              "(default 0.2)")
     p_gate.add_argument("--bench", default=None,
-                        help="gate only this bench id")
+                        help="gate only matching bench ids (glob; "
+                             "literal [ ] in bench ids are matched "
+                             "as-is)")
     p_gate.add_argument("--include-legacy", action="store_true",
                         help="also gate provenance=legacy records")
     p_gate.add_argument("--json", default=None, metavar="PATH",
@@ -216,8 +252,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_lm.add_argument("--method", default="PpermuteSlab",
                       choices=("PpermuteSlab", "PpermutePacked",
                                "AllGather"))
-    p_lm.add_argument("--exchange-every", type=int, default=1,
-                      metavar="S", help="temporal-blocking depth")
+    p_lm.add_argument("--exchange-every", default="1",
+                      metavar="S|z=4,y=1,x=1",
+                      help="temporal-blocking depth: one integer, or "
+                           "axis=value tokens for per-axis asymmetric "
+                           "depths")
     p_lm.add_argument("--dcn-axis", default=None,
                       choices=("x", "y", "z"),
                       help="slice-blocked axis (classifies its "
@@ -299,8 +338,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 0
         groups = group_records(recs_a)
         if args.bench is not None:
+            from ..utils.naming import glob_match
             groups = {k: g for k, g in groups.items()
-                      if k[1] == args.bench}
+                      if glob_match(str(k[1]), args.bench)}
         pairs = [g for g in groups.values() if len(g) >= 2]
         if not pairs:
             # an empty/group-less ledger is not an error — but it must

@@ -206,11 +206,17 @@ def gate_regressions(records: Sequence[Dict], threshold: float = 0.2,
     Only ``provenances`` records participate (default: ``measured``
     only — backfilled legacy snapshots come from different sessions
     and machines, so they seed the trajectory but do not gate it);
-    ``bench`` restricts the gate to one bench id."""
+    ``bench`` restricts the gate to matching bench ids — a glob with
+    literal-bracket tolerance (``utils.naming.glob_match``), so
+    ``--bench 'bench_exchange*'`` and ids carrying ``[...]`` both
+    work."""
+    from ..utils.naming import glob_match
+
     failures: List[str] = []
     eligible = [r for r in records
                 if r.get("provenance") in tuple(provenances)
-                and (bench is None or r.get("bench") == bench)]
+                and (bench is None
+                     or glob_match(str(r.get("bench")), bench))]
     for (fp, b), group in group_records(eligible).items():
         if len(group) < 2:
             continue
@@ -237,10 +243,14 @@ def gate_groups_checked(records: Sequence[Dict],
     COMPARED (>= 2 eligible records). The gate's coverage figure: a
     healthy gate and a vacuous one both exit 0, but only this number
     tells them apart — the CLI stamps it into the ``--json`` artifact
-    and ``--min-groups`` ratchets it."""
+    and ``--min-groups`` ratchets it. ``bench`` matches like
+    :func:`gate_regressions` — glob with literal-bracket tolerance."""
+    from ..utils.naming import glob_match
+
     eligible = [r for r in records
                 if r.get("provenance") in tuple(provenances)
-                and (bench is None or r.get("bench") == bench)]
+                and (bench is None
+                     or glob_match(str(r.get("bench")), bench))]
     return sum(1 for g in group_records(eligible).values()
                if len(g) >= 2)
 
@@ -286,6 +296,14 @@ def payload_records(payload: Dict, source: str,
                         cfg.get("exchange_rounds_per_step"),
                     "amortized_bytes_per_step_model":
                         cfg.get("amortized_bytes_per_step_model")})
+            # per-axis depth provenance: the (x, y, z) depth vector an
+            # asymmetric leg ran, stamped AFTER the fingerprint is
+            # fixed (the label string in exchange_every already keys
+            # the trajectory; the structured vector is a note that
+            # never forks a group)
+            if cfg.get("depths"):
+                records[-1]["config"].setdefault(
+                    "depths", [int(v) for v in cfg["depths"]])
         fused = payload.get("fused")
         if fused:
             legacy("bench_exchange.megastep",

@@ -342,22 +342,46 @@ def migration_traffic(counts: Dim3, n_fields: int, budget: int,
 def method_traffic(method_name: str,
                    shard_interior_zyx: Sequence[int], radius: Radius,
                    counts: Dim3, elem_sizes: Sequence[int],
-                   steps: int = 1,
+                   steps=1,
                    wire_layout: str = "slab") -> TrafficMatrix:
     """The per-method matrix of one DEEP exchange round — the linkmap
     twin of ``analysis.costmodel.exchange_round_model``, sharing its
     geometry conventions (deepened radius, deep padded
     cross-sections; ``wire_layout`` prices the irredundant packing on
-    the sweep engines, a no-op for the all-gather control)."""
-    deep = radius.deepened(max(int(steps), 1))
+    the sweep engines, a no-op for the all-gather control).
+
+    ``steps`` accepts the per-axis forms of
+    ``geometry.normalize_depths`` (``{"z": 4}``, ``(1, 1, 4)``). For
+    non-uniform depths the matrix covers the whole GROUP of
+    ``max(steps)`` sub-steps: axis ``a`` re-ships its deep slab every
+    ``s_a`` sub-steps (``parallel.temporal.refresh_axes``, with
+    cross-sections spanning the full padded extents both times), so
+    each axis-``a`` edge's bytes scale by ``s / s_a``; amortize with
+    ``rounds_per_step = 1/s`` for per-step bytes."""
+    from ..geometry import normalize_depths
+
+    depths = normalize_depths(steps)
+    s = max(depths)
+    deep = radius.deepened(depths)
     lo, hi = deep.pad_lo(), deep.pad_hi()
     z, y, x = shard_interior_zyx
     padded = (z + lo.z + hi.z, y + lo.y + hi.y, x + lo.x + hi.x)
     if method_name == "AllGather":
-        return allgather_traffic(shard_interior_zyx, deep, counts,
-                                 elem_sizes)
-    return sweep_traffic(padded, deep, counts, elem_sizes,
-                         layout=wire_layout)
+        tm = allgather_traffic(shard_interior_zyx, deep, counts,
+                               elem_sizes)
+    else:
+        tm = sweep_traffic(padded, deep, counts, elem_sizes,
+                           layout=wire_layout)
+    if depths.x == depths.y == depths.z:
+        return tm
+    out = TrafficMatrix(counts)
+    for e in tm.edges:
+        mult = s // depths[AXIS_NAMES.index(e.axis)]
+        out.add(TrafficEdge(e.src, e.dst, e.axis, e.side,
+                            e.nbytes * mult,
+                            {k: v * mult
+                             for k, v in e.class_bytes.items()}))
+    return out
 
 
 def pic_traffic(shard_interior_zyx: Sequence[int], radius: Radius,
@@ -530,9 +554,13 @@ def link_attribution_for(dd) -> Optional[Dict]:
         local = dd.local_size
         elem_sizes = tuple(dd._dtypes[q].itemsize for q in dd._names)
         s = max(int(dd.exchange_every), 1)
+        # per-axis depths: the group matrix (deep + refreshes) over
+        # 1/s rounds-per-step amortizes each axis by its own cadence
+        depths = getattr(dd, "exchange_depths", None)
         tm = method_traffic(pick_method(dd.methods).name,
                             (local.z, local.y, local.x), dd.radius,
-                            counts, elem_sizes, steps=s,
+                            counts, elem_sizes,
+                            steps=depths if depths is not None else s,
                             wire_layout=getattr(dd, "wire_layout",
                                                 "slab"))
         if not tm.edges:
@@ -761,21 +789,42 @@ REGISTERED_MESHES: Tuple[Dict, ...] = (
 )
 
 
+class _ScoreDevice:
+    """Coordless stand-in device for deployed-placement scoring: only
+    ``id`` (the ``_torus_sorted`` fallback key), so ``make_placement``
+    takes the same synthetic-fabric path a virtual mesh does."""
+
+    __slots__ = ("id",)
+
+    def __init__(self, i: int):
+        self.id = int(i)
+
+
 def placement_quality(counts: Dim3, radius: Radius,
                       elem_sizes: Sequence[int],
                       grid: Optional[Dim3] = None,
                       devices: Optional[Sequence] = None,
                       dcn_axis: Optional[int] = None,
                       n_slices: int = 1,
-                      qap_solver: Optional[Callable] = None) -> Dict:
+                      qap_solver: Optional[Callable] = None,
+                      mode: str = "auto") -> Dict:
     """Score subdomain->device placements for one mesh: the seed
     ``placement.comm_bytes_matrix`` (the QAP's ``w``) against the
     fabric distance matrix, comparing trivial (identity) placement
     with the seed ``qap.solve_catch`` hill climb — the reference's
-    NodeAware objective, scored on the TPU lattice."""
+    NodeAware objective, scored on the TPU lattice.
+
+    Also scores the assignment the orchestrator actually DEPLOYS:
+    ``placement.make_placement`` under ``mode`` (default "auto", the
+    deployment default — QAP on non-uniform fabrics, trivial order on
+    uniform ones) runs on stub devices and its assignment is priced
+    under the same objective (``deployed_cost``); the ``ok`` gate
+    requires BOTH the hill-climb score and the deployed assignment to
+    cost no more than trivial."""
     from .. import qap
     from ..partition import RankPartition
-    from ..placement import comm_bytes_matrix
+    from ..placement import (PlacementStrategy, comm_bytes_matrix,
+                             make_placement)
 
     counts = Dim3.of(counts)
     if grid is None:
@@ -789,6 +838,14 @@ def placement_quality(counts: Dim3, radius: Radius,
     solver = qap_solver or qap.solve_catch
     assignment, qap_cost = solver(w, dist)
     qap_cost = qap.cost(w, dist, list(assignment))
+    # the deployed assignment: the real make_placement path on stub
+    # (coordless) devices — exactly what a virtual/fake mesh gets
+    stubs = (list(devices) if devices is not None
+             else [_ScoreDevice(i) for i in range(n)])
+    placed = make_placement(PlacementStrategy.NodeAware, part, stubs,
+                            radius, elem_sizes, mode=mode,
+                            dcn_axis=dcn_axis, n_slices=n_slices)
+    deployed_cost = qap.cost(w, dist, list(placed.assignment))
     return {
         "counts": list(counts),
         "grid": list(grid),
@@ -802,7 +859,11 @@ def placement_quality(counts: Dim3, radius: Radius,
         "qap_over_trivial": (float(qap_cost) / float(trivial)
                              if trivial else 1.0),
         "assignment": [int(a) for a in assignment],
-        "ok": bool(qap_cost <= trivial * (1 + 1e-12)),
+        "placement_mode": str(mode),
+        "deployed_assignment": [int(a) for a in placed.assignment],
+        "deployed_cost": float(deployed_cost),
+        "ok": bool(qap_cost <= trivial * (1 + 1e-12)
+                   and deployed_cost <= trivial * (1 + 1e-12)),
     }
 
 
@@ -810,9 +871,10 @@ def placement_report(meshes: Sequence[Dict] = REGISTERED_MESHES,
                      radius: Optional[Radius] = None,
                      elem_sizes: Sequence[int] = (4,)) -> Dict:
     """The placement-quality report over every registered mesh: the
-    acceptance gate is ``ok`` on every row — modeled QAP-placement
-    cost <= trivial placement, so when the deployment default flips to
-    QAP placement it can only match or beat today's device order."""
+    acceptance gate is ``ok`` on every row — BOTH the modeled
+    QAP-placement cost and the cost of the assignment ``auto`` mode
+    actually deploys must be <= trivial placement, so the default
+    placement can only match or beat today's device order."""
     r = radius if radius is not None else Radius.constant(1)
     rows = []
     for spec in meshes:
@@ -886,12 +948,21 @@ class LinkmapSpec:
     non-negative, uniform per-shard rows (the SPMD capacity contract)
     — and (b) the acceptance identity: the per-shard row sum equals
     the HLO-extracted wire bytes EXACTLY (zero tolerance — a matrix
-    that drops corner traffic under-sums and fails)."""
+    that drops corner traffic under-sums and fails).
+
+    ``placement`` optionally ships the subdomain->device assignment the
+    target deploys: a dict with ``counts`` (mesh shape), ``assignment``
+    (the permutation), optional ``grid``/``radius``/``elem_sizes`` (the
+    QAP's ``w`` inputs) and ``dcn_axis``/``n_slices`` (the fabric).
+    The checker re-prices the claimed assignment under the NodeAware
+    objective and flags any placement shipped as "optimized" that
+    costs MORE than trivial device order."""
 
     fn: Callable
     args: Sequence
     traffic: TrafficMatrix
     count_kinds: Tuple[str, ...] = ("collective_permute", "all_gather")
+    placement: Optional[Dict] = None
 
 
 @dataclasses.dataclass
@@ -948,6 +1019,10 @@ def check_linkmap(target: LinkmapTarget):
         return findings, metrics
     metrics["matrix_bytes_per_shard"] = per_shard
 
+    if spec.placement is not None:
+        findings += _check_placement_payload(target.name,
+                                             spec.placement, metrics)
+
     if not lowering_supported():
         metrics["skipped"] = ("HLO cross-check skipped: StableHLO "
                               "lowering unavailable in this "
@@ -983,3 +1058,50 @@ def check_linkmap(target: LinkmapTarget):
             f"the lowered HLO moves {observed} B/shard "
             f"({missing:+d} B unattributed){hint}"))
     return findings, metrics
+
+
+def _check_placement_payload(name: str, payload: Dict,
+                             metrics: Dict) -> List:
+    """Re-price a target's claimed subdomain->device assignment under
+    the NodeAware QAP objective: a placement shipped as "optimized"
+    must be a permutation and must cost no more than trivial device
+    order on its own fabric — the same gate ``placement_report`` holds
+    every registered mesh to."""
+    from .. import qap
+    from ..analysis.report import Finding
+    from ..geometry import Radius
+    from ..partition import RankPartition
+    from ..placement import comm_bytes_matrix
+
+    findings: List = []
+    counts = Dim3.of(tuple(payload["counts"]))
+    n = counts.flatten()
+    grid = (Dim3.of(tuple(payload["grid"])) if payload.get("grid")
+            else counts * Dim3(8, 8, 8))
+    radius = payload.get("radius") or Radius.constant(1)
+    elem_sizes = tuple(payload.get("elem_sizes", (4,)))
+    part = RankPartition.from_dim(tuple(grid), tuple(counts))
+    w = comm_bytes_matrix(part, radius, elem_sizes)
+    dist = mesh_distance_matrix(counts,
+                                dcn_axis=payload.get("dcn_axis"),
+                                n_slices=int(payload.get("n_slices",
+                                                         1)))
+    asn = [int(a) for a in payload["assignment"]]
+    if sorted(asn) != list(range(n)):
+        findings.append(Finding(
+            "linkmap", name,
+            f"claimed placement {asn} is not a permutation of "
+            f"{n} subdomains"))
+        return findings
+    trivial = qap.cost(w, dist, list(range(n)))
+    claimed = qap.cost(w, dist, asn)
+    metrics["placement_trivial_cost"] = float(trivial)
+    metrics["placement_claimed_cost"] = float(claimed)
+    if claimed > trivial * (1 + 1e-12):
+        findings.append(Finding(
+            "linkmap", name,
+            f"claimed 'optimized' placement costs {claimed:.0f} under "
+            f"the NodeAware objective but trivial device order costs "
+            f"{trivial:.0f} — a placement shipped as tuned must never "
+            f"lose to the identity assignment"))
+    return findings

@@ -39,7 +39,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 from jax import lax
 
-from ..geometry import Dim3, Radius
+from ..geometry import DepthsLike, Dim3, Radius, normalize_depths
 from .exchange import dispatch_exchange
 from .methods import Method
 
@@ -58,40 +58,70 @@ TemporalUpdateFn = Callable[[Dict[str, jnp.ndarray], Dim3,
                             Dict[str, jnp.ndarray]]
 
 
-def validate_temporal(radius: Radius, local: Dim3, steps: int,
+def validate_temporal(radius: Radius, local: Dim3, steps: DepthsLike,
                       rem: Dim3 = ZERO) -> None:
     """Feasibility of ``steps``-deep blocking on ``local``-capacity
     shards: every shard's ACTUAL interior must supply the deep slab the
-    exchange ships from it (``steps * r`` rows per side)."""
-    if steps < 1:
-        raise ValueError(f"exchange_every must be >= 1, got {steps}")
+    exchange ships from it (``s_a * r`` rows per side, per axis)."""
+    depths = normalize_depths(steps)
     for a in range(3):
         min_interior = local[a] - (1 if rem[a] else 0)
-        need = steps * max(radius.face(a, -1), radius.face(a, 1))
+        need = depths[a] * max(radius.face(a, -1), radius.face(a, 1))
         if need and min_interior < need:
             raise ValueError(
-                f"temporal blocking depth {steps} needs interior >= "
+                f"temporal blocking depth {depths[a]} needs interior >= "
                 f"{need} along axis {'xyz'[a]}, but the smallest shard "
                 f"has {min_interior} (grow the grid or lower "
                 f"exchange_every)")
 
 
-def sub_step_windows(radius: Radius, capacity: Dim3, steps: int
+def sub_step_windows(radius: Radius, capacity: Dim3, steps: DepthsLike
                      ) -> List[Tuple[Dim3, Dim3]]:
     """The shrinking-window schedule in shard-interior coords: for each
     sub-step ``k`` the (offset, dims) of the region it computes —
-    offset components are ``-(s-1-k) * r_lo`` (negative = halo ring),
-    dims ``capacity + (s-1-k) * (r_lo + r_hi)``. Sub-step ``s-1`` lands
-    exactly on ``((0,0,0), capacity)``."""
+    offset components are ``-m_a * r_lo``, dims
+    ``capacity + m_a * (r_lo + r_hi)`` with the per-axis extension
+    ``m_a(k) = s_a - 1 - (k mod s_a)`` (negative offsets = halo ring).
+    With uniform depths ``m = s - 1 - k``; sub-step ``max(s) - 1``
+    lands exactly on ``((0,0,0), capacity)``. Per-axis depths saw-tooth:
+    each axis's window re-extends right after its own mid-group
+    exchange refreshes it (see :func:`temporal_shard_steps`)."""
+    depths = normalize_depths(steps)
     out = []
     lo, hi = radius.pad_lo(), radius.pad_hi()
-    for k in range(steps):
-        m = steps - 1 - k
-        off = Dim3(-m * lo.x, -m * lo.y, -m * lo.z)
-        dims = Dim3(capacity.x + m * (lo.x + hi.x),
-                    capacity.y + m * (lo.y + hi.y),
-                    capacity.z + m * (lo.z + hi.z))
+    for k in range(max(depths)):
+        m = Dim3(depths.x - 1 - (k % depths.x),
+                 depths.y - 1 - (k % depths.y),
+                 depths.z - 1 - (k % depths.z))
+        off = Dim3(-m.x * lo.x, -m.y * lo.y, -m.z * lo.z)
+        dims = Dim3(capacity.x + m.x * (lo.x + hi.x),
+                    capacity.y + m.y * (lo.y + hi.y),
+                    capacity.z + m.z * (lo.z + hi.z))
         out.append((off, dims))
+    return out
+
+
+def refresh_axes(depths: DepthsLike, k: int) -> List[int]:
+    """The axes whose halo an asymmetric group exchanges at sub-step
+    ``k``: axis ``a`` is refreshed when ``k % s_a == 0`` (sub-step 0 is
+    the full multi-axis exchange; shallow axes re-exchange mid-group
+    while deep axes coast on their ring). Uniform depths refresh every
+    axis at ``k == 0`` only."""
+    depths = normalize_depths(depths)
+    return [a for a in range(3) if k % depths[a] == 0]
+
+
+def _axes_wire_radius(radius: Radius, depths: Dim3,
+                      axes: Sequence[int]) -> Radius:
+    """Wire radius for a mid-group refresh of ``axes`` only: those
+    axes' faces deepen to ``s_a * r``; every other direction is zero,
+    so the sequential-sweep engine skips the coasting axes entirely."""
+    out = Radius.constant(0)
+    for a in axes:
+        for side in (-1, 1):
+            d = [0, 0, 0]
+            d[a] = side
+            out.set_dir(tuple(d), depths[a] * radius.face(a, side))
     return out
 
 
@@ -124,8 +154,8 @@ def _write_region(fields: Dict[str, jnp.ndarray], p_lo: Dim3, off: Dim3,
 
 def temporal_shard_steps(fields: Dict[str, jnp.ndarray], radius: Radius,
                          mesh_counts: Dim3, method: Method,
-                         update_fn: TemporalUpdateFn, steps: int,
-                         alloc_steps: Optional[int] = None,
+                         update_fn: TemporalUpdateFn, steps: DepthsLike,
+                         alloc_steps: Optional[DepthsLike] = None,
                          rem: Dim3 = ZERO,
                          exchange_keys: Optional[Sequence[str]] = None,
                          overlap: bool = False,
@@ -154,22 +184,55 @@ def temporal_shard_steps(fields: Dict[str, jnp.ndarray], radius: Radius,
     format and message layout (see ``parallel.exchange``) — the
     irredundant layout's win is largest here, where slab
     cross-sections grow with ``steps`` but the wire shell does not.
+
+    Per-axis ``steps`` (e.g. ``{"z": 4, "y": 1, "x": 1}`` — deep
+    blocking across a DCN axis, per-step exchange on ICI): the group
+    runs ``max(steps)`` sub-steps; axis ``a`` is exchanged at depth
+    ``s_a * r`` on every sub-step ``k`` with ``k % s_a == 0``
+    (:func:`refresh_axes` — sub-step 0 is the full multi-axis
+    exchange, mid-group refreshes carry only the shallow axes' faces).
+    Each axis's window component saw-tooths with its own
+    ``m_a(k) = s_a - 1 - (k mod s_a)``; the slab cross-sections span
+    the full padded extents, so a refresh forwards the neighbor's
+    coasting-axis ring rows exactly as deep as the next window reads
+    (the same SPMD-symmetric induction that makes dead-row placement
+    sound). Non-uniform depths decline ``overlap`` and the
+    ``"irredundant"`` wire layout loudly — both assume one group-wide
+    exchange.
     """
-    alloc_steps = steps if alloc_steps is None else alloc_steps
-    if not 1 <= steps <= alloc_steps:
-        raise ValueError(f"steps={steps} outside [1, {alloc_steps}]")
+    depths = normalize_depths(steps)
+    alloc_d = depths if alloc_steps is None else normalize_depths(alloc_steps)
+    if any(not 1 <= depths[a] <= alloc_d[a] for a in range(3)):
+        raise ValueError(f"steps={depths} outside [1, {alloc_d}]")
+    steps = max(depths)
+    uniform = depths.x == depths.y == depths.z
     if overlap and rem != ZERO:
         raise NotImplementedError(
             "overlap composition requires evenly divisible shards")
-    wire = radius.deepened(steps)
-    alloc_r = radius.deepened(alloc_steps)
+    if not uniform:
+        if overlap:
+            raise NotImplementedError(
+                f"asymmetric temporal depths {tuple(depths)} decline "
+                f"the overlap composition: the sub-step-0 shell split "
+                f"assumes one group-wide exchange, not mid-group "
+                f"refreshes")
+        from .packing import normalize_wire_layout
+        if normalize_wire_layout(wire_layout) != "slab":
+            raise NotImplementedError(
+                f"asymmetric temporal depths {tuple(depths)} decline "
+                f"wire_layout {wire_layout!r}: the irredundant "
+                f"dedup plan assumes one group-wide exchange whose "
+                f"slabs carry the halo-of-halo rows mid-group "
+                f"refreshes rely on")
+    wire = radius.deepened(depths)
+    alloc_r = radius.deepened(alloc_d)
     p_lo, p_hi = alloc_r.pad_lo(), alloc_r.pad_hi()
     r_lo, r_hi = radius.pad_lo(), radius.pad_hi()
     any_p = next(iter(fields.values()))
     cap = Dim3(any_p.shape[2] - p_lo.x - p_hi.x,
                any_p.shape[1] - p_lo.y - p_hi.y,
                any_p.shape[0] - p_lo.z - p_hi.z)
-    validate_temporal(radius, cap, steps, rem)
+    validate_temporal(radius, cap, depths, rem)
 
     keys = sorted(fields) if exchange_keys is None else list(exchange_keys)
     pre = dict(fields)
@@ -182,7 +245,7 @@ def temporal_shard_steps(fields: Dict[str, jnp.ndarray], radius: Radius,
     out = dict(fields)
     out.update(exchanged)
 
-    windows = sub_step_windows(radius, cap, steps)
+    windows = sub_step_windows(radius, cap, depths)
     k0 = 0
     inner_dims = cap - r_lo - r_hi
     if overlap and not inner_dims.any_lt(1):
@@ -214,6 +277,19 @@ def temporal_shard_steps(fields: Dict[str, jnp.ndarray], radius: Radius,
         k0 = 1
 
     for k in range(k0, steps):
+        if k > 0 and not uniform:
+            # mid-group refresh: the shallow axes re-exchange at their
+            # own depth while deep axes coast on their remaining ring
+            axes = [a for a in refresh_axes(depths, k)
+                    if radius.wire_rows(a)]
+            if axes:
+                mid = _axes_wire_radius(radius, depths, axes)
+                refreshed = dispatch_exchange(
+                    {q: out[q] for q in keys}, mid, mesh_counts, method,
+                    rem=rem, alloc_radius=alloc_r,
+                    nonperiodic=nonperiodic, wire_format=wire_format,
+                    wire_layout=wire_layout)
+                out.update(refreshed)
         off, dims = windows[k]
         blocks = _region_blocks(out, p_lo, r_lo, r_hi, off, dims)
         outs = update_fn(blocks, dims, (off.x, off.y, off.z), k)

@@ -296,6 +296,28 @@ def partition_dims_even(size: Dim3Like, n: int) -> Dim3:
     return min(best, key=iface)
 
 
+def exact_partition_candidates(size: Dim3Like, n: int) -> List[Dim3]:
+    """All subdomain grids ``dim`` with ``dim.flatten() == n`` that
+    divide ``size`` exactly — the candidate set the hierarchical
+    partition planner prices with the per-link cost model
+    (analysis/costmodel.asymmetric_step_seconds). Empty when no exact
+    factorization exists; the caller falls back to the
+    NodePartition/partition_dims_even ladder."""
+    size = Dim3.of(size)
+    out: List[Dim3] = []
+    for dx in range(1, n + 1):
+        if n % dx or size.x % dx:
+            continue
+        for dy in range(1, n // dx + 1):
+            if (n // dx) % dy or size.y % dy:
+                continue
+            dz = n // dx // dy
+            if size.z % dz:
+                continue
+            out.append(Dim3(dx, dy, dz))
+    return out
+
+
 def partition_dims_even_xfree(size: Dim3Like, n: int,
                               align: int = 1) -> Optional[Dim3]:
     """An exact ``n``-way factorization (1, dy, dz) that leaves the

@@ -153,23 +153,52 @@ class Placement:
 # the placement layer and the mesh provably agree
 from .parallel.mesh import _torus_sorted as _torus_sorted_devices
 
+#: placement-mode escape hatch (DistributedDomain.set_placement /
+#: Jacobi3D(placement=...)): "auto" deploys the QAP assignment whenever
+#: the fabric is non-uniform (measured ICI-hop spread, or a DCN-blocked
+#: axis) and keeps the trivial order on uniform fabrics; "qap"/"trivial"
+#: force one side for experiments and controls.
+PLACEMENT_MODES = ("auto", "qap", "trivial")
+
+
+def normalize_placement_mode(mode: str) -> str:
+    m = "auto" if mode is None else str(mode)
+    if m not in PLACEMENT_MODES:
+        raise ValueError(f"unknown placement mode {mode!r} "
+                         f"(expected one of {PLACEMENT_MODES})")
+    return m
+
 
 def make_placement(strategy: PlacementStrategy, part: RankPartition,
                    devices: Sequence, radius: Radius,
                    elem_sizes: Sequence[int], seed: int = 0,
-                   qap_timeout_s: float = 2.0) -> Placement:
+                   qap_timeout_s: float = 2.0, mode: str = "auto",
+                   dcn_axis: Optional[int] = None,
+                   n_slices: int = 1) -> Placement:
     """Construct a placement (reference: src/stencil.cu:201-239
     do_placement dispatch).
 
     * Trivial: subdomain i -> device i in enumeration order
       (reference: partition.hpp:291-445).
     * NodeAware: torus-sort devices, then QAP-refine the assignment with
-      the halo-bytes x hop-distance objective when the device count is
-      small enough for the hill climb (reference: partition.hpp:525-831).
+      the halo-bytes x hop-distance objective whenever the fabric is
+      non-uniform (reference: partition.hpp:525-831).
     * IntraNodeRandom: seeded shuffle, the experimental control
       (reference: src/placement_intranoderandom.cpp:117-125).
+
+    ``mode`` gates the NodeAware QAP refinement: ``"auto"`` (default)
+    deploys it when the fabric is non-uniform — a measured ICI-hop
+    spread in the device coords, or a DCN-blocked axis
+    (``dcn_axis``/``n_slices``), for which coordless fabrics get the
+    synthetic lattice-torus + DCN-penalty distances of
+    ``observatory.linkmap.mesh_distance_matrix``; ``"trivial"`` keeps
+    the identity assignment; ``"qap"`` always refines. The deployed
+    assignment is clamped to never cost more than identity under the
+    QAP objective, so the ``observatory linkmap --placement-report``
+    gate (QAP cost <= trivial) holds structurally.
     """
     n = part.dim().flatten()
+    mode = normalize_placement_mode(mode)
     if strategy == PlacementStrategy.Trivial:
         return Placement(part, list(devices))
     if strategy == PlacementStrategy.IntraNodeRandom:
@@ -178,9 +207,22 @@ def make_placement(strategy: PlacementStrategy, part: RankPartition,
         return Placement(part, list(devices), [int(a) for a in assignment])
     # NodeAware
     devs = _torus_sorted_devices(devices)
+    if n <= 1 or mode == "trivial":
+        return Placement(part, devs)
     dist = torus_distance_matrix(devs)
     offdiag = dist[~np.eye(n, dtype=bool)]
-    if n <= 1 or np.all(offdiag == offdiag[0]):
+    measured_nonuniform = not np.all(offdiag == offdiag[0])
+    dcn_blocked = dcn_axis is not None and int(n_slices) > 1
+    if not measured_nonuniform and (dcn_blocked or mode == "qap"):
+        # coordless (or coord-uniform) fabric: synthesize per-slot
+        # distances from the deployed shard lattice — wrapped-torus
+        # hops plus the DCN penalty on the blocked axis
+        from .observatory.linkmap import mesh_distance_matrix
+        dist = mesh_distance_matrix(part.dim(), dcn_axis=dcn_axis,
+                                    n_slices=n_slices)
+        offdiag = dist[~np.eye(n, dtype=bool)]
+    nonuniform = not np.all(offdiag == offdiag[0])
+    if mode == "auto" and not nonuniform:
         # uniform fabric: torus sort is already optimal
         return Placement(part, devs)
     w = comm_bytes_matrix(part, radius, elem_sizes)
@@ -188,4 +230,8 @@ def make_placement(strategy: PlacementStrategy, part: RankPartition,
         f, _ = qap.solve(w, dist, timeout_s=qap_timeout_s)
     else:
         f, _ = qap.solve_catch(w, dist)
-    return Placement(part, devs, [int(i) for i in f])
+    f = [int(i) for i in f]
+    identity = list(range(n))
+    if qap.cost(w, dist, f) > qap.cost(w, dist, identity):
+        f = identity  # never ship a costlier-than-trivial order
+    return Placement(part, devs, f)

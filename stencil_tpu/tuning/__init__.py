@@ -75,7 +75,9 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
                  max_measurements: int = 4,
                  runnable=None, topology: "Dict | None" = None,
                  wire_formats: Sequence[str] = ("f32",),
-                 wire_layouts: Sequence[str] = ("slab",)) -> Plan:
+                 wire_layouts: Sequence[str] = ("slab",),
+                 dcn_axis: "int | None" = None,
+                 placement: str = "auto") -> Plan:
     """The core search (timer injected — deterministic under
     :class:`FakeTimer`): cache lookup, alpha-beta calibration,
     model-ranked pruning, measurement of the survivors, plan store.
@@ -102,6 +104,17 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
     slab-only; add ``"irredundant"`` to rank the each-cell-once
     layout — the calibrated model prices its slimmer per-direction
     boxes, ``parallel.packing``).
+
+    ``dcn_axis``: the slice-blocked mesh axis, when the domain has
+    one. Arms two things: asymmetric-depth candidates that deepen
+    ONLY the DCN axis (``{dcn: s}`` for every uniform depth in
+    ``depths``) join the sweep automatically, and per-axis candidates
+    are priced per LINK — the topology fingerprint's (or default) DCN
+    coefficients on the blocked axis, ICI elsewhere — instead of the
+    single bottleneck-link price.
+
+    ``placement``: the domain's placement mode, recorded on the plan
+    (``Plan.placement``) so a cached plan replays the same fabric.
     """
     fp = fingerprint(inputs)
     if read_cache:
@@ -137,7 +150,15 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
                              for c in links.values()))
 
     # --- plan: rank every feasible candidate with the CALIBRATED model
-    cands = candidate_space(geom, depths=depths,
+    sweep = list(depths)
+    if dcn_axis is not None:
+        # a slice-blocked axis makes asymmetric blocking the
+        # interesting move: deepen ONLY the DCN axis at every uniform
+        # depth the caller swept (the ICI axes keep per-step exchange)
+        name = "xyz"[dcn_axis]
+        sweep += [{name: int(s)} for s in depths
+                  if isinstance(s, int) and s > 1]
+    cands = candidate_space(geom, depths=sweep,
                             overlap_options=overlap_options,
                             runnable=runnable,
                             wire_formats=wire_formats,
@@ -145,14 +166,29 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
     if not cands:
         raise ValueError("no feasible exchange configuration for this "
                          "geometry (shards smaller than the radius?)")
-    predicted = {
-        c: configured_step_seconds(c.method, geom.shard_interior_zyx,
-                                   geom.radius, geom.counts,
-                                   geom.elem_sizes, c.exchange_every,
-                                   coeffs, geom.dtype_groups,
-                                   wire_format=c.wire_format,
-                                   wire_layout=c.wire_layout)
-        for c in cands}
+    # uniform candidates keep the classic single bottleneck-link price;
+    # asymmetric ones are priced per link (DCN coefficients on the
+    # blocked axis, per-axis/ICI elsewhere) — the whole point of
+    # deepening one axis is that its link is NOT the others'
+    per_link = dict(links)
+    if dcn_axis is not None and "dcn" not in per_link:
+        from ..analysis.costmodel import DEFAULT_DCN_COEFFS
+        per_link["dcn"] = DEFAULT_DCN_COEFFS
+
+    def _predict(c: Candidate) -> float:
+        if c.depths is not None and len(set(c.depths)) > 1:
+            return configured_step_seconds(
+                c.method, geom.shard_interior_zyx, geom.radius,
+                geom.counts, geom.elem_sizes, c.depths, per_link,
+                geom.dtype_groups, wire_format=c.wire_format,
+                wire_layout=c.wire_layout, dcn_axis=dcn_axis)
+        return configured_step_seconds(
+            c.method, geom.shard_interior_zyx, geom.radius,
+            geom.counts, geom.elem_sizes, c.exchange_every, coeffs,
+            geom.dtype_groups, wire_format=c.wire_format,
+            wire_layout=c.wire_layout)
+
+    predicted = {c: _predict(c) for c in cands}
     ranked = sorted(cands, key=lambda c: predicted[c])
 
     # the temporal-depth crossover predictor, on the calibrated
@@ -196,7 +232,8 @@ def run_autotune(geom: TuneGeometry, inputs: Dict, timer,
                 # the VMEM planner's prescribed Pallas block shape for
                 # this geometry rides the plan record: Method.Auto
                 # ships tile shapes the way it ships exchange methods
-                tiling=tiling_record(geom))
+                tiling=tiling_record(geom),
+                placement=str(placement))
     LOG_INFO(f"autotune: measured {len(survivors)}/{len(cands)} "
              f"candidates (pruned {pruned} by the calibrated model; "
              f"depth crossover predicts s={best_depth}) -> "
@@ -236,13 +273,16 @@ def geometry_from_domain(dd, dim) -> TuneGeometry:
 def inputs_from_domain(dd, dim) -> Dict:
     """Fingerprint inputs from a configured ``DistributedDomain``."""
     platform = (dd._devices[0].platform if dd._devices else "cpu")
+    depths = getattr(dd, "exchange_depths", None)
     return fingerprint_inputs(
         platform=platform, device_count=len(dd._devices),
         mesh_shape=list(dim), grid=list(dd.size), radius=dd.radius,
         quantities={q: str(dd._dtypes[q]) for q in dd._names},
         boundary=dd.boundary.name, n_slices=dd.n_slices,
         wire_format=getattr(dd, "wire_format", "f32"),
-        wire_layout=getattr(dd, "wire_layout", "slab"))
+        wire_layout=getattr(dd, "wire_layout", "slab"),
+        exchange_depths=tuple(depths) if depths is not None else None,
+        placement=getattr(dd, "placement_mode", "auto"))
 
 
 def autotune_domain(dd, timer=None, use_cache: bool = True,
@@ -325,10 +365,19 @@ def autotune_domain(dd, timer=None, use_cache: bool = True,
             LOG_INFO(f"autotune: topology fingerprint hit "
                      f"{topology['fingerprint'][:12]}... (per-axis "
                      f"links replace the pingpong calibration)")
+    sweep = list(depths)
+    dd_depths = getattr(dd, "exchange_depths", None)
+    if dd_depths is not None and len(set(tuple(dd_depths))) > 1:
+        # a configured per-axis depth is a candidate the user already
+        # believes in — always rank it
+        sweep.append(tuple(dd_depths))
     return run_autotune(geom, inputs, timer,
                         read_cache=use_cache and not force,
                         write_cache=use_cache, cache_path=cache_path,
-                        depths=depths, overlap_options=overlap_options,
+                        depths=sweep, overlap_options=overlap_options,
                         max_measurements=max_measurements,
                         topology=topology, wire_formats=wire_formats,
-                        wire_layouts=wire_layouts)
+                        wire_layouts=wire_layouts,
+                        dcn_axis=(dd.dcn_axis if dd.n_slices > 1
+                                  else None),
+                        placement=getattr(dd, "placement_mode", "auto"))

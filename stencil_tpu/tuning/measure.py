@@ -74,11 +74,27 @@ class FakeTimer:
 
     def exchange_round(self, cand: Candidate, geom: TuneGeometry
                        ) -> float:
-        messages, nbytes = exchange_round_model(
-            cand.method, geom.shard_interior_zyx, geom.radius,
-            geom.counts, geom.elem_sizes, cand.exchange_every,
-            geom.dtype_groups, wire_format=cand.wire_format,
-            wire_layout=cand.wire_layout)
+        depths = cand.depths_xyz()
+        if len(set(depths)) > 1:
+            # asymmetric group: axis a re-ships its deep slab
+            # s / s_a times per group (parallel.temporal.refresh_axes)
+            from ..analysis.costmodel import per_axis_round_model
+            per_axis = per_axis_round_model(
+                cand.method, geom.shard_interior_zyx, geom.radius,
+                geom.counts, geom.elem_sizes, depths,
+                geom.dtype_groups, wire_format=cand.wire_format,
+                wire_layout=cand.wire_layout)
+            s = max(depths)
+            messages = sum(per_axis[n][0] * (s // depths[a])
+                           for a, n in enumerate("xyz"))
+            nbytes = sum(per_axis[n][1] * (s // depths[a])
+                         for a, n in enumerate("xyz"))
+        else:
+            messages, nbytes = exchange_round_model(
+                cand.method, geom.shard_interior_zyx, geom.radius,
+                geom.counts, geom.elem_sizes, cand.exchange_every,
+                geom.dtype_groups, wire_format=cand.wire_format,
+                wire_layout=cand.wire_layout)
         t = self.coeffs.seconds(messages, nbytes)
         t *= self.scale.get(cand.method, 1.0)
         if cand.overlap:
@@ -161,7 +177,12 @@ class MeshTimer:
                        ) -> float:
         """Seconds per deep exchange round of ``cand``'s configuration,
         timed on a throwaway jitted program over zero fields — built by
-        the same ``make_exchange`` the orchestrator deploys."""
+        the same ``make_exchange`` the orchestrator deploys. An
+        asymmetric-depth candidate times one whole GROUP (the per-axis
+        deep exchange plus every mid-group refresh) through
+        ``temporal_shard_steps`` with an identity update — again the
+        deployed code path — so the caller's ``/ exchange_every``
+        amortization yields per-step seconds either way."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -171,6 +192,9 @@ class MeshTimer:
         from ..parallel.mesh import mesh_dim
         from ..parallel.methods import Method
 
+        depths = cand.depths_xyz()
+        if len(set(depths)) > 1:
+            return self._asym_group_seconds(cand, geom)
         deep = geom.radius.deepened(cand.exchange_every)
         dim = mesh_dim(self.mesh)
         padded = raw_size(self.local, deep)
@@ -192,6 +216,53 @@ class MeshTimer:
                 for i, dt in enumerate(self.dtypes)}
         fields = {f"q{i}": mk() for i, mk in make.items()}
         # make_exchange DONATES its input dict: rebind every call
+        fields = dict(ex(fields))
+        self._sync(fields)
+        t0 = time.perf_counter()
+        for _ in range(self.reps):
+            fields = dict(ex(fields))
+        self._sync(fields)
+        return (time.perf_counter() - t0) / self.reps
+
+    def _asym_group_seconds(self, cand: Candidate, geom: TuneGeometry
+                            ) -> float:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..local_domain import raw_size, zyx_shape
+        from ..parallel.mesh import mesh_dim
+        from ..parallel.methods import Method
+        from ..parallel.temporal import temporal_shard_steps
+
+        depths = Dim3(*cand.depths_xyz())
+        dim = mesh_dim(self.mesh)
+        deep = geom.radius.deepened(depths)
+        padded = raw_size(self.local, deep)
+        gshape = zyx_shape(padded * dim)
+
+        def upd(blocks, dims, off, k):
+            return dict(blocks)
+
+        def shard(fields):
+            return temporal_shard_steps(
+                fields, geom.radius, dim, Method[cand.method], upd,
+                depths, rem=self.rem, nonperiodic=self.nonperiodic,
+                wire_format=(cand.wire_format
+                             if cand.wire_format != "f32" else None),
+                wire_layout=cand.wire_layout)
+
+        spec = P("z", "y", "x")
+        sharding = NamedSharding(self.mesh, spec)
+        names = [f"q{i}" for i in range(len(self.dtypes))]
+        specs = {q: spec for q in names}
+        ex = jax.jit(jax.shard_map(shard, mesh=self.mesh,
+                                   in_specs=(specs,), out_specs=specs,
+                                   check_vma=False))
+        make = {q: jax.jit(lambda dt=dt: jnp.zeros(gshape, dt),
+                           out_shardings=sharding)
+                for q, dt in zip(names, self.dtypes)}
+        fields = {q: mk() for q, mk in make.items()}
         fields = dict(ex(fields))
         self._sync(fields)
         t0 = time.perf_counter()

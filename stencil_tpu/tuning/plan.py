@@ -62,9 +62,25 @@ class Candidate:
     overlap: bool = False
     wire_format: str = "f32"
     wire_layout: str = "slab"
+    #: per-axis temporal depths (x, y, z) — None means the symmetric
+    #: ``exchange_every`` on every axis. A non-uniform tuple (e.g.
+    #: ``(1, 1, 4)``) deepens only the named axes (DCN-crossing faces
+    #: amortize while ICI faces exchange every step); serialized in the
+    #: key as a dot-separated depth ``s=1.1.4``
+    depths: Optional[Tuple[int, int, int]] = None
+
+    def depths_xyz(self) -> Tuple[int, int, int]:
+        """The effective (x, y, z) depths — ``depths`` or the symmetric
+        fill of ``exchange_every``."""
+        return (self.depths if self.depths is not None
+                else (self.exchange_every,) * 3)
 
     def key(self) -> str:
-        tag = f"{self.method}[s={self.exchange_every}"
+        d = self.depths
+        if d is not None and len(set(d)) > 1:
+            tag = f"{self.method}[s={d[0]}.{d[1]}.{d[2]}"
+        else:
+            tag = f"{self.method}[s={self.exchange_every}"
         if self.overlap:
             tag += ",overlap"
         if self.wire_format != "f32":
@@ -78,7 +94,14 @@ class Candidate:
         method, _, rest = key.partition("[")
         rest = rest.rstrip("]")
         parts = rest.split(",")
-        s = int(parts[0].split("=")[1])
+        sval = parts[0].split("=")[1]
+        depths: Optional[Tuple[int, int, int]] = None
+        if "." in sval:
+            dx, dy, dz = (int(v) for v in sval.split("."))
+            depths = (dx, dy, dz)
+            s = max(depths)
+        else:
+            s = int(sval)
         wire = "f32"
         layout = "slab"
         for p in parts[1:]:
@@ -86,7 +109,8 @@ class Candidate:
                 wire = p.split("=", 1)[1]
             elif p.startswith("layout="):
                 layout = p.split("=", 1)[1]
-        return Candidate(method, s, "overlap" in parts[1:], wire, layout)
+        return Candidate(method, s, "overlap" in parts[1:], wire, layout,
+                         depths)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -135,12 +159,26 @@ def candidate_feasible(cand: Candidate, geom: TuneGeometry) -> bool:
             return False
     if cand.exchange_every < 1:
         return False
-    # the deepened radius must fit the SMALLEST shard on every face
+    depths = cand.depths_xyz()
+    if any(d < 1 for d in depths) or max(depths) != cand.exchange_every:
+        return False
+    if len(set(depths)) > 1:
+        # asymmetric depths ride the ppermute engines with the slab
+        # layout and no overlap (temporal_shard_steps' declines)
+        if cand.method not in _PPERMUTE or cand.overlap:
+            return False
+        if cand.wire_layout != "slab":
+            return False
+        # each axis depth must divide the group length (refresh cadence)
+        if any(max(depths) % d for d in depths):
+            return False
+    # the (per-axis) deepened radius must fit the SMALLEST shard on
+    # every face
     mz, my, mx = geom.min_interior_zyx
     min_xyz = (mx, my, mz)
     for a in range(3):
-        need = cand.exchange_every * max(geom.radius.face(a, -1),
-                                         geom.radius.face(a, 1))
+        need = depths[a] * max(geom.radius.face(a, -1),
+                               geom.radius.face(a, 1))
         if need > min_xyz[a]:
             return False
     return True
@@ -163,21 +201,40 @@ def candidate_space(geom: TuneGeometry,
     ``("f32", "bf16")`` to also rank the certified half-width wire on
     the ppermute engines. ``wire_layouts`` is likewise opt-in: pass
     ``("slab", "irredundant")`` to also rank the each-cell-once
-    message layout (``parallel.packing``)."""
+    message layout (``parallel.packing``).
+
+    ``depths`` entries may be plain ints (symmetric blocking) or
+    per-axis specs — a ``{"z": 4}``-style dict or an (x, y, z)
+    tuple — which become asymmetric candidates (``Candidate.depths``,
+    keys like ``PpermuteSlab[s=1.1.4]``)."""
+    from ..geometry import normalize_depths
     from ..parallel.methods import Method, method_runnable
 
     if runnable is None:
         runnable = method_runnable
+    uniform = set()
+    asym = set()
+    for d in depths:
+        if isinstance(d, int):
+            uniform.add(int(d))
+        else:
+            nd = normalize_depths(d)
+            if nd.x == nd.y == nd.z:
+                uniform.add(nd.x)
+            else:
+                asym.add((nd.x, nd.y, nd.z))
     out: List[Candidate] = []
     for name in PLAN_METHODS:
         if not runnable(Method[name]):
             continue
-        for s in sorted(set(int(d) for d in depths)):
+        specs = ([(s, None) for s in sorted(uniform)]
+                 + [(max(d), d) for d in sorted(asym)])
+        for s, dxyz in specs:
             for ovl in overlap_options:
                 for wf in wire_formats:
                     for wl in wire_layouts:
                         cand = Candidate(name, s, bool(ovl), str(wf),
-                                         str(wl))
+                                         str(wl), dxyz)
                         if candidate_feasible(cand, geom):
                             out.append(cand)
     return out
@@ -400,16 +457,21 @@ def fingerprint_inputs(platform: str, device_count: int,
                        boundary: str, n_slices: int = 1,
                        library_version: Optional[str] = None,
                        wire_format: str = "f32",
-                       wire_layout: str = "slab") -> Dict:
+                       wire_layout: str = "slab",
+                       exchange_depths: Optional[Sequence[int]] = None,
+                       placement: str = "auto") -> Dict:
     """The identity a plan is valid for (see module docstring).
     ``quantities`` maps name -> numpy dtype string. ``wire_format``
     and ``wire_layout`` are part of the identity: a plan tuned for
     the f32 slab wire must never replay onto a bf16 or irredundant
     wire domain (the measured coefficients price a different byte
-    bill)."""
+    bill). ``exchange_depths`` (x, y, z) and ``placement`` join the
+    identity only when NON-default (non-uniform depths / mode other
+    than "auto") so fingerprints of symmetric auto-placed domains —
+    and every plan cached before these axes existed — are unchanged."""
     if library_version is None:
         from .. import __version__ as library_version
-    return {
+    out = {
         "platform": str(platform),
         "device_count": int(device_count),
         "mesh_shape": [int(v) for v in mesh_shape],
@@ -422,6 +484,11 @@ def fingerprint_inputs(platform: str, device_count: int,
         "wire_format": str(wire_format),
         "wire_layout": str(wire_layout),
     }
+    if exchange_depths is not None and len(set(exchange_depths)) > 1:
+        out["exchange_depths"] = [int(v) for v in exchange_depths]
+    if str(placement) != "auto":
+        out["placement"] = str(placement)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -451,6 +518,10 @@ class Plan:
     #: (:func:`tiling_record`) — plan-cache records carry the chosen
     #: tile shape the same way they carry the chosen exchange method
     tiling: Dict[str, Dict] = dataclasses.field(default_factory=dict)
+    #: the placement mode the plan was tuned under ("auto" | "qap" |
+    #: "trivial") — records from before the placement axis existed
+    #: load as "auto" (the then-only behavior)
+    placement: str = "auto"
 
     def to_record(self) -> Dict:
         rec = dataclasses.asdict(self)  # recurses into Candidate
@@ -460,12 +531,15 @@ class Plan:
     @staticmethod
     def from_record(rec: Dict) -> "Plan":
         cfg = rec["config"]
+        depths = cfg.get("depths")  # pre-per-axis records lack the key
         return Plan(
             config=Candidate(str(cfg["method"]),
                              int(cfg["exchange_every"]),
                              bool(cfg.get("overlap", False)),
                              str(cfg.get("wire_format", "f32")),
-                             str(cfg.get("wire_layout", "slab"))),
+                             str(cfg.get("wire_layout", "slab")),
+                             tuple(int(v) for v in depths)
+                             if depths is not None else None),
             fingerprint=str(rec["fingerprint"]),
             coefficients=dict(rec.get("coefficients", {})),
             costs=dict(rec.get("costs", {})),
@@ -476,4 +550,5 @@ class Plan:
             fingerprint_inputs=rec.get("fingerprint_inputs"),
             predicted_best_depth=rec.get("predicted_best_depth"),
             tiling=dict(rec.get("tiling", {})),
+            placement=str(rec.get("placement", "auto")),
         )

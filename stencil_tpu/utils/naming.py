@@ -1,0 +1,31 @@
+"""Name matching for the CLIs: fnmatch with literal-bracket tolerance.
+
+Registry targets, tuner plan keys, and bench ids embed literal
+brackets (``analysis.tiling.jacobi_halo[512]``,
+``models.jacobi.step_n[xla-temporal[s=1.1.4]]``), which collide with
+fnmatch's character-class syntax — ``*[s=2]`` parses ``[s=2]`` as a
+character class and never matches the literal name. ``glob_match``
+tries the raw pattern first (so old ``?512?`` spellings keep working)
+and then a variant with every ``[`` escaped to the ``[[]`` character
+class, so ``--only 'analysis.schedule.*[k=4]'`` and
+``gate --bench 'bench_exchange*'`` just work. The one matcher is
+shared by the analysis and observatory CLIs so bracket handling can
+never drift between them.
+"""
+
+import fnmatch
+
+__all__ = ["glob_match"]
+
+
+def glob_match(name: str, pattern: str) -> bool:
+    """True when ``name`` matches ``pattern`` as a glob, treating
+    ``[`` in the pattern as a literal bracket when the raw fnmatch
+    reading fails. An exact string match always passes."""
+    if name == pattern:
+        return True
+    if fnmatch.fnmatchcase(name, pattern):
+        return True
+    if "[" in pattern:
+        return fnmatch.fnmatchcase(name, pattern.replace("[", "[[]"))
+    return False

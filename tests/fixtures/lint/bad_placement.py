@@ -1,0 +1,66 @@
+"""Negative control for the placement gate: a QAP-costlier-than-
+trivial assignment shipped as "optimized".
+
+The two-tier fabric blocks z across 2 slices, and the 16x16x32 grid
+gives z the SMALLEST halo cross-sections — so trivial device order
+(z-neighbors across the DCN) is already the cheap side. The claimed
+"tuned" assignment transposes the x and z mesh indices, which marches
+the fat x faces over the slow DCN links instead. The linkmap checker
+re-prices the claimed permutation under the NodeAware objective and
+must flag it with a nonzero CLI exit: a placement shipped as
+optimized must never lose to the identity assignment.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from stencil_tpu.geometry import Dim3, Radius
+from stencil_tpu.observatory.linkmap import (LinkmapSpec, LinkmapTarget,
+                                             sweep_traffic)
+
+_MESH = (2, 2, 2)
+_GRID = (16, 16, 32)  # (x, y, z): z has the smallest cross-sections
+
+
+def _overpriced_placement_spec() -> LinkmapSpec:
+    from stencil_tpu.parallel.exchange import exchange_shard
+    from stencil_tpu.parallel.mesh import make_mesh, mesh_dim
+
+    n = _MESH[0] * _MESH[1] * _MESH[2]
+    mesh = make_mesh(_MESH, jax.devices()[:n])
+    counts = mesh_dim(mesh)
+    radius = Radius.constant(1)
+
+    def shard(p):
+        return exchange_shard(p, radius, counts)
+
+    sm = jax.shard_map(shard, mesh=mesh, in_specs=P("z", "y", "x"),
+                       out_specs=P("z", "y", "x"), check_vma=False)
+    # padded shard (z,y,x) = (18, 10, 10); the traffic matrix itself is
+    # exact — only the shipped placement is wrong
+    global_zyx = tuple((_GRID[2 - d] // _MESH[2 - d] + 2)
+                       * _MESH[2 - d] for d in range(3))
+    arg = jax.ShapeDtypeStruct(global_zyx, jax.numpy.float32)
+    traffic = sweep_traffic((18, 10, 10), radius, Dim3(*_MESH), (4,))
+    # the bug: an "optimized" assignment that transposes the x and z
+    # mesh indices, shipping the LARGE x faces across the DCN tier
+    perm = [0] * n
+    for z in range(2):
+        for y in range(2):
+            for x in range(2):
+                perm[x + 2 * y + 4 * z] = z + 2 * y + 4 * x
+    placement = {
+        "counts": _MESH,
+        "grid": _GRID,
+        "assignment": perm,
+        "dcn_axis": 2,
+        "n_slices": 2,
+    }
+    return LinkmapSpec(fn=sm, args=(arg,), traffic=traffic,
+                       placement=placement)
+
+
+TARGETS = [
+    LinkmapTarget("fixture.placement_ships_qap_loser",
+                  _overpriced_placement_spec),
+]

@@ -514,6 +514,58 @@ class TestPlacementQuality:
         assert tiered[1, 2] > flat[1, 2]
         assert tiered[0, 1] == flat[0, 1]
 
+    def test_deployed_placement_scored_and_gated(self):
+        """Every row scores the assignment make_placement(mode="auto")
+        actually DEPLOYS, next to the hill-climb upper bound; the gate
+        holds BOTH to the trivial cost — a deployment regression (the
+        orchestrator shipping a worse order than it scored) fails the
+        report, not just the solver."""
+        for row in placement_report()["meshes"]:
+            assert row["placement_mode"] == "auto"
+            assert sorted(row["deployed_assignment"]) \
+                == list(range(row["subdomains"]))
+            assert row["deployed_cost"] <= \
+                row["trivial_cost"] * (1 + 1e-12)
+        # a forced-trivial scoring run reports identity deployment
+        row = placement_quality(Dim3(2, 2, 2), Radius.constant(1),
+                                (4,), dcn_axis=2, n_slices=2,
+                                mode="trivial")
+        assert row["placement_mode"] == "trivial"
+        assert row["deployed_assignment"] == list(range(8))
+
+    def test_placement_payload_repricing(self):
+        """LinkmapSpec.placement: a target's claimed assignment is
+        re-priced under the QAP objective on its own declared fabric —
+        identity passes, a seam-crossing transpose is flagged (the
+        bad_placement fixture's failure mode, unit-level)."""
+        from stencil_tpu.observatory.linkmap import (
+            _check_placement_payload)
+
+        payload = {"counts": (2, 2, 2), "grid": (16, 16, 32),
+                   "assignment": list(range(8)),
+                   "radius": Radius.constant(1), "elem_sizes": (4,),
+                   "dcn_axis": 2, "n_slices": 2}
+        metrics = {}
+        assert _check_placement_payload("t", payload, metrics) == []
+        assert metrics["placement_claimed_cost"] == \
+            metrics["placement_trivial_cost"]
+        perm = [0] * 8
+        for z in range(2):
+            for y in range(2):
+                for x in range(2):
+                    # transpose x/z: the fat x faces cross the DCN seam
+                    perm[x + 2 * y + 4 * z] = z + 2 * y + 4 * x
+        bad, m2 = dict(payload, assignment=perm), {}
+        findings = _check_placement_payload("t", bad, m2)
+        assert len(findings) == 1
+        assert "never lose to the identity assignment" \
+            in findings[0].message
+        assert m2["placement_claimed_cost"] > \
+            m2["placement_trivial_cost"]
+        # a non-permutation "assignment" is flagged outright
+        junk, m3 = dict(payload, assignment=[0] * 8), {}
+        assert _check_placement_payload("t", junk, m3)
+
 
 # ----------------------------------------------------------------------
 # CLI

@@ -479,6 +479,26 @@ def test_linkmap_fixture_flagged():
     assert "6-neighbor-only" in f.message
 
 
+def test_placement_fixture_flagged():
+    """A linkmap target that SHIPS a QAP-refined placement costing
+    more than the identity order on its own declared fabric
+    (tests/fixtures/lint/bad_placement.py: an x/z transpose that drags
+    the fat x faces across the DCN seam) must be flagged by the
+    placement-payload re-pricing inside the linkmap checker."""
+    from stencil_tpu.analysis.hlo import lowering_supported
+
+    if not lowering_supported():
+        pytest.skip("no StableHLO lowering in this JAX/backend")
+    report = run_targets(load_targets(FIXTURES / "bad_placement.py"))
+    assert not report.ok
+    errs = [f for f in report.errors if "placement" in f.message]
+    assert errs, [str(f) for f in report.errors]
+    (f,) = errs
+    assert f.checker == "linkmap"
+    assert f.target.startswith("fixture.placement_ships_qap_loser")
+    assert "never lose to the identity assignment" in f.message
+
+
 def test_segment_carry_fixture_flagged():
     """A PIC fused segment whose carry contract DROPS the overflow
     probe column (tests/fixtures/lint/bad_segment_carry.py): every
@@ -680,6 +700,7 @@ def test_cli_only_accepts_target_globs(tmp_path):
                                      "bad_attribution.py",
                                      "bad_tiling.py",
                                      "bad_linkmap.py",
+                                     "bad_placement.py",
                                      "bad_segment_carry.py",
                                      "bad_schedule.py",
                                      "bad_precision.py",
@@ -692,8 +713,8 @@ def test_cli_nonzero_on_every_fixture(fixture):
     if fixture in ("bad_hlo.py", "bad_plan.py", "bad_probe.py",
                    "bad_probe_metrics.py", "bad_megastep.py",
                    "bad_donation.py", "bad_migration.py",
-                   "bad_linkmap.py", "bad_segment_carry.py",
-                   "bad_packing.py"):
+                   "bad_linkmap.py", "bad_placement.py",
+                   "bad_segment_carry.py", "bad_packing.py"):
         from stencil_tpu.analysis.hlo import lowering_supported
 
         if not lowering_supported():

@@ -1,0 +1,51 @@
+"""utils.naming.glob_match: glob matching with literal-bracket
+tolerance.
+
+Candidate keys and bench ids carry ``[...]`` (``PpermuteSlab[s=1.1.4]``,
+``observatory.linkmap.hierarchical[dcn]``), which raw fnmatch reads as
+a character class — so ``bench_exchange --targets`` and the ledger
+``--bench`` filter route through glob_match, which retries with the
+bracket escaped. These tests pin both readings."""
+
+import pytest
+
+from stencil_tpu.utils.naming import glob_match
+
+
+def test_exact_match_always_passes():
+    assert glob_match("PpermuteSlab[s=1.1.4]", "PpermuteSlab[s=1.1.4]")
+    assert glob_match("plain", "plain")
+    assert not glob_match("plain", "other")
+
+
+def test_raw_fnmatch_still_works():
+    # patterns without brackets behave exactly like fnmatch
+    assert glob_match("bench_exchange.megastep", "bench_exchange*")
+    assert glob_match("observatory.linkmap.hierarchical",
+                      "observatory.linkmap.*")
+    assert not glob_match("pic", "bench_*")
+    # a pattern whose character class genuinely matches keeps working
+    assert glob_match("a1", "a[0-9]")
+
+
+def test_bracketed_names_match_bracketed_patterns():
+    # raw fnmatch would read [s=1.1.4] as a character class and fail;
+    # glob_match retries with the bracket escaped
+    assert glob_match("PpermuteSlab[s=2]", "*[s=2]")
+    assert glob_match("observatory.linkmap.hierarchical[dcn]",
+                      "observatory.linkmap.hierarchical[dcn]")
+    assert glob_match("observatory.linkmap.hierarchical[dcn]",
+                      "*hierarchical[dcn]")
+    assert glob_match("PpermuteSlab[s=1.1.4]", "PpermuteSlab[s=*]")
+    assert not glob_match("PpermuteSlab[s=2]", "*[s=4]")
+    assert not glob_match("PpermuteSlab[s=2]", "AllGather[s=2]")
+
+
+@pytest.mark.parametrize("name,pattern,expected", [
+    ("bench_exchange[s=1.1.4]", "bench_exchange[s=1.1.4]", True),
+    ("bench_exchange[s=1.1.4]", "*[s=1.1.4]", True),
+    ("bench_exchange", "bench_exchange[s=*]", False),
+    ("x[a]y", "x[a]*", True),
+])
+def test_bracket_tolerance_table(name, pattern, expected):
+    assert glob_match(name, pattern) is expected

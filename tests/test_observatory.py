@@ -401,6 +401,65 @@ def test_payload_records_carry_contract_race_legs():
     assert by_bench["pic.megastep"]["config"]["check_every"] == 8
 
 
+def test_payload_records_stamp_depths_post_fingerprint():
+    """Asymmetric-depth bench configs carry a structured ``depths``
+    vector in the ledger record, stamped AFTER the fingerprint is
+    taken: a payload with and without the vector lands in the same
+    (fingerprint, bench) trajectory group (the ``exchange_every``
+    label string already keys it)."""
+    from stencil_tpu.observatory.ledger import payload_records
+
+    base = {"bench": "bench_exchange", "mesh": [2, 2, 2],
+            "per_device_size": [8, 8, 8], "radius": [1, 1, 1],
+            "fields": 1}
+    with_depths = {**base,
+                   "configs": [{"exchange_every": "1.1.4",
+                                "depths": [1, 1, 4],
+                                "steps_per_s": 80.0}]}
+    without = {**base,
+               "configs": [{"exchange_every": "1.1.4",
+                            "steps_per_s": 80.0}]}
+    stamped, _ = payload_records(with_depths, "t",
+                                 provenance="measured", created=1.0)
+    plain, _ = payload_records(without, "t",
+                               provenance="measured", created=1.0)
+    assert stamped[0]["config"]["depths"] == [1, 1, 4]
+    assert "depths" not in plain[0]["config"]
+    assert stamped[0]["fingerprint"] == plain[0]["fingerprint"]
+    assert stamped[0]["config"]["exchange_every"] == "1.1.4"
+
+
+def test_gate_and_groups_accept_bench_globs_and_brackets():
+    """The ledger CLIs' ``--bench`` filter is a glob with
+    literal-bracket tolerance: ``bench_exchange*`` restricts the gate,
+    and a bench id carrying ``[...]`` (the candidate-key spelling)
+    matches both its exact string and a ``*[s=...]`` pattern that raw
+    fnmatch would misread as a character class."""
+    from stencil_tpu.observatory.ledger import gate_groups_checked
+
+    regressed = [_record(100.0), _record(50.0, created=2.0),
+                 _record(100.0, bench="pic", fp="b" * 32),
+                 _record(90.0, bench="pic", fp="b" * 32, created=2.0)]
+    assert len(gate_regressions(regressed, threshold=0.2)) == 1
+    assert len(gate_regressions(regressed, threshold=0.2,
+                                bench="b*")) == 1
+    assert gate_regressions(regressed, threshold=0.2,
+                            bench="pic") == []
+    assert gate_groups_checked(regressed, bench="b*") == 1
+    assert gate_groups_checked(regressed) == 2
+
+    bracketed = [_record(100.0, bench="bench_exchange[s=1.1.4]"),
+                 _record(40.0, bench="bench_exchange[s=1.1.4]",
+                         created=2.0)]
+    for pat in ("bench_exchange[s=1.1.4]", "*[s=1.1.4]",
+                "bench_exchange*"):
+        assert len(gate_regressions(bracketed, threshold=0.2,
+                                    bench=pat)) == 1, pat
+        assert gate_groups_checked(bracketed, bench=pat) == 1, pat
+    assert gate_regressions(bracketed, threshold=0.2,
+                            bench="*[s=2]") == []
+
+
 def test_committed_seed_ledger_matches_backfill():
     """bench/ledger.jsonl: the first ten records are exactly the
     backfill of the committed legacy snapshots; everything after is a

@@ -174,3 +174,79 @@ class TestQap:
             d = rng.uniform(0.1, 1, (5, 5))
             f, c = solve(w, d)
             assert c == pytest.approx(cost(w, d, f))
+
+
+class TestExactPartitionCandidates:
+    def test_enumerates_exact_factorizations_only(self):
+        from stencil_tpu.partition import exact_partition_candidates
+
+        cands = exact_partition_candidates((32, 16, 16), 8)
+        assert Dim3(8, 1, 1) in cands
+        assert Dim3(2, 2, 2) in cands
+        for dim in cands:
+            assert dim.flatten() == 8
+            assert Dim3(32, 16, 16) % dim == Dim3(0, 0, 0)
+        # a prime axis with no exact split yields no candidate there
+        assert exact_partition_candidates((7, 7, 7), 8) == []
+
+
+class TestHierarchicalDcnPlanner:
+    """The hierarchical partition planner (_plan_dcn_partition): on a
+    DCN-blocked domain the deployed grid must be the candidate the
+    per-link alpha-beta model prices cheapest — the slice seam lands
+    on the axis with the smallest halo cross-section, and deep
+    temporal blocking on that axis must beat the uniform-depth
+    trivial baseline in modeled step seconds (ISSUE 19 acceptance)."""
+
+    def _domain(self, depths=None):
+        import jax
+
+        from stencil_tpu.distributed import DistributedDomain
+
+        devs = jax.devices()[:8]
+        dd = DistributedDomain(32, 16, 16, devices=devs)
+        dd.set_radius(1)
+        dd.add_data("q", np.float32)
+        if depths is not None:
+            dd.set_exchange_every(depths)
+        dd.set_dcn_axis(groups=[devs[:4], devs[4:]])
+        dd.realize()
+        return dd
+
+    def test_planner_minimizes_dcn_cross_section(self):
+        from stencil_tpu.parallel.mesh import mesh_dim
+
+        dd = self._domain()
+        # 32x16x16 over 8 devices, 2 slices: (8,1,1) puts the seam on
+        # x where the cross-section (16*16) is smallest per face pair
+        # and leaves y/z unsharded (zero ICI halo traffic)
+        assert tuple(mesh_dim(dd.mesh)) == (8, 1, 1)
+        assert dd.dcn_axis == 0
+        assert dd.n_slices == 2
+
+    def test_asym_depth_on_dcn_axis_beats_uniform_trivial(self):
+        """The acceptance criterion: modeled step seconds of the
+        planned grid + deep blocking on the DCN axis beat the
+        uniform-depth baseline (the expensive DCN alpha/beta bill is
+        paid once per 4 steps instead of every step)."""
+        from stencil_tpu.analysis.costmodel import (
+            asymmetric_step_seconds)
+        from stencil_tpu.parallel.mesh import mesh_dim
+
+        base = self._domain()
+        deep = self._domain(depths={"x": 4})
+
+        def seconds(dd):
+            local = dd.local_size
+            return asymmetric_step_seconds(
+                "PpermuteSlab", (local.z, local.y, local.x),
+                dd.radius, mesh_dim(dd.mesh), (4,),
+                dd.exchange_depths, dcn_axis=dd.dcn_axis)
+
+        assert seconds(deep) < seconds(base)
+        # and the planned grid itself beats the naive cube-like split
+        # (2,2,2) under the same model and depths
+        naive = asymmetric_step_seconds(
+            "PpermuteSlab", (8, 8, 16), Radius.constant(1),
+            Dim3(2, 2, 2), (4,), deep.exchange_depths, dcn_axis=0)
+        assert seconds(deep) < naive

@@ -154,3 +154,90 @@ class TestMakePlacement:
                             devs, Radius.constant(1), (4,), seed=7)
         assert p1.assignment == p2.assignment
         assert sorted(p1.assignment) == list(range(8))
+
+
+class TestPlacementModes:
+    """The deployment flip: ``make_placement(mode=...)`` — the QAP
+    assignment ships by default on non-uniform fabrics (measured hop
+    spread or a DCN-blocked axis), the trivial order is retained on
+    uniform fabrics, and "trivial"/"qap" force either side. Every
+    deployed assignment is clamped to never cost more than identity
+    under the QAP objective (the observatory placement-report gate,
+    held structurally)."""
+
+    class _IdDev:
+        def __init__(self, i):
+            self.id = i
+
+    def _args(self, grid=(16, 16, 32), counts=(2, 2, 2)):
+        part = RankPartition.from_dim(grid, counts)
+        n = Dim3.of(counts).flatten()
+        return part, [self._IdDev(i) for i in range(n)]
+
+    def test_mode_validation(self):
+        from stencil_tpu.placement import normalize_placement_mode
+
+        assert normalize_placement_mode(None) == "auto"
+        assert normalize_placement_mode("qap") == "qap"
+        with pytest.raises(ValueError):
+            normalize_placement_mode("fastest")
+
+    def test_auto_on_uniform_fabric_keeps_trivial_order(self):
+        part, devs = self._args()
+        p = make_placement(PlacementStrategy.NodeAware, part, devs,
+                           Radius.constant(1), (4,), mode="auto")
+        assert p.assignment == list(range(8))
+
+    def test_auto_deploys_qap_on_dcn_blocked_fabric(self):
+        """A DCN seam across z makes the coordless fabric non-uniform
+        (synthetic lattice-torus + DCN-penalty distances): auto must
+        QAP-refine, and the deployed permutation must never cost more
+        than identity on that same fabric."""
+        from stencil_tpu.observatory.linkmap import mesh_distance_matrix
+
+        part, devs = self._args()
+        radius = Radius.constant(1)
+        p = make_placement(PlacementStrategy.NodeAware, part, devs,
+                           radius, (4,), mode="auto", dcn_axis=2,
+                           n_slices=2)
+        assert sorted(p.assignment) == list(range(8))
+        w = comm_bytes_matrix(part, radius, (4,))
+        dist = mesh_distance_matrix(Dim3(2, 2, 2), dcn_axis=2,
+                                    n_slices=2)
+        assert qap.cost(w, dist, p.assignment) <= \
+            qap.cost(w, dist, list(range(8))) + 1e-9
+
+    def test_trivial_mode_skips_refinement_on_dcn_fabric(self):
+        part, devs = self._args()
+        p = make_placement(PlacementStrategy.NodeAware, part, devs,
+                           Radius.constant(1), (4,), mode="trivial",
+                           dcn_axis=2, n_slices=2)
+        assert p.assignment == list(range(8))
+
+    def test_qap_mode_forces_refinement_on_uniform_fabric(self):
+        """mode="qap" on a coordless fabric synthesizes the lattice
+        distances and refines anyway — still clamped to identity."""
+        from stencil_tpu.observatory.linkmap import mesh_distance_matrix
+
+        part, devs = self._args()
+        radius = Radius.constant(1)
+        p = make_placement(PlacementStrategy.NodeAware, part, devs,
+                           radius, (4,), mode="qap")
+        assert sorted(p.assignment) == list(range(8))
+        w = comm_bytes_matrix(part, radius, (4,))
+        dist = mesh_distance_matrix(Dim3(2, 2, 2))
+        assert qap.cost(w, dist, p.assignment) <= \
+            qap.cost(w, dist, list(range(8))) + 1e-9
+
+    def test_domain_placement_mode_escape_hatch(self):
+        """DistributedDomain.set_placement("qap"|"trivial"|"auto")
+        sets the NodeAware mode; junk is rejected loudly."""
+        from stencil_tpu.distributed import DistributedDomain
+
+        dd = DistributedDomain(16, 16, 16)
+        dd.set_placement("qap")
+        assert dd.placement_mode == "qap"
+        dd.set_placement(PlacementStrategy.Trivial)  # strategy form
+        assert dd.strategy == PlacementStrategy.Trivial
+        with pytest.raises(ValueError):
+            dd.set_placement("fastest")

@@ -243,6 +243,77 @@ def test_set_exchange_every_after_realize_raises():
         dd.set_exchange_every(2)
 
 
+def test_jacobi_asym_depths_bitwise_even_and_uneven():
+    """Per-axis {z: 4, y: 1, x: 1}: z rides a depth-4r slab refreshed
+    once per 4 steps while x/y refresh every sub-step — bitwise equal
+    to stepwise on even 16^3 AND uneven 17^3 (rem (1,1,1)), periodic
+    and zero-Dirichlet, 5 iterations so the tail group is partial."""
+    for size in ((16, 16, 16), (17, 17, 17)):
+        for boundary in BOUNDARIES:
+            base = Jacobi3D(*size, mesh_shape=(2, 2, 2),
+                            dtype=np.float64, kernel="xla",
+                            boundary=boundary)
+            base.init()
+            base.run(5)
+            ref = base.temperature()
+            j = Jacobi3D(*size, mesh_shape=(2, 2, 2), dtype=np.float64,
+                         kernel="xla", boundary=boundary,
+                         exchange_every={"z": 4, "y": 1, "x": 1})
+            assert j.kernel_path == "xla-temporal[s=1.1.4]"
+            j.init()
+            j.run(5)
+            np.testing.assert_array_equal(j.temperature(), ref)
+            stats = j.exchange_stats()
+            # x's cadence-1 refresh rides every sub-step, so dispatches
+            # stay at one round per iteration — the win is the deep z
+            # slab shipping (and paying its DCN alpha) only once per 4
+            assert stats["rounds_per_iteration"] == pytest.approx(1.0)
+
+
+def test_jacobi_asym_depths_packed_method_bitwise():
+    """Asymmetric depths through the PpermutePacked data path (uneven
+    shards): the mid-group x/y refreshes ride the packed buffers."""
+    base = Jacobi3D(17, 8, 8, mesh_shape=(2, 2, 2), dtype=np.float64,
+                    kernel="xla", methods=Method.PpermutePacked)
+    base.init()
+    base.run(4)
+    j = Jacobi3D(17, 8, 8, mesh_shape=(2, 2, 2), dtype=np.float64,
+                 kernel="xla", methods=Method.PpermutePacked,
+                 exchange_every={"x": 2})
+    assert j.kernel_path == "xla-temporal[s=2.1.1]"
+    j.init()
+    j.run(4)
+    np.testing.assert_array_equal(j.temperature(), base.temperature())
+
+
+def test_asym_depths_decline_loudly():
+    """The unsupported compositions must raise NotImplementedError at
+    construction/realize — never a silent fall back to symmetric
+    blocking or stepwise exchange."""
+    asym = {"z": 2, "y": 1, "x": 1}
+    # the Pallas in-kernel multi-step paths have ONE step count
+    for kernel in ("wrap", "halo", "pallas"):
+        with pytest.raises(NotImplementedError,
+                           match="asymmetric temporal depths"):
+            Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2),
+                     dtype=np.float64, kernel=kernel,
+                     exchange_every=asym)
+    # the overlap composition assumes one group-wide deep exchange
+    with pytest.raises(NotImplementedError, match="overlap"):
+        Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float64,
+                 kernel="xla", exchange_every=asym, overlap=True)
+    # the irredundant dedup plan assumes one group-wide exchange whose
+    # slabs carry the halo-of-halo rows mid-group refreshes rely on
+    with pytest.raises(NotImplementedError, match="wire_layout"):
+        Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float64,
+                 kernel="xla", exchange_every=asym,
+                 wire_layout="irredundant")
+    # each axis's cadence must divide the group length
+    with pytest.raises(ValueError):
+        Jacobi3D(16, 16, 16, mesh_shape=(2, 2, 2), dtype=np.float64,
+                 kernel="xla", exchange_every={"z": 4, "y": 3, "x": 1})
+
+
 def test_mhd_exchange_every_one_is_stepwise():
     import jax
 

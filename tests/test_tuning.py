@@ -289,6 +289,105 @@ def test_candidate_wire_format_space_and_feasibility():
 
 
 # ---------------------------------------------------------------------------
+# per-axis depths + placement: keys, candidate space, cache compat
+
+
+def test_asym_candidate_key_roundtrip_and_feasibility():
+    """Asymmetric depths serialize as a dot-separated (x, y, z) depth
+    (``PpermuteSlab[s=1.1.4]``), round-trip through from_key, and obey
+    the realize()-equivalent feasibility rules per axis."""
+    c = Candidate("PpermuteSlab", 4, depths=(1, 1, 4))
+    assert c.key() == "PpermuteSlab[s=1.1.4]"
+    assert Candidate.from_key(c.key()) == c
+    # a uniform depths tuple collapses to the symmetric spelling
+    assert Candidate("PpermuteSlab", 2, depths=(2, 2, 2)).key() == \
+        "PpermuteSlab[s=2]"
+    geom = _geom()
+    assert candidate_feasible(Candidate("PpermuteSlab", 4,
+                                        depths=(1, 1, 4)), geom)
+    # the deep axis is bounded by the SMALLEST shard (min_interior is
+    # zyx: 7 rows on z reject depth 8 there, depth 4 fits)
+    short = _geom(min_interior=(7, 8, 8))
+    assert not candidate_feasible(Candidate("PpermuteSlab", 8,
+                                            depths=(1, 1, 8)), short)
+    assert candidate_feasible(Candidate("PpermuteSlab", 4,
+                                        depths=(1, 1, 4)), short)
+    # asym declines: non-ppermute engines, overlap, non-slab layout,
+    # and cadences that do not divide the group length
+    assert not candidate_feasible(Candidate("AllGather", 4,
+                                            depths=(1, 1, 4)), geom)
+    assert not candidate_feasible(Candidate("PpermuteSlab", 4, True,
+                                            depths=(1, 1, 4)), geom)
+    assert not candidate_feasible(
+        Candidate("PpermuteSlab", 4, wire_layout="irredundant",
+                  depths=(1, 1, 4)), geom)
+    assert not candidate_feasible(Candidate("PpermuteSlab", 4,
+                                            depths=(1, 3, 4)), geom)
+
+
+def test_candidate_space_asymmetric_depth_specs():
+    """Depth entries may be per-axis dicts/tuples: they become
+    asymmetric candidates on the ppermute engines only, and uniform
+    spellings collapse into the symmetric set (no duplicate keys)."""
+    cands = candidate_space(_geom(), depths=(1, 4, {"z": 4}, (4, 4, 4)),
+                            runnable=lambda m: True)
+    keys = [c.key() for c in cands]
+    assert len(keys) == len(set(keys))
+    assert "PpermuteSlab[s=1.1.4]" in keys
+    assert "PpermutePacked[s=1.1.4]" in keys
+    assert "PpermuteSlab[s=4]" in keys
+    assert not any(k.startswith(("AllGather[s=1.1.4",
+                                 "PallasDMA[s=1.1.4")) for k in keys)
+
+
+def test_plan_cache_loads_pre_deployment_records(tmp_path):
+    """Cache records written before the per-axis depth / placement
+    axes existed carry neither ``config.depths`` nor ``placement`` —
+    they must load cleanly as symmetric-depth auto-placement plans
+    (the same old-record contract as ``Plan.tiling``), and a new
+    asymmetric/qap plan round-trips its keys."""
+    cache = tmp_path / "plans.json"
+    store_plan(Plan(config=Candidate("PpermutePacked", 4),
+                    fingerprint="old1", coefficients={}, costs={}),
+               cache)
+    data = json.loads(cache.read_text())
+    rec = data["plans"]["old1"]
+    del rec["config"]["depths"]
+    del rec["placement"]
+    cache.write_text(json.dumps(data))
+    back = load_plan("old1", cache)
+    assert back is not None
+    assert back.config.depths is None
+    assert back.config.depths_xyz() == (4, 4, 4)
+    assert back.placement == "auto"
+    store_plan(Plan(config=Candidate("PpermuteSlab", 4,
+                                     depths=(1, 1, 4)),
+                    fingerprint="new1", coefficients={}, costs={},
+                    placement="qap"), cache)
+    b2 = load_plan("new1", cache)
+    assert b2.config.depths == (1, 1, 4)
+    assert b2.config.key() == "PpermuteSlab[s=1.1.4]"
+    assert b2.placement == "qap"
+
+
+def test_fingerprint_depths_and_placement_only_when_nondefault():
+    """Symmetric depths and auto placement are the identity: spelling
+    them out must not re-key plans cached before these axes existed;
+    non-uniform depths and forced placement modes must."""
+    base = dict(platform="cpu", device_count=8, mesh_shape=[2, 2, 2],
+                grid=[16, 16, 16], radius=Radius.constant(1),
+                quantities={"q0": "float32"}, boundary="PERIODIC")
+    fp = fingerprint(fingerprint_inputs(**base))
+    assert fingerprint(fingerprint_inputs(
+        exchange_depths=(4, 4, 4), placement="auto", **base)) == fp
+    assert fingerprint(fingerprint_inputs(
+        exchange_depths=(1, 1, 4), **base)) != fp
+    assert fingerprint(fingerprint_inputs(placement="qap", **base)) != fp
+    assert fingerprint(fingerprint_inputs(placement="trivial",
+                                          **base)) != fp
+
+
+# ---------------------------------------------------------------------------
 # the end-to-end search (fake timer; deterministic)
 
 
