@@ -23,7 +23,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 TIER="${CI_TIER:-smoke}"
 
-echo "== 1/12 lint (stencil-lint + ruff; tier=$TIER) =="
+echo "== 1/13 lint (stencil-lint + ruff; tier=$TIER) =="
 # stencil-lint: all thirteen static checkers — halo-radius footprint,
 # DMA discipline, ppermute sanity, HLO collective-permute-only
 # lowering, analytic-vs-HLO byte cross-check, the Pallas VMEM/tiling
@@ -197,10 +197,10 @@ if [ "$TIER" = "full" ]; then
   fi
 fi
 
-echo "== 2/12 native build =="
+echo "== 2/13 native build =="
 bash ci/build.sh
 
-echo "== 3/12 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
+echo "== 3/13 unit tests, tier=$TIER (8-device virtual CPU mesh) =="
 # The full tier is dominated by interpret-mode Pallas parity tests
 # (CPU-bound, independent): fan them out with pytest-xdist when the
 # machine has cores to spare. Each worker process builds its own
@@ -216,7 +216,7 @@ else
   python -m pytest tests/ -q --maxfail=1 -m "not slow"
 fi
 
-echo "== 4/12 app smoke runs =="
+echo "== 4/13 app smoke runs =="
 # overlap app smokes execute remote DMA: possible only on a TPU or
 # with the distributed (mosaic) interpreter — probe, don't assume
 RDMA_OK=$(python -c "from stencil_tpu._compat import remote_dma_runnable
@@ -241,7 +241,7 @@ smoke() { echo "-- $*"; python "$@" > /dev/null; }
   smoke bench_qap.py --sizes 4 6
 )
 
-echo "== 5/12 bench smoke: temporal blocking + autotuned plan =="
+echo "== 5/13 bench smoke: temporal blocking + autotuned plan =="
 # communication-avoiding temporal blocking must not regress steps/s of
 # the REAL blocked hot path (Jacobi3D's fused run loop, redundant ring
 # compute included) on the fake CPU mesh; the amortized byte model
@@ -388,7 +388,7 @@ fi
 rm -f "$BENCH_JSON" "$BENCH_METRICS" "$TUNE_CACHE"
 # NOTE: "$OBS_LEDGER" survives into stages 8/9 (the observatory stage)
 
-echo "== 6/12 exchange autotuner (fake timer: search/fit/plan/cache) =="
+echo "== 6/13 exchange autotuner (fake timer: search/fit/plan/cache) =="
 # the tuner's whole pipeline with deterministic fake measurements (no
 # hardware dependence): first invocation tunes and writes the plan
 # cache, the second MUST be a cache hit performing zero measurements.
@@ -419,7 +419,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -f "$TUNE_CACHE" "$PLAN1" "$PLAN2"
 
-echo "== 7/12 chaos smoke: resilient run loop under injected faults =="
+echo "== 7/13 chaos smoke: resilient run loop under injected faults =="
 # the Jacobi app under run_resilient (stencil_tpu/resilience) with a
 # seeded fault plan: one NaN injection (must trip the health sentinel
 # and roll back to the last good checkpoint) and one transient save
@@ -481,7 +481,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -rf "$CHAOS_CKPT" "$CHAOS_EVENTS" "$CHAOS_FLIGHT"
 
-echo "== 8/12 pic smoke: particle migration + ParticleLoss chaos =="
+echo "== 8/13 pic smoke: particle migration + ParticleLoss chaos =="
 # the particle-in-cell workload (stencil_tpu/models/pic.py): a short
 # run proves the dynamic migration path end-to-end (CSV line, zero
 # overflow, charge conserved), then a chaos run injects a ParticleLoss
@@ -577,7 +577,7 @@ EOF
 fi
 rm -rf "$PIC_CKPT" "$PIC_EVENTS" "$PIC_BENCH" "$PIC_METRICS"
 
-echo "== 9/12 observatory: bench ledger validate/gate + backfill =="
+echo "== 9/13 observatory: bench ledger validate/gate + backfill =="
 # the bench trajectory ledger (stencil_tpu/observatory/ledger.py): the
 # bench (stage 5) and pic (stage 8) smoke runs appended their records
 # to the scratch ledger — validate it, prove the regression gate
@@ -652,7 +652,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -f "$OBS_LEDGER" "$OBS_BAD" "$OBS_LEGACY" "$OBS_GATE_JSON"
 
-echo "== 10/12 service smoke: concurrent multi-tenant ensemble campaigns =="
+echo "== 10/13 service smoke: concurrent multi-tenant ensemble campaigns =="
 # the campaign service (stencil_tpu/serving) on the fake CPU mesh:
 # three concurrent fake tenants share one problem fingerprint and ride
 # ONE batched ensemble dispatch stream (tenant0 gets a chaos NaN that
@@ -708,7 +708,7 @@ if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
 fi
 rm -rf "$SERVE_ROOT" "$SERVE_CACHE" "$SERVE_EVENTS1" "$SERVE_EVENTS2"
 
-echo "== 11/12 telemetry: metrics surface, span trace, unified events =="
+echo "== 11/13 telemetry: metrics surface, span trace, unified events =="
 # the observability acceptance gate (stencil_tpu/telemetry): a first
 # service process (cold: tunes once) and a second process on the same
 # plan cache (warm) each export their metrics snapshot, span trace,
@@ -779,7 +779,105 @@ fi
 rm -rf "$TM_ROOT" "$TM_CACHE" "$TM_EVENTS1" "$TM_EVENTS2" \
        "$TM_METRICS1" "$TM_METRICS2" "$TM_TRACE"
 
-echo "== 12/12 multi-chip certification sweep =="
+echo "== 12/13 fleet chaos smoke: replica kill + admission flood =="
+# the zero-loss gate (ROADMAP item 4) proven from EXPORTED surfaces:
+# a calm 3-replica / 4-tenant fleet establishes the reference digests,
+# then a chaos fleet on the SAME plan cache kills the replica that
+# rendezvous-owns tenant t0 mid-batch (member step 2, after that
+# step's checkpoints landed) while a priority-0 admission flood
+# hammers the front door. Gates: zero campaigns lost (every final
+# field digest bitwise-equal to the calm run), recovered campaigns
+# RESUMED from a checkpoint (not restarted), survivors'
+# recompiles_total and tuner_measurements_total both 0 (shared plan
+# cache + bounded engine cache), >= 1 request shed with a NAMED
+# reason, the fleet event log schema-valid, and the dead replica's
+# flight-recorder black box archived.
+FLEET_ROOT="$(mktemp -d -t fleet_root.XXXXXX)"
+FLEET_CACHE="$(mktemp -t fleet_cache.XXXXXX.json)"; rm -f "$FLEET_CACHE"
+FLEET_CALM="$(mktemp -t fleet_calm.XXXXXX.json)"
+FLEET_CHAOS="$(mktemp -t fleet_chaos.XXXXXX.json)"
+FLEET_EVENTS="$(mktemp -t fleet_events.XXXXXX.json)"
+FLEET_METRICS="$(mktemp -t fleet_metrics.XXXXXX.json)"
+FLEET_FLIGHT="$(mktemp -d -t fleet_flight.XXXXXX)"
+( cd apps
+  python fleet.py --replicas 3 --tenants 4 --steps 6 --fake-cpu 8 \
+        --fake-timer --tune-cache "$FLEET_CACHE" \
+        --root "$FLEET_ROOT/calm" --results-json "$FLEET_CALM"
+  python fleet.py --replicas 3 --tenants 4 --steps 6 --fake-cpu 8 \
+        --fake-timer --tune-cache "$FLEET_CACHE" \
+        --root "$FLEET_ROOT/chaos" --kill-owner-of t0 \
+        --kill-at-step 2 --flood 6 --max-queue-depth 3 \
+        --results-json "$FLEET_CHAOS" --events-json "$FLEET_EVENTS" \
+        --metrics-json "$FLEET_METRICS" --flight-dir "$FLEET_FLIGHT" )
+python -m stencil_tpu.telemetry validate-events "$FLEET_EVENTS"
+[ -n "$(ls -A "$FLEET_FLIGHT")" ] \
+  || { echo "FAIL: dead replica left no flight-recorder dump"; exit 1; }
+FLEET_CALM="$FLEET_CALM" FLEET_CHAOS="$FLEET_CHAOS" \
+FLEET_EVENTS="$FLEET_EVENTS" FLEET_METRICS="$FLEET_METRICS" \
+python - <<'EOF'
+import json
+import os
+from stencil_tpu.telemetry import snapshot_value as v
+calm = json.load(open(os.environ["FLEET_CALM"]))
+chaos = json.load(open(os.environ["FLEET_CHAOS"]))
+ev = json.load(open(os.environ["FLEET_EVENTS"]))
+met = json.load(open(os.environ["FLEET_METRICS"]))
+# zero campaigns lost: every tenant finished, bitwise-equal to calm
+assert set(chaos["campaigns"]) == set(calm["campaigns"]), chaos
+for t, c in chaos["campaigns"].items():
+    assert c["ok"], (t, c)
+    assert c["digest"] == calm["campaigns"][t]["digest"], t
+# the killed replica really died and its campaigns really RESUMED
+killed = f"replica-{chaos['killed']}"
+states = {n: r["state"] for n, r in chaos["replicas"].items()}
+assert states[killed] == "dead", states
+assert v(met, "stencil_fleet_replicas", state="dead") == 1, met
+assert v(met, "stencil_fleet_replicas", state="active") == 2, met
+assert v(met, "stencil_fleet_recovered_campaigns_total") >= 1, met
+resumed = [c for c in chaos["campaigns"].values()
+           if c.get("resumed_from") is not None]
+assert resumed and all(c["resumed_from"] > 0 for c in resumed), chaos
+# survivors: zero recompiles, zero tuner measurements — and the
+# series EXIST in the export (seeded 0), not absent-series 0.0
+for n, r in chaos["replicas"].items():
+    if r["state"] != "active":
+        continue
+    assert r["recompiles"] == 0, (n, r)
+    assert r["tuner_measurements"] == 0, (n, r)
+    for m in ("stencil_service_recompiles_total",
+              "stencil_service_tuner_measurements_total"):
+        assert r["metrics"]["metrics"][m]["samples"], (n, m)
+# the flood was shed LOUDLY: counter + named-reason events agree
+shed = v(met, "stencil_fleet_shed_total",
+         tenant="flood", reason="queue_depth")
+assert shed >= 1, met
+sheds = [e for e in ev["events"] if e["event"] == "request_shed"]
+assert len(sheds) == int(shed), (shed, sheds)
+assert all(e["reason"] in ("queue_depth", "admission_latency")
+           for e in sheds), sheds
+kinds = {e["event"] for e in ev["events"]}
+assert {"fault_replica_crash", "replica_dead",
+        "campaign_recovered"} <= kinds, kinds
+n_rec = sum(1 for e in ev["events"]
+            if e["event"] == "campaign_recovered")
+print(f"fleet chaos smoke OK: {killed} killed mid-batch, "
+      f"{n_rec} campaign(s) recovered bitwise-equal, survivors "
+      f"recompiles=0 tuner_measurements=0, {int(shed)} request(s) "
+      f"shed (queue_depth), events schema-valid")
+EOF
+if [ -n "${CI_ARTIFACT_DIR:-}" ]; then
+  mkdir -p "$CI_ARTIFACT_DIR"
+  cp "$FLEET_CALM" "$CI_ARTIFACT_DIR/fleet_calm.json"
+  cp "$FLEET_CHAOS" "$CI_ARTIFACT_DIR/fleet_chaos.json"
+  cp "$FLEET_EVENTS" "$CI_ARTIFACT_DIR/fleet_events.json"
+  cp "$FLEET_METRICS" "$CI_ARTIFACT_DIR/fleet_metrics.json"
+  mkdir -p "$CI_ARTIFACT_DIR/fleet_flight"
+  cp "$FLEET_FLIGHT"/* "$CI_ARTIFACT_DIR/fleet_flight/" 2>/dev/null || true
+fi
+rm -rf "$FLEET_ROOT" "$FLEET_CACHE" "$FLEET_CALM" "$FLEET_CHAOS" \
+       "$FLEET_EVENTS" "$FLEET_METRICS" "$FLEET_FLIGHT"
+
+echo "== 13/13 multi-chip certification sweep =="
 python __graft_entry__.py 8 | tail -1
 
 echo "CI PASSED"

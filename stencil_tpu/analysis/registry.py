@@ -2092,6 +2092,82 @@ def _ensemble_set_lane_entry():
     return eng._set_lane, (dict(eng.state), lane, jnp.int32(0))
 
 
+@functools.lru_cache(maxsize=None)
+def _fleet_bucket_requests():
+    """A padded admission (user grid strictly inside the bucket) and
+    the native bucket-shape request — the pair the fleet bucketing
+    targets compare."""
+    from ..serving.queue import CampaignRequest
+    from ..serving.slo import GridBucketer
+
+    bucketer = GridBucketer(((24, 24, 24),))
+    padded, was_padded = bucketer.apply(CampaignRequest(
+        tenant="lint", campaign="pad", grid=(18, 21, 13),
+        mesh_shape=_EXCHANGE_MESH))
+    native = CampaignRequest(tenant="lint", campaign="native",
+                             grid=(24, 24, 24),
+                             mesh_shape=_EXCHANGE_MESH)
+    return padded, native, was_padded
+
+
+@functools.lru_cache(maxsize=None)
+def _fleet_bucket_entry():
+    """The fleet admission path's compiled step: bucketing replaces
+    the user grid with its bucket BEFORE fingerprinting, so a padded
+    request must share the native bucket request's fingerprint (ONE
+    engine-cache slot — the bounded-cache contract). Raises when
+    bucketing leaks the pre-pad grid into the admission key; returns
+    the bucket-shaped ensemble step entry the padded request reuses."""
+    from ..serving.queue import request_fingerprint
+
+    padded, native, was_padded = _fleet_bucket_requests()
+    if not was_padded or tuple(padded.grid) != (24, 24, 24):
+        raise AssertionError(
+            f"grid bucketing failed: (18, 21, 13) admitted at "
+            f"{tuple(padded.grid)}, want the (24, 24, 24) bucket")
+    fp_pad = request_fingerprint(padded)
+    fp_nat = request_fingerprint(native)
+    if fp_pad != fp_nat:
+        raise AssertionError(
+            f"padded admission does not share the native bucket "
+            f"fingerprint ({fp_pad} != {fp_nat}) — the pre-pad grid "
+            f"leaked into the admission key, so the per-replica "
+            f"engine cache is unbounded again")
+    return _ensemble_step_entry()
+
+
+def _fleet_bucket_step_spec() -> HloSpec:
+    """Bucketed-admission HLO identity: the step an engine built from
+    the PADDED request lowers to StableHLO text byte-identical to the
+    native bucket-shape step (bucketing must not leak the pre-pad
+    grid into the compiled program), with the same pinned collective
+    contract as ``serving.ensemble.step``."""
+    from .hlo import lowering_supported
+
+    padded, _, _ = _fleet_bucket_requests()
+    fn, args = _fleet_bucket_entry()
+    if lowering_supported():
+        import jax
+        import jax.numpy as jnp
+
+        from ..serving.ensemble import EnsembleJacobi
+        eng_pad = EnsembleJacobi(_ENSEMBLE_N, *padded.grid,
+                                 mesh_shape=_EXCHANGE_MESH)
+        hot, cold = eng_pad._param_args()
+        pad_args = (eng_pad.state["temp"], hot, cold,
+                    jnp.asarray(1, jnp.int32))
+        pad_text = jax.jit(eng_pad._step_n).lower(*pad_args).as_text()
+        nat_text = jax.jit(fn).lower(*args).as_text()
+        if pad_text != nat_text:
+            raise AssertionError(
+                "padded-bucket step does not lower to HLO identical "
+                "to the native bucket-shape step — bucketed admission "
+                "compiled a different program than the bucket it "
+                "claims to reuse")
+    return HloSpec(fn=fn, args=args, allow=("collective_permute",),
+                   exact_counts={"collective_permute": 6})
+
+
 def _donation_spec(entry, donate=(0,)):
     fn, args = entry()
     return DonationSpec(fn=fn, args=args, donate_argnums=tuple(donate))
@@ -2159,6 +2235,7 @@ def _dataflow_targets() -> List[Target]:
          _ensemble_step_entry),
         (f"serving.ensemble.segment[N={_ENSEMBLE_N},k=2,transfer]",
          _ensemble_segment_entry),
+        ("serving.fleet.admission[transfer]", _fleet_bucket_entry),
         ("models.pic.step[transfer]", _pic_step_entry),
     ]
     for name, entry in transfer:
@@ -2181,6 +2258,8 @@ def _dataflow_targets() -> List[Target]:
          _ensemble_step_entry, ((0, None),)),
         (f"serving.ensemble.segment[N={_ENSEMBLE_N},k=2,recompile]",
          _ensemble_segment_entry, ((0, (0,)),)),
+        ("serving.fleet.admission[recompile]",
+         _fleet_bucket_entry, ((0, None),)),
         ("models.pic.step[recompile]", _pic_step_entry, ((0, None),)),
     ]
     for name, entry, carry in recompile:
@@ -2823,6 +2902,8 @@ def default_targets() -> List[Target]:
                   _ensemble_step_spec),
         HloTarget("serving.ensemble.probe[N=4,hlo]",
                   _ensemble_probe_spec),
+        HloTarget("serving.fleet.bucket_step[hlo]",
+                  _fleet_bucket_step_spec),
     ]
     # the health sentinel's probe: exactly one small all-reduce, alone
     # and fused into the production step (see resilience/health.py)

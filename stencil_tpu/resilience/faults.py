@@ -25,6 +25,20 @@ Fault classes (all dataclasses on a :class:`FaultPlan`):
 * :class:`Preemption` — deliver a real ``SIGTERM`` to this process at
   a chosen step (the fleet scheduler reclaiming the host).
 
+Fleet-level fault classes (consumed by :class:`~..serving.fleet.
+Fleet` rather than the single-process resilience driver; ``step`` is
+the fleet serving ROUND, not a member step):
+
+* :class:`ReplicaCrash` — hard-kill one replica mid-batch: its
+  in-RAM lanes and unresolved handles are lost; recovery must come
+  from the per-tenant checkpoint namespaces on the shared root.
+* :class:`SlowReplica` — degrade one replica: the fleet's ladder
+  drains it, reshards its tenants to survivors, and readmits it at
+  ``recover_step``.
+* :class:`AdmissionFlood` — a burst of low-priority junk requests
+  that must be SHED loudly (request_shed events + counter), never
+  allowed to starve protected tenants.
+
 Each event fires at most ``repeat`` times, so a transient fault
 disappears on the retry pass while a persistent one (``repeat`` large)
 keeps tripping until the driver degrades the configuration.
@@ -231,6 +245,91 @@ class Preemption:
         self.fired += 1
         log("fault_preemption", step=self.step)
         os.kill(os.getpid(), signal.SIGTERM)
+
+
+@dataclasses.dataclass
+class ReplicaCrash:
+    """Hard-kill replica ``replica`` during the fleet round ``step``:
+    the fleet arms the replica's crash hook so the kill lands at
+    member step ``at_member_step`` INSIDE its next batch (after that
+    boundary's checkpoints — state newer than the last periodic
+    checkpoint is genuinely lost). No handles resolve, nothing is
+    checkpointed at the kill point: recovery must re-admit the
+    replica's campaigns to survivors from the per-tenant checkpoint
+    namespaces, bitwise-continuous."""
+
+    step: int
+    replica: int = 0
+    at_member_step: int = 0
+    repeat: int = 1
+    fired: int = 0
+
+    def due(self, step: int) -> bool:
+        return step == self.step and self.fired < self.repeat
+
+    def fire(self, log: LogFn) -> None:
+        self.fired += 1
+        log("fault_replica_crash", step=self.step,
+            replica=self.replica, at_member_step=self.at_member_step)
+
+
+@dataclasses.dataclass
+class SlowReplica:
+    """Mark replica ``replica`` degraded at fleet round ``step``: the
+    fleet trips its degradation ladder (drain -> reshard its tenants
+    to survivors -> readmit on recovery). ``recover_step`` is the
+    fleet round at which the replica rejoins the active set (None =
+    it stays degraded)."""
+
+    step: int
+    replica: int = 0
+    recover_step: Optional[int] = None
+    repeat: int = 1
+    fired: int = 0
+    restored: int = 0
+
+    def due(self, step: int) -> bool:
+        return step == self.step and self.fired < self.repeat
+
+    def fire(self, log: LogFn) -> None:
+        self.fired += 1
+        log("fault_slow_replica", step=self.step, replica=self.replica,
+            recover_step=self.recover_step)
+
+    def recover_due(self, step: int) -> bool:
+        return (self.recover_step is not None and self.fired > 0
+                and self.restored < self.fired
+                and step >= self.recover_step)
+
+    def recover(self, log: LogFn) -> None:
+        self.restored += 1
+        log("fault_slow_replica_recovered", step=self.recover_step,
+            replica=self.replica)
+
+
+@dataclasses.dataclass
+class AdmissionFlood:
+    """Submit ``count`` junk campaigns from ``tenant`` at ``priority``
+    (below the fleet policy's protected floor by default) during fleet
+    round ``step`` — the overload that must be SHED with a named
+    reason, not silently queued until protected tenants starve."""
+
+    step: int
+    tenant: str = "flood"
+    count: int = 8
+    priority: int = 0
+    n_steps: int = 1
+    grid: Tuple[int, int, int] = (8, 8, 8)
+    repeat: int = 1
+    fired: int = 0
+
+    def due(self, step: int) -> bool:
+        return step == self.step and self.fired < self.repeat
+
+    def fire(self, log: LogFn) -> None:
+        self.fired += 1
+        log("fault_admission_flood", step=self.step, tenant=self.tenant,
+            count=self.count, priority=self.priority)
 
 
 @dataclasses.dataclass

@@ -34,12 +34,22 @@ drives >= 3 concurrent fake-tenant campaigns through it on CPU.
 from .ensemble import (EnsembleAstaroth, EnsembleHealth, EnsembleJacobi,
                        EnsembleSentinel, configured_domain,
                        domain_fingerprint, make_ensemble_probe)
-from .queue import CampaignHandle, CampaignRequest, RequestQueue
-from .service import CampaignResult, CampaignService, ServiceStats
+from .fleet import (REPLICA_STATES, Fleet, RequestShed,
+                    TransientDispatchError)
+from .queue import (CampaignHandle, CampaignRequest, DeadlineExpired,
+                    RequestQueue)
+from .service import (CampaignResult, CampaignService, ReplicaCrashed,
+                      ServiceStats)
+from .slo import (DEFAULT_BUCKETS, SHED_REASONS, BucketError,
+                  GridBucketer, SloPolicy, rendezvous_replica)
 
 __all__ = [
     "EnsembleJacobi", "EnsembleAstaroth", "EnsembleSentinel",
     "EnsembleHealth", "make_ensemble_probe", "configured_domain",
     "domain_fingerprint", "CampaignRequest", "CampaignHandle",
     "RequestQueue", "CampaignService", "CampaignResult", "ServiceStats",
+    "DeadlineExpired", "ReplicaCrashed",
+    "Fleet", "RequestShed", "TransientDispatchError", "REPLICA_STATES",
+    "GridBucketer", "SloPolicy", "BucketError", "rendezvous_replica",
+    "DEFAULT_BUCKETS", "SHED_REASONS",
 ]

@@ -8,11 +8,16 @@ with the SAME problem fingerprint the autotuner caches plans under
 share a compiled executable" and "this request can reuse a cached
 exchange plan" are one question with one answer.
 
-:class:`RequestQueue` is the admission structure:
-``pop_batch(width)`` removes the oldest request plus every younger
-request with the SAME fingerprint (up to ``width``) — the batch a
-single ensemble dispatch serves. Requests with other fingerprints keep
-their queue order for later batches.
+:class:`RequestQueue` is the admission structure, priority-ordered
+with stable FIFO within a priority class: ``pop_batch(width)`` removes
+the highest-priority oldest request plus every younger request with
+the SAME fingerprint (up to ``width``) — the batch a single ensemble
+dispatch serves. Requests with other fingerprints keep their queue
+order for later batches. Requests carrying a ``deadline_seconds`` that
+has already expired are rejected AT POP (:class:`DeadlineExpired` on
+their handle, plus the queue's ``on_expired`` callback — the service
+turns it into a v1-schema ``request_expired`` event) instead of
+burning a batch slot on dead work.
 """
 
 from __future__ import annotations
@@ -20,7 +25,11 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class DeadlineExpired(RuntimeError):
+    """The request's deadline passed before admission."""
 
 
 @dataclasses.dataclass
@@ -48,6 +57,14 @@ class CampaignRequest:
     #: test/chaos hook: poison this member at the given member-step
     #: (None = no injection); fires once
     chaos_nan_step: Optional[int] = None
+    # -- SLO knobs (fleet admission; see serving/slo.py)
+    #: admission class: higher pops first; stable FIFO within a class.
+    #: The fleet sheds work BELOW its policy's protected_priority
+    #: under overload. Default 1 = protected under the default policy.
+    priority: int = 1
+    #: wall-clock admission deadline from submit; an expired request
+    #: is rejected at pop with a request_expired event (None = none)
+    deadline_seconds: Optional[float] = None
 
     def validate(self) -> None:
         from ..utils.checkpoint import validate_checkpoint_component
@@ -59,6 +76,11 @@ class CampaignRequest:
             raise ValueError(f"n_steps must be >= 1, got {self.n_steps}")
         if int(self.check_every) < 1:
             raise ValueError("check_every must be >= 1")
+        if self.deadline_seconds is not None \
+                and float(self.deadline_seconds) <= 0:
+            raise ValueError(
+                f"deadline_seconds must be > 0 when set, got "
+                f"{self.deadline_seconds}")
 
 
 def request_fingerprint(req: CampaignRequest, devices=None) -> str:
@@ -125,14 +147,21 @@ class _Entry:
 
 
 class RequestQueue:
-    """Thread-safe FIFO with fingerprint-compatible batch admission."""
+    """Thread-safe priority queue with fingerprint-compatible batch
+    admission (stable FIFO within a priority class; back-compat: all
+    default-priority requests behave exactly as the old FIFO)."""
 
-    def __init__(self, devices=None) -> None:
+    def __init__(self, devices=None,
+                 on_expired: Optional[Callable[["_Entry"], None]] = None
+                 ) -> None:
         self._devices = devices
         self._entries: List[_Entry] = []
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._seq = 0
+        #: called (outside handle resolution) for each entry rejected
+        #: at pop with an expired deadline — the service's event hook
+        self._on_expired = on_expired
 
     def submit(self, req: CampaignRequest) -> CampaignHandle:
         req.validate()
@@ -157,19 +186,66 @@ class RequestQueue:
                 lambda: bool(self._entries), timeout)
 
     def pop_batch(self, width: int) -> List[_Entry]:
-        """The next admission batch: the oldest request and every
-        younger fingerprint-identical request, up to ``width`` members.
-        Other fingerprints keep their positions."""
+        """The next admission batch: the highest-priority oldest
+        request (stable FIFO within a priority class) and every
+        younger fingerprint-identical request, up to ``width``
+        members. Other fingerprints keep their positions. Entries
+        whose deadline already passed are rejected here — their
+        handles fail with :class:`DeadlineExpired` and ``on_expired``
+        fires per entry — so a batch slot is never spent on work the
+        tenant has already given up on."""
+        now = time.time()
         with self._lock:
+            expired = [e for e in self._entries
+                       if e.request.deadline_seconds is not None
+                       and now - e.submitted
+                       > float(e.request.deadline_seconds)]
+            if expired:
+                gone = set(map(id, expired))
+                self._entries = [e for e in self._entries
+                                 if id(e) not in gone]
             if not self._entries:
-                return []
-            head_fp = self._entries[0].fingerprint
-            batch: List[_Entry] = []
-            rest: List[_Entry] = []
-            for e in self._entries:
-                if e.fingerprint == head_fp and len(batch) < int(width):
-                    batch.append(e)
-                else:
-                    rest.append(e)
-            self._entries = rest
-            return batch
+                batch, head = [], None
+            else:
+                # priority class first, then submit order — max() is
+                # stable in neither direction, so order the key by
+                # (priority, -seq) and take the max explicitly
+                head = max(self._entries,
+                           key=lambda e: (e.request.priority, -e.seq))
+                batch = []
+                rest: List[_Entry] = []
+                for e in sorted(self._entries,
+                                key=lambda e: (-e.request.priority,
+                                               e.seq)):
+                    if e.fingerprint == head.fingerprint \
+                            and len(batch) < int(width):
+                        batch.append(e)
+                    else:
+                        rest.append(e)
+                rest.sort(key=lambda e: e.seq)  # keep queue order
+                self._entries = rest
+        for e in expired:
+            e.handle._fail(DeadlineExpired(
+                f"{e.request.tenant}/{e.request.campaign}: deadline "
+                f"{e.request.deadline_seconds}s expired after "
+                f"{now - e.submitted:.3f}s in queue"))
+            if self._on_expired is not None:
+                self._on_expired(e)
+        return batch
+
+    def drain_entries(self) -> List[_Entry]:
+        """Remove and return EVERY queued entry (queue order) — the
+        fleet's reshard primitive when a replica degrades."""
+        with self._lock:
+            entries, self._entries = self._entries, []
+            return entries
+
+    def take(self, tenant: str, campaign: str) -> Optional[_Entry]:
+        """Remove and return the queued entry for one campaign (None
+        when it is not queued) — the fleet's migration primitive."""
+        with self._lock:
+            for i, e in enumerate(self._entries):
+                if e.request.tenant == tenant \
+                        and e.request.campaign == campaign:
+                    return self._entries.pop(i)
+        return None

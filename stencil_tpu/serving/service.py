@@ -66,6 +66,15 @@ class CampaignFailed(RuntimeError):
     """A campaign exhausted its per-tenant retry budget."""
 
 
+class ReplicaCrashed(RuntimeError):
+    """Deterministic chaos: this replica was hard-killed mid-batch
+    (see :meth:`CampaignService.arm_crash_at`). Unlike preemption,
+    NOTHING is checkpointed or resolved on the way out — in-RAM lane
+    state newer than the last periodic checkpoint is lost, exactly
+    like a real process death. The fleet recovers the replica's
+    campaigns from their per-tenant checkpoint namespaces."""
+
+
 def _block_state(eng) -> None:
     """Fence the ensemble's live state (the attribution clock must not
     credit async dispatch with seconds it merely deferred)."""
@@ -155,7 +164,8 @@ class CampaignService:
         self._window = int(window)
         self._growth_factor = float(growth_factor)
         self._max_to_keep = int(max_to_keep)
-        self.queue = RequestQueue(devices)
+        self.queue = RequestQueue(devices,
+                                  on_expired=self._on_request_expired)
         self.stats = ServiceStats()
         # unified telemetry: events through the versioned EventLog into
         # a BOUNDED ring (a long-running service holds flat memory over
@@ -207,6 +217,10 @@ class CampaignService:
         self._preempt = False
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        # deterministic chaos thresholds (member steps), armed by the
+        # fleet's fault plan; checked at segment boundaries
+        self._crash_at_step: Optional[int] = None
+        self._preempt_at_step: Optional[int] = None
 
     def _register_metrics(self) -> None:
         """Declare the service metric surface (names and labels are a
@@ -367,6 +381,23 @@ class CampaignService:
         self._preempt = True
         self._stop = True
 
+    def arm_crash_at(self, member_step: int) -> None:
+        """Deterministic chaos: hard-crash this replica (raise
+        :class:`ReplicaCrashed` out of the serving loop) at the first
+        segment boundary where any lane's member step reaches
+        ``member_step``. Periodic checkpoints written BEFORE the
+        boundary survive; everything newer is lost — the recovery
+        path the fleet's zero-loss gate exercises."""
+        self._crash_at_step = int(member_step)
+
+    def arm_preempt_at(self, member_step: int) -> None:
+        """Deterministic chaos: trip the graceful preemption path
+        (checkpoint every active campaign, tagged ``preempted``) at
+        the first segment boundary where any lane reaches
+        ``member_step`` — the fleet's migration primitive, made
+        step-deterministic for bitwise tests."""
+        self._preempt_at_step = int(member_step)
+
     def namespace(self, tenant: str, campaign: str) -> Path:
         """``root/<tenant>/<campaign>`` — both components validated
         against path traversal before they touch the filesystem."""
@@ -389,6 +420,16 @@ class CampaignService:
     def _log(self, kind: str, **kw) -> None:
         # events correlate with the enclosing telemetry span (if any)
         self._elog.emit(kind, span=self.tracer.current_span_id(), **kw)
+
+    def _on_request_expired(self, entry) -> None:
+        """Queue hook: a request's deadline passed before admission —
+        loud (v1-schema event), never a silent drop."""
+        req = entry.request
+        self._log("request_expired", tenant=req.tenant,
+                  campaign=req.campaign,
+                  deadline_seconds=req.deadline_seconds)
+        self.stats.failed += 1
+        self._m_campaigns.inc(tenant=req.tenant, outcome="expired")
 
     def _flight_dump(self, reason: str, **attrs) -> None:
         from ..observatory.recorder import safe_dump
@@ -867,6 +908,26 @@ class CampaignService:
                     self._m_checkpoints.inc()
                     poll_snapshots(block=True)
                     self._complete_lane(eng, lane)
+            # deterministic chaos: armed thresholds fire at the END of
+            # boundary processing, so checkpoints due at this boundary
+            # have already landed — a crash loses exactly the work
+            # since the last ckpt_every boundary, no more, no less
+            if (self._preempt_at_step is not None
+                    and top >= self._preempt_at_step):
+                self._preempt_at_step = None
+                # same contract as preempt(): this batch checkpoints
+                # and the worker stops (the fleet requeues + resumes)
+                self._preempt = True
+                self._stop = True
+            if (self._crash_at_step is not None
+                    and top >= self._crash_at_step):
+                armed = self._crash_at_step
+                self._crash_at_step = None
+                self._log("replica_crash", fingerprint=fp, step=top,
+                          armed_at=armed)
+                raise ReplicaCrashed(
+                    f"replica hard-crashed at member step {top} "
+                    f"(armed at {armed})")
         poll_snapshots(block=True)
         elapsed = time.perf_counter() - t_batch
         if steps_advanced and elapsed > 0:

@@ -1,0 +1,47 @@
+"""Negative control for the fleet bucketing contract: admission paths
+that leak the per-request grid into the jit signature, so every
+distinct user grid forks the compile cache — the unbounded-engine-
+cache hazard grid bucketing exists to prevent.
+
+``fixture.bucketing.shape_drift`` "buckets" by padding INSIDE the
+jitted step instead of before admission: the carried output is
+bucket-shaped while the input is the raw user grid, so the abstract
+fingerprint drifts and the second dispatch re-traces (and the real
+engine cache would hold one executable per user grid).
+``fixture.bucketing.grid_scalar_arg`` threads the grid extent through
+as a bare Python scalar — every distinct grid value forks the jit
+cache exactly like an unbucketed shape would.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from stencil_tpu.analysis.recompile import RecompileSpec, RecompileTarget
+
+#: the declared bucket edge and a user grid strictly inside it
+_BUCKET = 8
+_USER = 5
+
+
+def _shape_drift() -> RecompileSpec:
+    # pad-to-bucket INSIDE the compiled step: input is user-shaped,
+    # carried output is bucket-shaped — aval drift, retrace per step
+    fn = jax.jit(lambda x: jnp.pad(
+        x * 0.5, ((0, _BUCKET - _USER),) * 2))
+    arg = jax.ShapeDtypeStruct((_USER, _USER), jnp.float32)
+    return RecompileSpec(fn=fn, args=(arg,), carry=((0, None),))
+
+
+def _grid_scalar_arg() -> RecompileSpec:
+    # the grid extent as a Python int in the signature: weak-typed
+    # trace, one cache entry per distinct user grid
+    fn = jax.jit(lambda x, n: x * (1.0 / n))
+    arg = jax.ShapeDtypeStruct((_BUCKET, _BUCKET), jnp.float32)
+    return RecompileSpec(fn=fn, args=(arg, _USER), carry=((0, None),))
+
+
+TARGETS = [
+    RecompileTarget("fixture.bucketing.shape_drift", _shape_drift),
+    RecompileTarget("fixture.bucketing.grid_scalar_arg",
+                    _grid_scalar_arg),
+]

@@ -1,0 +1,440 @@
+"""The serving fleet: sharded/SLO admission, shedding, replica
+recovery, rebalancing.
+
+Covers the PR's acceptance contract end to end, asserting from the
+EXPORTED surfaces (Prometheus text, v1-schema events), not internal
+fields: a replica killed mid-fleet loses zero campaigns and every
+recovered campaign finishes bitwise-equal to a fault-free fleet run
+with zero recompiles and zero tuner measurements on survivors; floods
+are shed loudly below the protected priority while protected tenants
+finish unaffected; rebalance migrations resume bitwise on a
+destination that recompiles nothing; bucketing bounds the engine
+cache under 20 distinct user grids.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from stencil_tpu.resilience.faults import (AdmissionFlood, ReplicaCrash,
+                                           SlowReplica)
+from stencil_tpu.serving import (BucketError, CampaignRequest,
+                                 DeadlineExpired, Fleet, GridBucketer,
+                                 RequestQueue, RequestShed, SloPolicy,
+                                 TransientDispatchError,
+                                 rendezvous_replica)
+from stencil_tpu.serving.queue import request_fingerprint
+from stencil_tpu.telemetry import (metric_value, parse_prometheus_text,
+                                   validate_events)
+from stencil_tpu.tuning import FakeTimer
+
+MESH = (2, 2, 2)
+GRID = (8, 8, 8)
+
+
+def req(tenant="t0", campaign="c0", **kw):
+    kw.setdefault("grid", GRID)
+    kw.setdefault("n_steps", 4)
+    kw.setdefault("ckpt_every", 2)
+    kw.setdefault("mesh_shape", MESH)
+    return CampaignRequest(tenant=tenant, campaign=campaign, **kw)
+
+
+def fleet(tmp_path, tag, **kw):
+    kw.setdefault("n_replicas", 3)
+    kw.setdefault("width", 4)
+    kw.setdefault("tuner_timer", FakeTimer())
+    kw.setdefault("plan_cache_path", str(tmp_path / f"plans-{tag}.json"))
+    return Fleet(str(tmp_path / f"root-{tag}"), **kw)
+
+
+def owner_of(tenant, n_replicas=3, request=None):
+    """The rendezvous owner the fleet will route this tenant to."""
+    fp = request_fingerprint(request if request is not None
+                             else req(tenant=tenant))
+    names = [f"replica-{i}" for i in range(n_replicas)]
+    return rendezvous_replica(f"{fp}|{tenant}", names)
+
+
+# ---------------------------------------------------------------------------
+# queue: priority + deadline ordering
+
+
+def test_queue_priority_order_stable_fifo_within_class():
+    q = RequestQueue()
+    a = q.submit(req(tenant="a", priority=1))
+    b = q.submit(req(tenant="b", priority=2))
+    c = q.submit(req(tenant="c", priority=2))
+    d = q.submit(req(tenant="d", priority=1))
+    batch = q.pop_batch(width=4)
+    # highest class first, submit order within a class
+    assert [e.handle for e in batch] == [b, c, a, d]
+
+
+def test_queue_priority_back_compat_default_is_fifo():
+    q = RequestQueue()
+    handles = [q.submit(req(tenant=f"t{i}")) for i in range(4)]
+    batch = q.pop_batch(width=4)
+    assert [e.handle for e in batch] == handles
+
+
+def test_queue_priority_head_other_fingerprints_keep_place():
+    q = RequestQueue()
+    q.submit(req(tenant="low", priority=0))
+    q.submit(req(tenant="big", grid=(16, 8, 8), priority=5))
+    batch = q.pop_batch(width=4)
+    # the high-priority head picks ITS fingerprint's batch
+    assert [e.request.tenant for e in batch] == ["big"]
+    assert q.pop_batch(width=4)[0].request.tenant == "low"
+
+
+def test_queue_deadline_expired_rejected_at_pop():
+    expired_cb = []
+    q = RequestQueue(on_expired=expired_cb.append)
+    dead = q.submit(req(tenant="dead", deadline_seconds=0.01))
+    live = q.submit(req(tenant="live"))
+    time.sleep(0.05)
+    batch = q.pop_batch(width=4)
+    assert [e.handle for e in batch] == [live]
+    assert dead.done()
+    with pytest.raises(DeadlineExpired):
+        dead.result(timeout=0)
+    assert [e.request.tenant for e in expired_cb] == ["dead"]
+
+
+def test_queue_deadline_validation():
+    with pytest.raises(ValueError):
+        req(deadline_seconds=0).validate()
+    with pytest.raises(ValueError):
+        req(deadline_seconds=-1.0).validate()
+    req(deadline_seconds=30.0).validate()
+
+
+# ---------------------------------------------------------------------------
+# bucketing + rendezvous policy units
+
+
+def test_bucketer_picks_smallest_fit_and_rejects_oversize():
+    b = GridBucketer(((16, 16, 16), (8, 8, 8)))
+    assert b.bucket_for((5, 6, 7)) == (8, 8, 8)
+    assert b.bucket_for((8, 8, 8)) == (8, 8, 8)
+    assert b.bucket_for((9, 2, 2)) == (16, 16, 16)
+    with pytest.raises(BucketError):
+        b.bucket_for((17, 1, 1))
+    padded, was_padded = b.apply(req(grid=(5, 6, 7)))
+    assert was_padded and padded.grid == (8, 8, 8)
+    same, untouched = b.apply(req(grid=(8, 8, 8)))
+    assert not untouched and same.grid == (8, 8, 8)
+
+
+def test_bucketed_request_shares_native_fingerprint():
+    b = GridBucketer(((8, 8, 8),))
+    padded, _ = b.apply(req(tenant="pad", grid=(5, 6, 7)))
+    assert request_fingerprint(padded) == \
+        request_fingerprint(req(tenant="nat", grid=(8, 8, 8)))
+
+
+def test_rendezvous_death_remaps_only_the_dead_replicas_keys():
+    names = ["replica-0", "replica-1", "replica-2"]
+    keys = [f"fp|tenant-{i}" for i in range(40)]
+    before = {k: rendezvous_replica(k, names) for k in keys}
+    assert len(set(before.values())) == 3  # all replicas own something
+    survivors = [n for n in names if n != "replica-1"]
+    for k in keys:
+        after = rendezvous_replica(k, survivors)
+        if before[k] != "replica-1":
+            assert after == before[k]  # survivors keep their keys
+        else:
+            assert after in survivors
+
+
+# ---------------------------------------------------------------------------
+# the zero-loss gate: replica crash -> recovery, bitwise
+
+
+def test_replica_crash_recovers_all_campaigns_bitwise(tmp_path):
+    tenants = [f"t{i}" for i in range(4)]
+    reqs = [req(tenant=t, n_steps=6, ckpt_every=2) for t in tenants]
+
+    # one plan cache across both fleets: the calm run tunes once, the
+    # chaos run's replicas all resolve their exchange plans from cache
+    plans = str(tmp_path / "plans-shared.json")
+    calm = fleet(tmp_path, "calm", plan_cache_path=plans)
+    calm_handles = [calm.submit(r) for r in reqs]
+    calm.serve()
+    calm_final = {t: h.result(timeout=0).final["temp"]
+                  for t, h in zip(tenants, calm_handles)}
+
+    # kill the replica that owns t0 (computed, not guessed), mid-batch
+    victim = int(owner_of("t0").rsplit("-", 1)[1])
+    chaos = fleet(tmp_path, "chaos", plan_cache_path=plans, chaos=[
+        ReplicaCrash(step=0, replica=victim, at_member_step=2)])
+    handles = [chaos.submit(r) for r in reqs]
+    chaos.serve()
+
+    # zero campaigns lost, every one bitwise-equal to the calm fleet
+    for t, h in zip(tenants, handles):
+        np.testing.assert_array_equal(calm_final[t],
+                                      h.result(timeout=0).final["temp"])
+
+    # the gate reads the EXPORTED surfaces
+    text = chaos.metrics_text()
+    assert metric_value(text, "stencil_fleet_replicas",
+                        state="dead") == 1.0
+    assert metric_value(text, "stencil_fleet_replicas",
+                        state="active") == 2.0
+    assert metric_value(
+        text, "stencil_fleet_recovered_campaigns_total") >= 1.0
+    for rep in chaos.replicas:
+        if rep.state != "active":
+            continue
+        rtext = rep.service.metrics_text()
+        parsed = parse_prometheus_text(rtext)
+        # the series exists (seeded 0) AND is 0: no recompiles, and no
+        # tuner measurements for plan-cache-held fingerprints
+        assert parsed["stencil_service_recompiles_total"] == {(): 0.0}
+        assert parsed["stencil_service_tuner_measurements_total"] \
+            == {(): 0.0}
+    kinds = [e["event"] for e in chaos.events]
+    assert "fault_replica_crash" in kinds
+    assert "replica_dead" in kinds
+    assert "campaign_recovered" in kinds
+    assert validate_events(chaos.events) == []
+
+
+# ---------------------------------------------------------------------------
+# SLO shedding under flood
+
+
+def test_flood_is_shed_loudly_and_protected_tenants_unaffected(tmp_path):
+    protected = [req(tenant="alice", n_steps=4, ckpt_every=2),
+                 req(tenant="bob", n_steps=4, ckpt_every=2)]
+
+    calm = fleet(tmp_path, "calm", n_replicas=2)
+    calm_final = {}
+    for r in protected:
+        calm_final[r.tenant] = calm.submit(r)
+    calm.serve()
+    calm_final = {t: h.result(timeout=0).final["temp"]
+                  for t, h in calm_final.items()}
+
+    flooded = fleet(
+        tmp_path, "flood", n_replicas=2,
+        policy=SloPolicy(max_queue_depth=3),
+        chaos=[AdmissionFlood(step=0, tenant="flood", count=6,
+                              priority=0, n_steps=1)])
+    handles = {r.tenant: flooded.submit(r) for r in protected}
+    flooded.serve()
+
+    # protected campaigns complete bitwise-identical to the calm fleet
+    for t, h in handles.items():
+        np.testing.assert_array_equal(calm_final[t],
+                                      h.result(timeout=0).final["temp"])
+
+    text = flooded.metrics_text()
+    shed = metric_value(text, "stencil_fleet_shed_total",
+                        tenant="flood", reason="queue_depth")
+    assert shed >= 1.0
+    # protected tenants shed nothing (series exist, seeded 0)
+    for t in ("alice", "bob"):
+        for reason in ("queue_depth", "admission_latency"):
+            parsed = parse_prometheus_text(text)
+            assert parsed["stencil_fleet_shed_total"][
+                (("reason", reason), ("tenant", t))] == 0.0
+    sheds = [e for e in flooded.events if e["event"] == "request_shed"]
+    assert len(sheds) == int(shed)
+    assert all(e["reason"] == "queue_depth" and e["tenant"] == "flood"
+               for e in sheds)
+    assert validate_events(flooded.events) == []
+
+
+def test_shed_reason_thresholds():
+    p = SloPolicy(max_queue_depth=4,
+                  max_admission_latency_seconds=1.0,
+                  protected_priority=1)
+    assert p.shed_reason(1, 100, 100.0) is None     # protected
+    assert p.shed_reason(0, 4, None) == "queue_depth"
+    assert p.shed_reason(0, 3, 2.0) == "admission_latency"
+    assert p.shed_reason(0, 3, 0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# rebalance: preempt-on-src -> resume-on-dst, zero dst recompiles
+
+
+def test_rebalance_migration_bitwise_zero_destination_recompiles(
+        tmp_path):
+    mig_req = req(tenant="mig", n_steps=6, ckpt_every=2)
+
+    # one SHARED plan cache across both fleets: the calm run tunes
+    # once, so NO replica of the migration fleet measures anything
+    plans = str(tmp_path / "plans-shared.json")
+    calm = fleet(tmp_path, "calm", n_replicas=2, plan_cache_path=plans)
+    h = calm.submit(mig_req)
+    calm.serve()
+    calm_final = h.result(timeout=0).final["temp"]
+
+    fl = fleet(tmp_path, "mig", n_replicas=2, plan_cache_path=plans)
+    src = owner_of("mig", n_replicas=2, request=mig_req)
+    dst = next(r.name for r in fl.replicas if r.name != src)
+    # warm the destination with a fingerprint-identical campaign from
+    # a tenant the rendezvous hash routes there
+    warm_tenant = next(
+        f"w{i}" for i in range(64)
+        if owner_of(f"w{i}", n_replicas=2,
+                    request=req(tenant=f"w{i}")) == dst)
+    warm = fl.submit(req(tenant=warm_tenant, n_steps=2, ckpt_every=2))
+    handle = fl.submit(mig_req)
+    # preempt-on-src mid-campaign, then pin the resume to dst
+    fl.replica(src).service.arm_preempt_at(2)
+    fl.pump()
+    assert warm.done() and not handle.done()
+    fl.migrate("mig", "c0", dst)
+    fl.serve()
+
+    np.testing.assert_array_equal(calm_final,
+                                  handle.result(timeout=0).final["temp"])
+    res = handle.result(timeout=0)
+    assert res.resumed_from == 2   # continued, not restarted
+
+    dtext = fl.replica(dst).service.metrics_text()
+    # destination recompiled nothing and re-tuned nothing: the warm
+    # campaign built the engine (1 compile), the migrated campaign
+    # reused it
+    assert metric_value(dtext, "stencil_service_recompiles_total") == 0.0
+    assert metric_value(dtext,
+                        "stencil_service_tuner_measurements_total") == 0.0
+    assert metric_value(dtext, "stencil_service_compiles_total") == 1.0
+    ftext = fl.metrics_text()
+    assert metric_value(ftext, "stencil_fleet_migrations_total") == 1.0
+    migs = [e for e in fl.events if e["event"] == "migration"]
+    assert len(migs) == 1 and migs[0]["to_replica"] == dst
+
+
+def test_rebalance_picks_migrations_from_load(tmp_path):
+    fl = fleet(tmp_path, "bal", n_replicas=2)
+    # pin 4 campaigns onto one replica via pinned routing, then let
+    # rebalance spread them
+    for i in range(4):
+        fl.submit(req(tenant=f"t{i}", n_steps=2))
+        fl._campaigns[(f"t{i}", "c0")].pinned = "replica-0"
+    moved = fl.rebalance()
+    # 4/0 -> 3/1 -> 2/2: two moves reach balance
+    assert len(moved) == 2
+    assert all(m["from"] == "replica-0" and m["to"] == "replica-1"
+               for m in moved)
+    load = fl.loads()
+    assert abs(load["replica-0"] - load["replica-1"]) < 2
+    fl.serve()
+    for c in fl._campaigns.values():
+        assert c.handle.result(timeout=0).steps == 2
+
+
+# ---------------------------------------------------------------------------
+# bucketing bounds the engine cache
+
+
+def test_bucketing_caps_engine_cache_under_20_distinct_grids(tmp_path):
+    fl = fleet(tmp_path, "buckets", n_replicas=1)
+    grids = [(2 + a, 2 + b, 8) for a in range(4) for b in range(5)]
+    assert len(set(grids)) == 20
+    handles = [fl.submit(req(tenant=f"g{i}", grid=g, n_steps=1,
+                             ckpt_every=0))
+               for i, g in enumerate(grids)]
+    fl.serve()
+    for h in handles:
+        assert h.result(timeout=0).steps == 1
+        assert h.request.grid == (8, 8, 8)  # admitted AT the bucket
+    rtext = fl.replicas[0].service.metrics_text()
+    # 20 distinct user grids -> ONE bucket-shaped engine
+    assert metric_value(rtext, "stencil_service_engine_cache_size") == 1.0
+    assert metric_value(rtext, "stencil_service_compiles_total") == 1.0
+    assert metric_value(rtext, "stencil_service_recompiles_total") == 0.0
+    bucketed = [e for e in fl.events if e["event"] == "request_bucketed"]
+    assert len(bucketed) == 20
+
+
+def test_unbucketable_grid_rejected_loudly(tmp_path):
+    fl = fleet(tmp_path, "reject", n_replicas=1)
+    h = fl.submit(req(tenant="huge", grid=(64, 64, 64)))
+    assert h.done()
+    with pytest.raises(BucketError):
+        h.result(timeout=0)
+    assert any(e["event"] == "request_rejected"
+               and e["reason"] == "bucket" for e in fl.events)
+
+
+# ---------------------------------------------------------------------------
+# slow-replica degradation ladder
+
+
+def test_slow_replica_drains_resards_and_readmits(tmp_path):
+    victim_name = owner_of("t0")
+    victim = int(victim_name.rsplit("-", 1)[1])
+    fl = fleet(tmp_path, "slow", chaos=[
+        SlowReplica(step=0, replica=victim, recover_step=1)])
+    handles = [fl.submit(req(tenant=f"t{i}", n_steps=2))
+               for i in range(3)]
+    fl.serve()
+    for h in handles:
+        assert h.result(timeout=0).steps == 2
+    # nothing ran on the degraded replica while it was out
+    vtext = fl.replica(victim_name).service.metrics_text()
+    assert metric_value(vtext, "stencil_service_batches_total") == 0.0
+    kinds = [e["event"] for e in fl.events]
+    assert "replica_degraded" in kinds and "replica_recovered" in kinds
+    # after readmission it serves its tenants again
+    text = fl.metrics_text()
+    assert metric_value(text, "stencil_fleet_replicas",
+                        state="active") == 3.0
+    assert metric_value(text, "stencil_fleet_replicas",
+                        state="degraded") == 0.0
+    h2 = fl.submit(req(tenant="t0", campaign="c1", n_steps=2))
+    fl.serve()
+    assert h2.result(timeout=0).steps == 2
+    assert metric_value(fl.replica(victim_name).service.metrics_text(),
+                        "stencil_service_batches_total") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# dispatch retry/backoff
+
+
+def test_transient_dispatch_failure_retries_with_backoff(tmp_path):
+    delays = []
+    fl = fleet(tmp_path, "retry", n_replicas=1,
+               retry_base_delay=0.05, retry_sleep=delays.append)
+    fl.inject_dispatch_error(TransientDispatchError("blip"),
+                             TransientDispatchError("blip"))
+    h = fl.submit(req(tenant="t0", n_steps=2))
+    fl.serve()
+    assert h.result(timeout=0).steps == 2
+    assert delays == [0.05, 0.1]   # base_delay * 2**k
+    retries = [e for e in fl.events if e["event"] == "dispatch_retry"]
+    assert [r["attempt"] for r in retries] == [1, 2]
+
+
+def test_dispatch_retry_budget_exhaustion_fails_the_campaign(tmp_path):
+    fl = fleet(tmp_path, "retryx", n_replicas=1,
+               retry_attempts=2, retry_sleep=lambda _d: None)
+    fl.inject_dispatch_error(TransientDispatchError("down"),
+                             TransientDispatchError("down"),
+                             TransientDispatchError("down"))
+    h = fl.submit(req(tenant="t0", n_steps=2))
+    fl.serve()
+    with pytest.raises(TransientDispatchError):
+        h.result(timeout=0)
+    assert any(e["event"] == "dispatch_failed" for e in fl.events)
+
+
+def test_non_retriable_dispatch_error_propagates_immediately(tmp_path):
+    delays = []
+    fl = fleet(tmp_path, "retrynr", n_replicas=1,
+               retry_sleep=delays.append)
+    fl.inject_dispatch_error(ValueError("not transient"))
+    h = fl.submit(req(tenant="t0", n_steps=2))
+    fl.serve()
+    with pytest.raises(ValueError):
+        h.result(timeout=0)
+    assert delays == []   # no backoff burned on a non-transient error

@@ -704,7 +704,8 @@ def test_cli_only_accepts_target_globs(tmp_path):
                                      "bad_segment_carry.py",
                                      "bad_schedule.py",
                                      "bad_precision.py",
-                                     "bad_packing.py"])
+                                     "bad_packing.py",
+                                     "bad_bucketing.py"])
 def test_cli_nonzero_on_every_fixture(fixture):
     """The acceptance criterion verbatim: the CLI exits nonzero on
     EVERY negative-control fixture."""
